@@ -1,0 +1,27 @@
+"""shadow1_tpu: a TPU-native discrete-event network simulator.
+
+A brand-new framework with the capabilities of Shadow (reference:
+RWails/shadow-1): it simulates large Internets -- thousands of virtual hosts
+with a userspace TCP stack, latency/loss topologies, CoDel routers,
+token-bucket interfaces, and real or modeled applications -- in deterministic
+nanosecond virtual time.
+
+Unlike the reference's per-event C engine (one pthread pops one event at a
+time from per-host priority queues, reference src/main/core/worker.c:149-216),
+the hot loop here is a JAX/XLA design: per-host protocol state lives as
+dense SoA arrays in HBM, each conservative time window advances *all* hosts
+in one compiled device step, routing is a gather from a precomputed dense
+all-pairs latency/reliability matrix, and multi-chip scale-out shards the
+host axis over a `jax.sharding.Mesh` with packet exchange as collectives
+over ICI.
+
+Simulation time is int64 nanoseconds (reference
+src/main/core/support/definitions.h:28-64), which requires 64-bit mode;
+importing this package enables jax_enable_x64.
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

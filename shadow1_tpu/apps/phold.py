@@ -1,0 +1,145 @@
+"""PHOLD: the classic parallel-discrete-event synthetic workload, on-device.
+
+The reference ships phold as a C plugin (/root/reference/src/test/phold/
+test_phold.c): N hosts hold messages; each received UDP message triggers
+sending a new message to a random host after a random exponential delay.
+It doubles as the scheduler stress test and the event-rate performance
+probe.
+
+Here phold is an on-device application model: its per-host state is a
+pytree, its "receive a message / send a message" logic runs inside the
+engine micro-step as masked vector ops, and its randomness is keyed by
+(host, per-host draw counter) so the trajectory is bitwise reproducible on
+any mesh.
+"""
+
+from __future__ import annotations
+
+from flax import struct
+import jax.numpy as jnp
+
+from ..core import emit, rng, simtime
+from ..core.state import I32, I64, U32
+from ..transport import udp
+
+PHOLD_PORT = 9000
+MSG_BYTES = 64
+
+
+@struct.dataclass
+class PholdState:
+    next_send: jnp.ndarray  # [H] i64 time of next send, SIMTIME_INVALID if none
+    pending: jnp.ndarray    # [H] i32 messages held, waiting to be forwarded
+    sent: jnp.ndarray       # [H] i64 total messages sent
+    recv: jnp.ndarray       # [H] i64 total messages received
+
+
+class Phold:
+    """Static app config; hashable so jitted engine calls cache per config."""
+
+    def __init__(self, mean_delay_ns: int, sock_slot: int = 0):
+        self.mean_delay_ns = int(mean_delay_ns)
+        self.sock_slot = int(sock_slot)
+
+    def __hash__(self):
+        return hash(("phold", self.mean_delay_ns, self.sock_slot))
+
+    def __eq__(self, other):
+        return (isinstance(other, Phold)
+                and other.mean_delay_ns == self.mean_delay_ns
+                and other.sock_slot == self.sock_slot)
+
+    # -- engine hooks -------------------------------------------------------
+
+    def next_time(self, state):
+        a = state.app
+        return jnp.where(a.pending > 0, a.next_send,
+                         jnp.asarray(simtime.SIMTIME_INVALID, I64))
+
+    def _delay(self, params, host_ids, ctr):
+        """Exponential delay, keyed by (host, draw counter)."""
+        key = rng.purpose_key(params.seed_key, rng.PURPOSE_HOST_APP)
+        u = rng.keyed_uniform(key, host_ids, ctr, jnp.uint32(1))
+        d = -jnp.log1p(-u) * self.mean_delay_ns
+        return jnp.maximum(d.astype(I64), 1)
+
+    def _pick_dst(self, params, host_ids, ctr, num_hosts):
+        key = rng.purpose_key(params.seed_key, rng.PURPOSE_HOST_APP)
+        u = rng.keyed_uniform(key, host_ids, ctr, jnp.uint32(2))
+        # Uniform over the other hosts (never self).
+        off = 1 + jnp.minimum((u * (num_hosts - 1)).astype(I32), num_hosts - 2)
+        return (host_ids.astype(I32) + off) % num_hosts
+
+    def on_tick(self, state, params, em, tick_t, active):
+        a = state.app
+        socks = state.socks
+        h = a.pending.shape[0]
+        rows = jnp.arange(h, dtype=U32)
+        slot = jnp.full((h,), self.sock_slot, I32)
+
+        # Consume delivered messages from the socket ring: each one becomes
+        # a pending message with a fresh send time.  The engine delivers at
+        # most one datagram per host per tick and this app always drains on
+        # the same tick, so ring depth never exceeds 1; two iterations only
+        # bound the unrolled graph, not the throughput.
+        for _ in range(2):
+            socks, got, _src, _sport, _len, _pid = udp.pop_ring(
+                socks, active, slot)
+            ctr = state.hosts.rng_ctr
+            delay = self._delay(params, rows, ctr)
+            cand = tick_t + delay
+            a = a.replace(
+                pending=a.pending + jnp.where(got, 1, 0),
+                next_send=jnp.where(
+                    got, jnp.minimum(a.next_send, cand), a.next_send),
+                recv=a.recv + jnp.where(got, 1, 0),
+            )
+            state = state.replace(hosts=state.hosts.replace(
+                rng_ctr=state.hosts.rng_ctr + jnp.where(got, 1, 0).astype(U32)))
+
+        # Send one message where due.
+        due = active & (a.pending > 0) & (a.next_send <= tick_t)
+        ctr = state.hosts.rng_ctr
+        dst = self._pick_dst(params, rows, ctr, h)
+        em = emit.put(
+            em, due, emit.SLOT_APP,
+            dst=dst, sport=PHOLD_PORT, dport=PHOLD_PORT,
+            proto=17, length=MSG_BYTES,
+        )
+        # Re-arm: more pending messages draw a new delay (counter +2: one for
+        # dst draw, one for the delay draw).
+        delay2 = self._delay(params, rows, ctr + 1)
+        pending2 = a.pending - jnp.where(due, 1, 0)
+        a = a.replace(
+            pending=pending2,
+            sent=a.sent + jnp.where(due, 1, 0),
+            next_send=jnp.where(
+                due,
+                jnp.where(pending2 > 0, tick_t + delay2,
+                          jnp.asarray(simtime.SIMTIME_INVALID, I64)),
+                a.next_send),
+        )
+        state = state.replace(
+            app=a,
+            socks=socks,
+            hosts=state.hosts.replace(
+                rng_ctr=state.hosts.rng_ctr + jnp.where(due, 2, 0).astype(U32)),
+        )
+        return state, em
+
+
+def init_state(num_hosts: int, params, msgs_per_host: int = 1,
+               mean_delay_ns: int = 10 * simtime.SIMTIME_ONE_MILLISECOND):
+    """Initial phold population: every host holds `msgs_per_host` messages
+    with exponentially distributed first send times."""
+    key = rng.purpose_key(params.seed_key, rng.PURPOSE_HOST_APP)
+    rows = jnp.arange(num_hosts, dtype=U32)
+    u = rng.keyed_uniform(key, rows, jnp.uint32(0), jnp.uint32(1))
+    first = jnp.maximum(
+        (-jnp.log1p(-u) * mean_delay_ns).astype(I64), 1)
+    return PholdState(
+        next_send=first,
+        pending=jnp.full((num_hosts,), msgs_per_host, I32),
+        sent=jnp.zeros((num_hosts,), I64),
+        recv=jnp.zeros((num_hosts,), I64),
+    )

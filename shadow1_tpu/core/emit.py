@@ -1,0 +1,99 @@
+"""Per-tick packet emission staging.
+
+During one engine micro-step every host may emit a bounded number of
+packets (an ACK from receive processing, a delayed-ACK/timer packet, an
+application datagram, a few TCP data segments).  Each emission category has
+a *fixed slot index* in a dense [H, E] staging buffer; at the end of the
+tick the staging buffer is flushed into the global PacketPool.
+
+The fixed slot order is what makes packet identity deterministic: a host's
+n-th emission of the whole run gets pkt_id (host << 40) | n, with the
+within-tick order defined by slot index.  This reproduces the role of the
+reference's per-host srcHostEventID in the deterministic event total order
+(/root/reference/src/main/core/work/event.c:110-153) without any sequential
+bookkeeping.
+"""
+
+from __future__ import annotations
+
+from flax import struct
+import jax.numpy as jnp
+
+from .state import F32, I32, I64, U32
+
+# Emission slots, in deterministic within-tick order.
+SLOT_RX_REPLY = 0   # ACK/SYN-ACK/RST generated while processing an arrival
+SLOT_TIMER = 1      # delayed-ACK / zero-window probe packets
+SLOT_APP = 2        # application datagram (UDP sendto)
+SLOT_TX_BASE = 3    # TCP data segments (SLOT_TX_BASE .. SLOT_TX_BASE+TX_SLOTS-1)
+TX_SLOTS = 4
+NUM_SLOTS = SLOT_TX_BASE + TX_SLOTS
+
+
+@struct.dataclass
+class Emissions:
+    """[H, NUM_SLOTS] staged outgoing packets for the current tick."""
+
+    valid: jnp.ndarray       # [H,E] bool
+    dst: jnp.ndarray         # [H,E] i32
+    sport: jnp.ndarray       # [H,E] i32
+    dport: jnp.ndarray       # [H,E] i32
+    proto: jnp.ndarray       # [H,E] i32
+    flags: jnp.ndarray       # [H,E] i32
+    seq: jnp.ndarray         # [H,E] u32
+    ack: jnp.ndarray         # [H,E] u32
+    wnd: jnp.ndarray         # [H,E] i32
+    length: jnp.ndarray      # [H,E] i32
+    ts_echo: jnp.ndarray     # [H,E] i64
+    payload_id: jnp.ndarray  # [H,E] i32
+    priority: jnp.ndarray    # [H,E] f32
+
+
+def empty(num_hosts: int) -> Emissions:
+    he = (num_hosts, NUM_SLOTS)
+    return Emissions(
+        valid=jnp.zeros(he, jnp.bool_),
+        dst=jnp.zeros(he, I32),
+        sport=jnp.zeros(he, I32),
+        dport=jnp.zeros(he, I32),
+        proto=jnp.zeros(he, I32),
+        flags=jnp.zeros(he, I32),
+        seq=jnp.zeros(he, U32),
+        ack=jnp.zeros(he, U32),
+        wnd=jnp.zeros(he, I32),
+        length=jnp.zeros(he, I32),
+        ts_echo=jnp.zeros(he, I64),
+        payload_id=jnp.full(he, -1, I32),
+        priority=jnp.zeros(he, F32),
+    )
+
+
+def put(em: Emissions, mask: jnp.ndarray, slot: int, *, dst, sport, dport,
+        proto, flags=0, seq=0, ack=0, wnd=0, length=0, ts_echo=0,
+        payload_id=-1, priority=0.0) -> Emissions:
+    """Vectorized emit: for hosts where `mask` is set, stage one packet in
+    `slot`.  All field arguments are scalars or [H] arrays."""
+
+    h = em.valid.shape[0]
+
+    def b(x, dtype):
+        return jnp.broadcast_to(jnp.asarray(x).astype(dtype), (h,))
+
+    def upd(cur, val, dtype):
+        return cur.at[:, slot].set(jnp.where(mask, b(val, dtype), cur[:, slot]))
+
+    return Emissions(
+        valid=em.valid.at[:, slot].set(jnp.where(mask, True, em.valid[:, slot])),
+        dst=upd(em.dst, dst, I32),
+        sport=upd(em.sport, sport, I32),
+        dport=upd(em.dport, dport, I32),
+        proto=upd(em.proto, proto, I32),
+        flags=upd(em.flags, flags, I32),
+        seq=upd(em.seq, seq, U32),
+        ack=upd(em.ack, ack, U32),
+        wnd=upd(em.wnd, wnd, I32),
+        length=upd(em.length, length, I32),
+        ts_echo=upd(em.ts_echo, ts_echo, I64),
+        payload_id=upd(em.payload_id, payload_id, I32),
+        priority=upd(em.priority, priority, F32),
+    )

@@ -1,0 +1,324 @@
+"""The windowed discrete-event engine: conservative PDES as compiled loops.
+
+Semantics preserved from the reference:
+
+* Conservative time windows with min-latency lookahead: all hosts process
+  events in [window_start, window_end), then the window advances by the
+  topology's minimum cross-host latency ("min time jump",
+  /root/reference/src/main/core/master.c:133-159,450-480).  A packet sent
+  at t >= window_start arrives at t + latency >= window_end, so hosts are
+  independent within a window -- the property the reference enforces with
+  per-host queues + barriers (scheduler.c:359-414) and that we exploit to
+  advance every host in one vectorized step.
+
+* Deterministic per-host event order: within a host, events execute in
+  (time, category, packet-id) order, reproducing the role of the
+  reference's total order (time, dstHostID, srcHostID, srcHostEventID)
+  (core/work/event.c:110-153).  Between hosts no order is needed --
+  windows make them independent -- so the result is bitwise identical for
+  any device mesh.
+
+Structure: `run_until` runs an outer while_loop over windows; each window
+runs an inner while_loop of *micro-steps*.  One micro-step advances every
+host's earliest pending work simultaneously:
+
+  phase A  packet arrivals -> transport/socket processing (1/host/tick)
+  phase B  socket timer expirations (RTO, delayed ACK, TIME_WAIT)
+  phase C  application model tick (consume delivered data, timed sends)
+  phase D  TCP transmit + flush staged emissions into the packet pool
+
+The per-phase work is bounded per tick (one arrival per host, a few
+emission slots), so each micro-step is a fixed-shape dataflow graph; hosts
+with nothing due are masked off.  "Find the next event" is a segment-min
+over the packet pool plus element-wise mins over timer tables -- the
+replacement for the reference's binary-heap pops (scheduler_pop,
+core/scheduler/scheduler.c:359).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import emit, rng, simtime
+# Reliability-dropped packets are never materialized in the pool (they are
+# counted in HostTable.pkts_dropped_inet instead), so PDS_INET_DROPPED is
+# deliberately absent here.
+from .state import (ERR_POOL_OVERFLOW, I32, I64, PROTO_TCP, PROTO_UDP,
+                    STAGE_FREE, STAGE_IN_FLIGHT,
+                    PDS_INET_SENT, PDS_RCV_SOCKET_PROCESSED, SimState)
+
+INV = simtime.SIMTIME_INVALID
+
+
+def _seg_min(values, seg, num, mask):
+    big = jnp.asarray(INV, values.dtype)
+    data = jnp.where(mask, values, big)
+    return jax.ops.segment_min(data, seg, num_segments=num)
+
+
+# ---------------------------------------------------------------------------
+# Next-event scan (replaces priority-queue peeks)
+# ---------------------------------------------------------------------------
+
+
+def next_times(state: SimState, params, app):
+    """Per-host earliest pending event time [H] and its global min."""
+    pool, socks, hosts = state.pool, state.socks, state.hosts
+    h = hosts.num_hosts
+
+    inflight = pool.stage == STAGE_IN_FLIGHT
+    t_arr = _seg_min(pool.time, pool.dst, h, inflight)
+
+    t_tmr = jnp.minimum(
+        jnp.min(socks.t_rto, axis=1),
+        jnp.minimum(jnp.min(socks.t_delack, axis=1),
+                    jnp.min(socks.t_tw, axis=1)),
+    )
+
+    t_app = app.next_time(state) if app is not None else jnp.full((h,), INV, I64)
+
+    t_h = jnp.minimum(jnp.minimum(t_arr, t_tmr),
+                      jnp.minimum(t_app, hosts.t_resume))
+    return t_h, jnp.min(t_h)
+
+
+# ---------------------------------------------------------------------------
+# Phase A: arrival selection + delivery
+# ---------------------------------------------------------------------------
+
+
+def _select_arrivals(state: SimState, tick_t, active):
+    """Pick per host the earliest due in-flight packet (deterministic by
+    (time, pkt_id)).
+
+    Returns ([H] pool index or -1, [P] chosen mask).  The mask is what pool
+    updates must use: indexing the pool by the clipped per-host slot would
+    produce duplicate-index scatters whose write order is undefined.
+    """
+    pool = state.pool
+    h = state.hosts.num_hosts
+    p = pool.capacity
+
+    due = (pool.stage == STAGE_IN_FLIGHT) & (pool.time <= tick_t[pool.dst]) \
+        & active[pool.dst]
+    tmin = _seg_min(pool.time, pool.dst, h, due)
+    at_min = due & (pool.time == tmin[pool.dst])
+    idmin = _seg_min(pool.pkt_id, pool.dst, h, at_min)
+    chosen = at_min & (pool.pkt_id == idmin[pool.dst])
+    # Scatter pool index to the destination host (<=1 chosen per host;
+    # .max makes the -1 fillers harmless regardless of write order).
+    idx = jnp.where(chosen, jnp.arange(p, dtype=I32), -1)
+    slot_of_host = jnp.full((h,), -1, I32).at[pool.dst].max(idx)
+    return slot_of_host, chosen
+
+
+def _deliver(state: SimState, params, em, tick_t, pool_slot, chosen, app):
+    """Deliver the selected packets to their sockets (UDP now; TCP hooks in
+    transport/tcp.py once present)."""
+    from ..transport import tcp as tcp_mod
+    from ..transport import udp as udp_mod
+
+    pool = state.pool
+    have = pool_slot >= 0
+    slot = jnp.clip(pool_slot, 0, pool.capacity - 1)
+
+    g = lambda a: a[slot]
+    src, sport, dport = g(pool.src), g(pool.sport), g(pool.dport)
+    proto, length, payload = g(pool.proto), g(pool.length), g(pool.payload_id)
+
+    # UDP
+    udp_mask = have & (proto == PROTO_UDP)
+    socks, _accepted = udp_mod.deliver(state.socks, udp_mask, src, sport,
+                                       dport, length, payload)
+    state = state.replace(socks=socks)
+
+    # TCP
+    tcp_mask = have & (proto == PROTO_TCP)
+    state, em = tcp_mod.process_arrivals(state, params, em, tick_t, slot,
+                                         tcp_mask)
+
+    # Consume delivered packets & account (elementwise via the [P] mask --
+    # no duplicate-index scatters).
+    pool = pool.replace(
+        stage=jnp.where(chosen, STAGE_FREE, pool.stage),
+        status=jnp.where(chosen, pool.status | PDS_RCV_SOCKET_PROCESSED,
+                         pool.status),
+    )
+    hosts = state.hosts
+    hosts = hosts.replace(
+        pkts_recv=hosts.pkts_recv + jnp.where(have, 1, 0),
+        bytes_recv=hosts.bytes_recv + jnp.where(have, length, 0),
+    )
+    return state.replace(pool=pool, hosts=hosts), em
+
+
+# ---------------------------------------------------------------------------
+# Emission staging (packets leave their source this tick)
+# ---------------------------------------------------------------------------
+
+
+def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t):
+    """Assign pkt_ids, apply routing latency + reliability drops, and
+    scatter staged emissions into free pool slots.
+
+    The reference equivalent is worker_sendPacket
+    (/root/reference/src/main/core/worker.c:243-304): reliability draw,
+    latency lookup, push event to the destination host queue.  Loopback
+    bypasses the topology with a 1ns delay like the reference's local path
+    (network_interface.c:548-555).
+    """
+    pool, hosts = state.pool, state.hosts
+    h, e = em.valid.shape
+    p = pool.capacity
+
+    valid = em.valid
+    rank = jnp.cumsum(valid, axis=1) - 1              # [H,E] within-host order
+    counts = jnp.sum(valid, axis=1).astype(I64)       # [H]
+    ctr = hosts.send_ctr                               # [H]
+
+    src2 = jnp.broadcast_to(jnp.arange(h, dtype=I32)[:, None], (h, e))
+    ctr2 = ctr[:, None] + rank
+    pkt_id2 = (src2.astype(I64) << 40) | ctr2
+
+    # Routing: latency + reliability, loopback shortcut.
+    vs = params.host_vertex[src2]
+    vd = params.host_vertex[jnp.clip(em.dst, 0, params.host_vertex.shape[0] - 1)]
+    lat = params.latency_ns[vs, vd]
+    rel = params.reliability[vs, vd]
+    loop = em.dst == src2
+    lat = jnp.where(loop, simtime.SIMTIME_ONE_NANOSECOND, lat)
+    rel = jnp.where(loop, 1.0, rel)
+
+    drop_key = rng.purpose_key(params.seed_key, rng.PURPOSE_PACKET_DROP)
+    u = rng.keyed_uniform(drop_key, src2, ctr2.astype(jnp.uint32),
+                          (ctr2 >> 32).astype(jnp.uint32))
+    dropped = valid & (u >= rel)
+    live = valid & ~dropped
+
+    # Allocate free pool slots to live emissions in deterministic flat order.
+    livef = live.reshape(-1)
+    order = jnp.cumsum(livef) - 1                      # [H*E]
+    free = pool.stage == STAGE_FREE
+    nmax = min(p, h * e)
+    # Sentinel for "no slot" is `p`, NOT -1: negative scatter indices wrap
+    # in XLA even under mode='drop'; only >= size is dropped.
+    free_idx = jnp.nonzero(free, size=nmax, fill_value=p)[0]
+    n_free = jnp.sum(free_idx < p)
+    slot = jnp.where(livef & (order < n_free),
+                     free_idx[jnp.clip(order, 0, nmax - 1)], p)
+    overflow = jnp.any(livef & (slot >= p))
+
+    send_t = jnp.broadcast_to(tick_t[:, None], (h, e)).reshape(-1)
+    arr_t = send_t + lat.reshape(-1)
+
+    def sc(a, val, dtype=None):
+        v = val.reshape(-1) if hasattr(val, "reshape") else val
+        if dtype is not None:
+            v = v.astype(dtype)
+        return a.at[slot].set(v, mode="drop")
+
+    pool = pool.replace(
+        stage=sc(pool.stage, jnp.full((h * e,), STAGE_IN_FLIGHT, I32)),
+        src=sc(pool.src, src2),
+        dst=sc(pool.dst, em.dst),
+        sport=sc(pool.sport, em.sport),
+        dport=sc(pool.dport, em.dport),
+        proto=sc(pool.proto, em.proto),
+        flags=sc(pool.flags, em.flags),
+        seq=sc(pool.seq, em.seq),
+        ack=sc(pool.ack, em.ack),
+        wnd=sc(pool.wnd, em.wnd),
+        length=sc(pool.length, em.length),
+        time=sc(pool.time, arr_t),
+        pkt_id=sc(pool.pkt_id, pkt_id2),
+        ts=sc(pool.ts, send_t),
+        ts_echo=sc(pool.ts_echo, em.ts_echo),
+        payload_id=sc(pool.payload_id, em.payload_id),
+        priority=sc(pool.priority, em.priority),
+        status=sc(pool.status, jnp.full((h * e,), PDS_INET_SENT, I32)),
+    )
+
+    sent_bytes = jnp.sum(jnp.where(live, em.length, 0), axis=1).astype(I64)
+    hosts = hosts.replace(
+        send_ctr=ctr + counts,
+        pkts_sent=hosts.pkts_sent + jnp.sum(live, axis=1),
+        bytes_sent=hosts.bytes_sent + sent_bytes,
+        pkts_dropped_inet=hosts.pkts_dropped_inet + jnp.sum(dropped, axis=1),
+    )
+    err = state.err | jnp.where(overflow, ERR_POOL_OVERFLOW, 0).astype(jnp.int32)
+    return state.replace(pool=pool, hosts=hosts, err=err)
+
+
+# ---------------------------------------------------------------------------
+# Micro-step and loops
+# ---------------------------------------------------------------------------
+
+
+def microstep(state: SimState, params, app, t_h, window_end):
+    """Advance every host's earliest pending event (< window_end)."""
+    from ..transport import tcp as tcp_mod
+
+    h = state.hosts.num_hosts
+    active = t_h < window_end
+    tick_t = jnp.where(active, t_h, window_end)
+
+    # Hosts resume flags are re-armed by this tick's phases.
+    state = state.replace(
+        hosts=state.hosts.replace(t_resume=jnp.full((h,), INV, I64)))
+
+    em = emit.empty(h)
+
+    # Phase A: arrivals.
+    pool_slot, chosen = _select_arrivals(state, tick_t, active)
+    state, em = _deliver(state, params, em, tick_t, pool_slot, chosen, app)
+
+    # Phase B: transport timers.
+    state, em = tcp_mod.run_timers(state, params, em, tick_t, active)
+
+    # Phase C: application tick.
+    if app is not None:
+        state, em = app.on_tick(state, params, em, tick_t, active)
+
+    # Phase D: TCP transmission, then flush all staged packets.
+    state, em = tcp_mod.transmit(state, params, em, tick_t, active)
+    state = _stage_emissions(state, params, em, tick_t)
+    return state
+
+
+@functools.partial(jax.jit, static_argnames=("app",))
+def run_until(state: SimState, params, app, t_target):
+    """Run windows until simulated time reaches t_target (jitted whole)."""
+    t_target = jnp.asarray(t_target, I64)
+
+    # (t_h, gmin) ride in the loop carry so the next-event scan -- the most
+    # expensive reduction in the simulator -- runs exactly once per
+    # micro-step instead of once more per window cond/body.
+    def window_cond(carry):
+        st, _t_h, gmin = carry
+        return (st.now < t_target) & (gmin < t_target)
+
+    def window_body(carry):
+        st, t_h, gmin = carry
+        ws = jnp.maximum(st.now, gmin)
+        we = jnp.minimum(ws + params.min_latency_ns, t_target)
+
+        def icond(icarry):
+            _s, _th, g = icarry
+            return g < we
+
+        def ibody(icarry):
+            s, th, _ = icarry
+            s = microstep(s, params, app, th, we)
+            th2, g2 = next_times(s, params, app)
+            return s, th2, g2
+
+        st, t_h, gmin = jax.lax.while_loop(icond, ibody, (st, t_h, gmin))
+        return st.replace(now=we), t_h, gmin
+
+    t_h0, gmin0 = next_times(state, params, app)
+    state, _, _ = jax.lax.while_loop(window_cond, window_body,
+                                     (state, t_h0, gmin0))
+    return state.replace(now=t_target)

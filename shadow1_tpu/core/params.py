@@ -1,0 +1,85 @@
+"""Static (per-run constant) simulation parameters.
+
+The reference resolves latency/reliability lazily per source via Dijkstra
+with a path cache (/root/reference/src/main/routing/topology.c:1678-1875).
+Here the whole all-pairs answer is precomputed once at startup into dense
+matrices indexed by topology vertex (see routing/apsp.py), and per-packet
+"routing" is a 2-D gather -- the TPU-shaped replacement for the path cache.
+"""
+
+from __future__ import annotations
+
+from flax import struct
+import jax
+import jax.numpy as jnp
+
+from . import simtime
+from .state import I32, I64, F32
+
+
+@struct.dataclass
+class NetParams:
+    """Constant under jit for a whole run (still a pytree of arrays so it
+    can be donated/sharded)."""
+
+    latency_ns: jnp.ndarray     # [V,V] i64 one-way latency along chosen path
+    reliability: jnp.ndarray    # [V,V] f32 end-to-end delivery probability
+    host_vertex: jnp.ndarray    # [H] i32 topology vertex each host attached to
+    bw_up_Bps: jnp.ndarray      # [H] i64 upstream bytes/sec
+    bw_down_Bps: jnp.ndarray    # [H] i64 downstream bytes/sec
+    min_latency_ns: jnp.ndarray  # i64 scalar: conservative lookahead (min jump)
+    seed_key: jax.Array         # PRNG root key
+    stop_time: jnp.ndarray      # i64 scalar
+    bootstrap_end: jnp.ndarray  # i64 scalar: before this, bandwidth unlimited
+                                # (reference master.c:261-268, worker.c:445-453)
+
+    def pair_latency(self, src_host, dst_host):
+        """One-way latency between two hosts (ns)."""
+        vs = self.host_vertex[src_host]
+        vd = self.host_vertex[dst_host]
+        return self.latency_ns[vs, vd]
+
+    def pair_reliability(self, src_host, dst_host):
+        vs = self.host_vertex[src_host]
+        vd = self.host_vertex[dst_host]
+        return self.reliability[vs, vd]
+
+
+def make_net_params(
+    latency_ns,
+    reliability,
+    host_vertex,
+    bw_up_Bps,
+    bw_down_Bps,
+    seed: int = 1,
+    stop_time: int = simtime.SIMTIME_ONE_SECOND,
+    bootstrap_end: int = 0,
+    min_latency_ns=None,
+) -> NetParams:
+    from . import rng
+
+    latency_ns = jnp.asarray(latency_ns, I64)
+    if min_latency_ns is None:
+        # Minimum positive off-diagonal latency bounds the lookahead window,
+        # like the reference's min time jump with a 10ms default when the
+        # topology gives nothing (master.c:133-159).
+        v = latency_ns.shape[0]
+        off = jnp.where(jnp.eye(v, dtype=bool), jnp.asarray(simtime.SIMTIME_INVALID, I64), latency_ns)
+        off = jnp.where(off <= 0, jnp.asarray(simtime.SIMTIME_INVALID, I64), off)
+        m = jnp.min(off)
+        min_latency_ns = jnp.where(
+            m == simtime.SIMTIME_INVALID,
+            jnp.asarray(10 * simtime.SIMTIME_ONE_MILLISECOND, I64),
+            m,
+        )
+    return NetParams(
+        latency_ns=latency_ns,
+        reliability=jnp.asarray(reliability, F32),
+        host_vertex=jnp.asarray(host_vertex, I32),
+        bw_up_Bps=jnp.asarray(bw_up_Bps, I64),
+        bw_down_Bps=jnp.asarray(bw_down_Bps, I64),
+        min_latency_ns=jnp.asarray(min_latency_ns, I64),
+        seed_key=rng.root_key(seed),
+        stop_time=jnp.asarray(stop_time, I64),
+        bootstrap_end=jnp.asarray(bootstrap_end, I64),
+    )

@@ -1,0 +1,78 @@
+"""Deterministic, order-independent random draws.
+
+The reference derives determinism from a seeded chain of rand_r generators
+handed master -> slave -> scheduler -> host
+(/root/reference/src/main/utility/random.c:15-50, master.c:95, slave.c:301).
+That scheme is inherently sequential: a draw's value depends on how many
+draws happened before it on the same generator.
+
+A TPU-native simulator cannot afford (and does not want) sequential draw
+order: events for all hosts are processed in one vectorized step, and the
+set of draws must be identical regardless of device mesh shape or window
+batching.  So every random draw here is *functionally keyed*: a counter-based
+PRNG (JAX threefry) evaluated at a key derived from the global seed plus the
+stable identifiers of the thing being drawn for -- e.g. (packet id, hop) for
+a drop decision, (host id, per-host draw counter) for application
+randomness.  Two runs with the same seed produce bitwise-identical draws on
+any sharding, which upgrades the reference's determinism contract
+(reference src/test/determinism/) from "same worker count" to "any mesh".
+"""
+
+import jax
+import jax.numpy as jnp
+
+# Purpose tags keep independent subsystems' draws decorrelated even when the
+# rest of the key material collides.
+PURPOSE_PACKET_DROP = 1
+PURPOSE_HOST_APP = 2
+PURPOSE_ATTACH = 3
+PURPOSE_JITTER = 4
+PURPOSE_SCHED = 5
+
+
+def root_key(seed: int) -> jax.Array:
+    """Root PRNG key for a simulation (reference: --seed, options.c)."""
+    return jax.random.PRNGKey(seed)
+
+
+def purpose_key(key: jax.Array, purpose: int) -> jax.Array:
+    return jax.random.fold_in(key, purpose)
+
+
+def keyed_uniform(key: jax.Array, *ids) -> jax.Array:
+    """U[0,1) keyed by a sequence of integer ids (scalars or same-shape arrays).
+
+    Vectorized: if ids are arrays, returns an array of independent draws of
+    the broadcast shape.
+    """
+    ids = [jnp.asarray(i, dtype=jnp.uint32) for i in ids]
+    shape = jnp.broadcast_shapes(*(i.shape for i in ids))
+    # Mix the ids into per-element key data with a threefry fold-in chain.
+    def fold_all(scalars):
+        k = key
+        for s in scalars:
+            k = jax.random.fold_in(k, s)
+        return jax.random.uniform(k, (), dtype=jnp.float32)
+
+    # Scalars route through a size-1 batch: shape-() random ops hang on the
+    # axon TPU backend (observed 2026-07-29), and the batch path is what the
+    # engine exercises anyway.
+    flat = [jnp.broadcast_to(i, shape).reshape(-1) for i in ids]
+    out = jax.vmap(lambda *s: fold_all(s))(*flat)
+    return out.reshape(shape)
+
+
+def keyed_bits(key: jax.Array, *ids) -> jax.Array:
+    """uint32 random bits keyed by integer ids (same contract as keyed_uniform)."""
+    ids = [jnp.asarray(i, dtype=jnp.uint32) for i in ids]
+    shape = jnp.broadcast_shapes(*(i.shape for i in ids))
+
+    def fold_all(scalars):
+        k = key
+        for s in scalars:
+            k = jax.random.fold_in(k, s)
+        return jax.random.bits(k, (), dtype=jnp.uint32)
+
+    flat = [jnp.broadcast_to(i, shape).reshape(-1) for i in ids]
+    out = jax.vmap(lambda *s: fold_all(s))(*flat)
+    return out.reshape(shape)

@@ -1,0 +1,58 @@
+"""Simulation time: int64 nanoseconds since simulation start.
+
+Mirrors the reference's SimulationTime contract
+(/root/reference/src/main/core/support/definitions.h:28-64): nanosecond
+resolution, with an emulated wall clock offset so applications that ask for
+the time see a date shortly after Jan 1 2000
+(definitions.h:78, src/main/core/worker.c:385-390).
+
+All constants are plain Python ints; device arrays carrying times must be
+jnp.int64 (the package enables x64 at import).
+"""
+
+import jax.numpy as jnp
+
+# One nanosecond is the base unit.
+SIMTIME_ONE_NANOSECOND = 1
+SIMTIME_ONE_MICROSECOND = 1_000
+SIMTIME_ONE_MILLISECOND = 1_000_000
+SIMTIME_ONE_SECOND = 1_000_000_000
+SIMTIME_ONE_MINUTE = 60 * SIMTIME_ONE_SECOND
+SIMTIME_ONE_HOUR = 60 * SIMTIME_ONE_MINUTE
+
+# Sentinel for "no event pending" / invalid time. Using int64 max means a
+# plain jnp.min over next-event candidates naturally ignores empty slots.
+SIMTIME_INVALID = (1 << 63) - 1
+
+# Greatest representable simulation time (kept distinct from the sentinel so
+# clamping logic can't accidentally produce "invalid").
+SIMTIME_MAX = SIMTIME_INVALID - 1
+
+# Emulated Unix epoch offset: applications observe wall-clock time starting
+# at 946_684_800s (2000-01-01T00:00:00Z), like the reference's
+# EMULATED_TIME_OFFSET (definitions.h:78).
+EMULATED_TIME_OFFSET = 946_684_800 * SIMTIME_ONE_SECOND
+
+TIME_DTYPE = jnp.int64
+
+
+def simtime(value) -> jnp.ndarray:
+    """Lift a scalar/array to the canonical time dtype."""
+    return jnp.asarray(value, dtype=TIME_DTYPE)
+
+
+def from_seconds(seconds: float) -> int:
+    return int(round(seconds * SIMTIME_ONE_SECOND))
+
+
+def from_millis(ms: float) -> int:
+    return int(round(ms * SIMTIME_ONE_MILLISECOND))
+
+
+def to_seconds(t) -> float:
+    return float(t) / SIMTIME_ONE_SECOND
+
+
+def emulated_time(sim_now):
+    """Virtual wall-clock time an application observes (ns since Unix epoch)."""
+    return simtime(sim_now) + EMULATED_TIME_OFFSET

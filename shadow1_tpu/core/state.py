@@ -1,0 +1,393 @@
+"""Simulator state as dense structure-of-arrays pytrees.
+
+The reference keeps one heap-allocated object graph per host (Host owns
+NetworkInterfaces, Routers, Descriptors, TCP structs; reference
+src/main/host/host.c:57-105) and a locked priority queue of event objects
+per host (scheduler_policy_host_single.c).  Here the same information lives
+in fixed-capacity dense arrays with a leading `hosts` axis, so one compiled
+device step advances every host at once and the host axis can be sharded
+over a TPU mesh.
+
+Three big tables:
+
+* `PacketPool` -- every packet in the simulated world, in any lifecycle
+  stage (reference: Packet objects + per-queue linked lists,
+  src/main/routing/packet.c:40-63).  A packet's position in the network is
+  a `stage` tag, not a container: FREE -> TX_QUEUED (socket/qdisc/token
+  bucket at source, reference network_interface.c:466-540) -> IN_FLIGHT
+  (latency line, reference worker.c:243-304) -> RX_QUEUED (destination
+  upstream-router CoDel queue, reference router_queue_codel.c) -> consumed.
+  Stage transitions are vectorized masked updates; "queues" are recovered
+  by sorting on (time, id) keys, which reproduces the reference's
+  deterministic event total order (src/main/core/work/event.c:110-153).
+
+* `SocketTable` -- `[H, S]` per-host socket slots holding the entire
+  transport state machine as int fields (reference TCP struct,
+  src/main/host/descriptor/tcp.c:125-230).
+
+* `HostTable` -- `[H]` per-host NIC token buckets, RNG counters, and
+  tracker counters (reference network_interface.c:32-40, tracker.c).
+
+Payload *bytes* never live on device: packets carry a `length` and an
+optional host-side arena id (`payload_id`), mirroring how the reference
+shares one refcounted Payload across hosts (src/main/routing/payload.c) --
+the device only ever needs metadata.
+"""
+
+from __future__ import annotations
+
+from flax import struct
+import jax.numpy as jnp
+
+from . import simtime
+
+# ---------------------------------------------------------------------------
+# Enums / constants
+# ---------------------------------------------------------------------------
+
+# Packet lifecycle stages.
+STAGE_FREE = 0
+STAGE_TX_QUEUED = 1   # waiting for source NIC tokens / qdisc
+STAGE_IN_FLIGHT = 2   # traversing the latency line
+STAGE_RX_QUEUED = 3   # in destination upstream-router (CoDel) queue
+
+# IP protocols (only these two exist in the simulated net, like the
+# reference's PTCP/PUDP/PLOCAL protocol tags, packet.h).
+PROTO_NONE = 0
+PROTO_TCP = 6
+PROTO_UDP = 17
+
+# TCP header flags.
+TCP_FLAG_FIN = 1
+TCP_FLAG_SYN = 2
+TCP_FLAG_RST = 4
+TCP_FLAG_ACK = 16
+
+# Socket slot types.
+SOCK_FREE = 0
+SOCK_UDP = 1
+SOCK_TCP = 2
+
+# TCP states (reference tcp.c:41-55).
+TCPS_CLOSED = 0
+TCPS_LISTEN = 1
+TCPS_SYNSENT = 2
+TCPS_SYNRECEIVED = 3
+TCPS_ESTABLISHED = 4
+TCPS_FINWAIT1 = 5
+TCPS_FINWAIT2 = 6
+TCPS_CLOSING = 7
+TCPS_TIMEWAIT = 8
+TCPS_CLOSEWAIT = 9
+TCPS_LASTACK = 10
+
+# Packet delivery-status trail bits, the observability analog of the
+# reference's PDS_* flags (src/main/routing/packet.h:18-41).
+PDS_SND_CREATED = 1 << 0
+PDS_SND_TCP_ENQUEUE_THROTTLED = 1 << 1
+PDS_SND_INTERFACE_SENT = 1 << 2
+PDS_INET_SENT = 1 << 3
+PDS_INET_DROPPED = 1 << 4
+PDS_ROUTER_ENQUEUED = 1 << 5
+PDS_ROUTER_DROPPED = 1 << 6
+PDS_RCV_INTERFACE_RECEIVED = 1 << 7
+PDS_RCV_SOCKET_PROCESSED = 1 << 8
+PDS_DESTROYED = 1 << 9
+
+# Error flag bits (raised to the host between windows; the escape hatch for
+# fixed-capacity overflow).
+ERR_POOL_OVERFLOW = 1 << 0
+ERR_SOCKET_OVERFLOW = 1 << 1
+ERR_UDPQ_OVERFLOW = 1 << 2
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+F32 = jnp.float32
+
+MTU = 1500          # reference CONFIG_MTU, definitions.h:188
+TCP_HEADER_SIZE = 40   # reference CONFIG_HEADER_SIZE_TCPIPETH ballpark
+UDP_HEADER_SIZE = 28
+TCP_MSS = MTU - TCP_HEADER_SIZE
+
+
+def _full(shape, dtype, value):
+    return jnp.full(shape, value, dtype=dtype)
+
+
+def _zeros(shape, dtype):
+    return jnp.zeros(shape, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Packet pool
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class PacketPool:
+    """All packets in the world; fixed capacity P, SoA layout.
+
+    `pkt_id` is the deterministic total-order tiebreaker: a packet created
+    by host h as its n-th emission gets id (h << 40) | n, mirroring the
+    reference's (srcHostID, srcHostEventID) component of the event order
+    (event.c:110-153).  Drop draws are keyed by pkt_id so loss is identical
+    across meshes and window batchings.
+    """
+
+    stage: jnp.ndarray        # [P] i32 STAGE_*
+    src: jnp.ndarray          # [P] i32 source host index
+    dst: jnp.ndarray          # [P] i32 destination host index
+    sport: jnp.ndarray       # [P] i32
+    dport: jnp.ndarray       # [P] i32
+    proto: jnp.ndarray        # [P] i32 PROTO_*
+    flags: jnp.ndarray        # [P] i32 TCP flags
+    seq: jnp.ndarray          # [P] u32
+    ack: jnp.ndarray          # [P] u32
+    wnd: jnp.ndarray          # [P] i32 advertised window (bytes)
+    length: jnp.ndarray       # [P] i32 payload bytes (headers excluded)
+    time: jnp.ndarray         # [P] i64 stage-dependent: ready/deliver/arrive time
+    pkt_id: jnp.ndarray       # [P] i64 (src << 40) | per-src counter
+    ts: jnp.ndarray           # [P] i64 TCP timestamp (send time)
+    ts_echo: jnp.ndarray      # [P] i64 TCP timestamp echo
+    payload_id: jnp.ndarray   # [P] i32 host-side arena ref, -1 = modeled
+    priority: jnp.ndarray     # [P] f32 qdisc priority (reference packet.c priority)
+    status: jnp.ndarray       # [P] i32 PDS_* trail
+
+    @property
+    def capacity(self) -> int:
+        return self.stage.shape[0]
+
+
+def make_packet_pool(capacity: int) -> PacketPool:
+    return PacketPool(
+        stage=_zeros((capacity,), I32),
+        src=_zeros((capacity,), I32),
+        dst=_zeros((capacity,), I32),
+        sport=_zeros((capacity,), I32),
+        dport=_zeros((capacity,), I32),
+        proto=_zeros((capacity,), I32),
+        flags=_zeros((capacity,), I32),
+        seq=_zeros((capacity,), U32),
+        ack=_zeros((capacity,), U32),
+        wnd=_zeros((capacity,), I32),
+        length=_zeros((capacity,), I32),
+        time=_full((capacity,), I64, simtime.SIMTIME_INVALID),
+        pkt_id=_zeros((capacity,), I64),
+        ts=_zeros((capacity,), I64),
+        ts_echo=_zeros((capacity,), I64),
+        payload_id=_full((capacity,), I32, -1),
+        priority=_zeros((capacity,), F32),
+        status=_zeros((capacity,), I32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Socket table
+# ---------------------------------------------------------------------------
+
+OOO_WORDS = 8  # out-of-order bitmap: 8 * 32 = 256 MSS segments beyond rcv_nxt
+UDP_RING = 8   # per-UDP-socket datagram ring entries
+
+
+@struct.dataclass
+class SocketTable:
+    """[H, S] socket slots; the whole descriptor/transport layer.
+
+    The reference's vtable hierarchy Descriptor->Transport->Socket->TCP/UDP
+    (descriptor/socket.h) collapses into one table of int fields; the
+    "vtable dispatch" is a vectorized select on `stype`/`tcp_state`.
+    """
+
+    stype: jnp.ndarray        # [H,S] i32 SOCK_*
+    tcp_state: jnp.ndarray    # [H,S] i32 TCPS_*
+    local_port: jnp.ndarray   # [H,S] i32 0 = unbound
+    peer_host: jnp.ndarray    # [H,S] i32 -1 = none
+    peer_port: jnp.ndarray    # [H,S] i32
+    parent: jnp.ndarray       # [H,S] i32 listener slot for accepted children, -1
+    accepted: jnp.ndarray     # [H,S] bool child handed to app via accept()
+    child_order: jnp.ndarray  # [H,S] i64 SYN pkt_id: deterministic accept order
+    backlog: jnp.ndarray      # [H,S] i32 listen backlog
+
+    # --- send side (sequence space, reference tcp.c:125-150) ---
+    snd_una: jnp.ndarray      # [H,S] u32 oldest unacked
+    snd_nxt: jnp.ndarray      # [H,S] u32 next to transmit
+    snd_end: jnp.ndarray      # [H,S] u32 end of app-supplied data
+    snd_wnd: jnp.ndarray      # [H,S] i32 peer receive window
+    snd_buf_cap: jnp.ndarray  # [H,S] i32 send buffer capacity (bytes)
+    cwnd: jnp.ndarray         # [H,S] i32 congestion window (bytes)
+    ssthresh: jnp.ndarray     # [H,S] i32
+    dup_acks: jnp.ndarray     # [H,S] i32
+    recover: jnp.ndarray      # [H,S] u32 fast-recovery high-water mark
+    in_recovery: jnp.ndarray  # [H,S] bool
+    retrans_nxt: jnp.ndarray  # [H,S] u32 next seq to retransmit (< snd_nxt when retransmitting)
+    app_closed: jnp.ndarray   # [H,S] bool app called close(); FIN at snd_end
+
+    # --- receive side ---
+    rcv_nxt: jnp.ndarray      # [H,S] u32 next expected
+    rcv_read: jnp.ndarray     # [H,S] u32 seq consumed by app
+    rcv_buf_cap: jnp.ndarray  # [H,S] i32
+    ooo_mask: jnp.ndarray     # [H,S,OOO_WORDS] u32 bitmap of segments past rcv_nxt
+    fin_seq: jnp.ndarray      # [H,S] u32 peer FIN sequence, 0 = none seen
+
+    # --- timers & RTT (reference tcp.c:175-220) ---
+    srtt: jnp.ndarray         # [H,S] i64 ns, 0 = no sample yet
+    rttvar: jnp.ndarray       # [H,S] i64 ns
+    rto: jnp.ndarray          # [H,S] i64 ns
+    t_rto: jnp.ndarray        # [H,S] i64 retransmit timer expiry, SIMTIME_INVALID = off
+    t_delack: jnp.ndarray     # [H,S] i64 delayed-ACK timer
+    t_tw: jnp.ndarray         # [H,S] i64 TIME_WAIT / misc timer
+    delack_pending: jnp.ndarray  # [H,S] i32 segments since last ACK sent
+
+    # --- UDP datagram ring ---
+    udp_head: jnp.ndarray     # [H,S] i32
+    udp_count: jnp.ndarray    # [H,S] i32
+    udp_src: jnp.ndarray      # [H,S,UDP_RING] i32
+    udp_sport: jnp.ndarray    # [H,S,UDP_RING] i32
+    udp_len: jnp.ndarray      # [H,S,UDP_RING] i32
+    udp_payload: jnp.ndarray  # [H,S,UDP_RING] i32 arena id
+
+    # --- error & accounting ---
+    error: jnp.ndarray        # [H,S] i32 pending socket error (errno-like)
+    bytes_sent: jnp.ndarray   # [H,S] i64
+    bytes_recv: jnp.ndarray   # [H,S] i64
+
+    @property
+    def num_hosts(self) -> int:
+        return self.stype.shape[0]
+
+    @property
+    def slots(self) -> int:
+        return self.stype.shape[1]
+
+
+def make_socket_table(num_hosts: int, slots: int) -> SocketTable:
+    hs = (num_hosts, slots)
+    return SocketTable(
+        stype=_zeros(hs, I32),
+        tcp_state=_zeros(hs, I32),
+        local_port=_zeros(hs, I32),
+        peer_host=_full(hs, I32, -1),
+        peer_port=_zeros(hs, I32),
+        parent=_full(hs, I32, -1),
+        accepted=_zeros(hs, jnp.bool_),
+        child_order=_zeros(hs, I64),
+        backlog=_zeros(hs, I32),
+        snd_una=_zeros(hs, U32),
+        snd_nxt=_zeros(hs, U32),
+        snd_end=_zeros(hs, U32),
+        snd_wnd=_zeros(hs, I32),
+        snd_buf_cap=_zeros(hs, I32),
+        cwnd=_zeros(hs, I32),
+        ssthresh=_zeros(hs, I32),
+        dup_acks=_zeros(hs, I32),
+        recover=_zeros(hs, U32),
+        in_recovery=_zeros(hs, jnp.bool_),
+        retrans_nxt=_zeros(hs, U32),
+        app_closed=_zeros(hs, jnp.bool_),
+        rcv_nxt=_zeros(hs, U32),
+        rcv_read=_zeros(hs, U32),
+        rcv_buf_cap=_zeros(hs, I32),
+        ooo_mask=_zeros(hs + (OOO_WORDS,), U32),
+        fin_seq=_zeros(hs, U32),
+        srtt=_zeros(hs, I64),
+        rttvar=_zeros(hs, I64),
+        rto=_zeros(hs, I64),
+        t_rto=_full(hs, I64, simtime.SIMTIME_INVALID),
+        t_delack=_full(hs, I64, simtime.SIMTIME_INVALID),
+        t_tw=_full(hs, I64, simtime.SIMTIME_INVALID),
+        delack_pending=_zeros(hs, I32),
+        udp_head=_zeros(hs, I32),
+        udp_count=_zeros(hs, I32),
+        udp_src=_full(hs + (UDP_RING,), I32, -1),
+        udp_sport=_zeros(hs + (UDP_RING,), I32),
+        udp_len=_zeros(hs + (UDP_RING,), I32),
+        udp_payload=_full(hs + (UDP_RING,), I32, -1),
+        error=_zeros(hs, I32),
+        bytes_sent=_zeros(hs, I64),
+        bytes_recv=_zeros(hs, I64),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host table (NIC + per-host counters)
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class HostTable:
+    """[H] per-host state outside the socket table.
+
+    Token buckets mirror the reference's per-interface up/down buckets with
+    1ms refill (network_interface.c:93-190); refill is computed lazily from
+    `last_refill` instead of scheduling a refill event per ms per host.
+    NOTE: bandwidth enforcement is NOT yet wired into the engine -- the
+    token fields exist but emissions currently go straight to IN_FLIGHT
+    (the NIC/qdisc/CoDel milestone turns them on).
+    """
+
+    rng_ctr: jnp.ndarray       # [H] u32 per-host app draw counter
+    send_ctr: jnp.ndarray      # [H] i64 per-host packet emission counter (pkt_id low bits)
+    t_resume: jnp.ndarray      # [H] i64 host has more same-time work (e.g. open
+                               # TCP window not fully transmitted); SIMTIME_INVALID = none
+    tokens_tx: jnp.ndarray     # [H] i64 bytes available to transmit
+    tokens_rx: jnp.ndarray     # [H] i64 bytes available to receive
+    last_refill: jnp.ndarray   # [H] i64 last lazy-refill timestamp (ms-aligned)
+    # Tracker counters (reference tracker.c).
+    bytes_sent: jnp.ndarray    # [H] i64
+    bytes_recv: jnp.ndarray    # [H] i64
+    pkts_sent: jnp.ndarray     # [H] i64
+    pkts_recv: jnp.ndarray     # [H] i64
+    pkts_dropped_inet: jnp.ndarray   # [H] i64 reliability drops
+    pkts_dropped_router: jnp.ndarray  # [H] i64 CoDel/overflow drops
+
+    @property
+    def num_hosts(self) -> int:
+        return self.rng_ctr.shape[0]
+
+
+def make_host_table(num_hosts: int) -> HostTable:
+    h = (num_hosts,)
+    return HostTable(
+        rng_ctr=_zeros(h, U32),
+        send_ctr=_zeros(h, I64),
+        t_resume=_full(h, I64, simtime.SIMTIME_INVALID),
+        tokens_tx=_zeros(h, I64),
+        tokens_rx=_zeros(h, I64),
+        last_refill=_zeros(h, I64),
+        bytes_sent=_zeros(h, I64),
+        bytes_recv=_zeros(h, I64),
+        pkts_sent=_zeros(h, I64),
+        pkts_recv=_zeros(h, I64),
+        pkts_dropped_inet=_zeros(h, I64),
+        pkts_dropped_router=_zeros(h, I64),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Whole-simulation state
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class SimState:
+    """Everything that evolves during a run; one pytree, checkpointable."""
+
+    now: jnp.ndarray          # i64 scalar: current window start
+    pool: PacketPool
+    socks: SocketTable
+    hosts: HostTable
+    app: any = struct.field(pytree_node=True, default=None)  # application-model state
+    err: jnp.ndarray = struct.field(default=None)  # i32 scalar ERR_* bitmask
+
+
+def make_sim_state(num_hosts: int, sock_slots: int = 16,
+                   pool_capacity: int = 1 << 15, app=None) -> SimState:
+    return SimState(
+        now=jnp.asarray(0, I64),
+        pool=make_packet_pool(pool_capacity),
+        socks=make_socket_table(num_hosts, sock_slots),
+        hosts=make_host_table(num_hosts),
+        app=app,
+        err=jnp.asarray(0, I32),
+    )

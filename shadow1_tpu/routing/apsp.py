@@ -1,0 +1,99 @@
+"""All-pairs shortest paths on device: the routing precompute.
+
+The reference runs igraph Dijkstra lazily per source vertex at simulation
+time, guarded by a path cache and rwlocks
+(/root/reference/src/main/routing/topology.c:1678-1875, cache at :24-79).
+On TPU the better shape is the opposite: compute *all* pairs once at
+startup with a Floyd-Warshall relaxation entirely on device, then serve
+every per-packet lookup as a two-level gather from the resulting dense
+[V,V] matrices.  No locks, no cache misses, no per-packet graph walks.
+
+Weights are f32 milliseconds during relaxation (sub-microsecond resolution
+at Internet scales); the final latency matrix is rounded to i64
+nanoseconds so engine arithmetic stays exact and deterministic.
+
+Reliability composes multiplicatively along the chosen (min-latency) path:
+the relaxation carries it next to the latency and updates it wherever the
+latency strictly improves -- the vectorized equivalent of the reference
+accumulating edge/vertex packet-loss along the Dijkstra path
+(topology.c:1407-1523).
+
+Self-paths (two hosts attached to the same vertex) use twice the minimum
+incident edge, like the reference's doubled min-incident-edge rule
+(topology.c:1545-1643).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.simtime import SIMTIME_ONE_MILLISECOND, TIME_DTYPE
+
+# Unreachable sentinel in ms; far above any real path but small enough that
+# INF + INF stays finite in f32.
+INF_MS = 1e12
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def floyd_warshall(lat_ms: jnp.ndarray, rel: jnp.ndarray):
+    """Relax [V,V] f32 latency (ms) + reliability through every vertex.
+
+    Plain k-loop FW: V iterations of O(V^2) vectorized relaxations; one
+    compiled fori_loop, VPU-bound, run once at topology load.
+    """
+
+    def body(k, carry):
+        lat, rel = carry
+        through = lat[:, k, None] + lat[None, k, :]
+        rel_through = rel[:, k, None] * rel[None, k, :]
+        better = through < lat
+        return (jnp.where(better, through, lat),
+                jnp.where(better, rel_through, rel))
+
+    v = lat_ms.shape[0]
+    return jax.lax.fori_loop(0, v, body, (lat_ms, rel))
+
+
+def build_matrices(edge_lat_ms: jnp.ndarray, edge_rel: jnp.ndarray,
+                   self_lat_ms=None, self_rel=None):
+    """From directed-adjacency inputs to the final routing matrices.
+
+    edge_lat_ms: [V,V] f32, INF_MS where no edge, 0 on the diagonal.
+    edge_rel:    [V,V] f32 per-edge delivery probability (vertex loss
+                 already folded into incoming edges by the loader).
+    self_lat_ms: optional [V] f32 explicit self-loop latency (nan = absent);
+                 vertices without one fall back to the doubled
+                 min-incident-edge rule.
+    self_rel:    optional [V] f32 reliability paired with self_lat_ms.
+
+    Returns (latency_ns i64 [V,V], reliability f32 [V,V]).
+    """
+    v = edge_lat_ms.shape[0]
+    lat, rel = floyd_warshall(edge_lat_ms, edge_rel)
+
+    # Self-paths: explicit self-loop if the topology declares one, else out
+    # to the nearest neighbor and back.
+    eye = jnp.eye(v, dtype=bool)
+    off_lat = jnp.where(eye, INF_MS, lat)
+    nearest = jnp.argmin(off_lat, axis=1)
+    d_lat = 2.0 * off_lat[jnp.arange(v), nearest]
+    d_rel = rel[jnp.arange(v), nearest] ** 2
+    if self_lat_ms is not None:
+        have = ~jnp.isnan(self_lat_ms)
+        d_lat = jnp.where(have, self_lat_ms, d_lat)
+        d_rel = jnp.where(have, jnp.ones_like(d_rel) if self_rel is None
+                          else self_rel, d_rel)
+    lat = jnp.where(eye, d_lat[:, None] * eye, lat)
+    rel = jnp.where(eye, (d_rel[:, None] * eye) + (~eye), rel)
+
+    lat_ns = jnp.round(lat * SIMTIME_ONE_MILLISECOND).astype(TIME_DTYPE)
+    return lat_ns, rel.astype(jnp.float32)
+
+
+def is_routable(lat_ns: jnp.ndarray) -> jnp.ndarray:
+    """[V,V] bool connectivity, the analog of topology_isRoutable
+    (topology.c:2065-2092)."""
+    return lat_ns < int(INF_MS) * SIMTIME_ONE_MILLISECOND // 2

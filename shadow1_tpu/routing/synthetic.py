@@ -1,0 +1,25 @@
+"""Synthetic topologies for tests and benchmarks.
+
+The reference ships tiny inline GraphML topologies for its test configs
+(e.g. the 1-vertex CDATA topology in
+/root/reference/src/test/determinism/determinism1.test.shadow.config.xml);
+these helpers produce the equivalent dense matrices directly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.simtime import TIME_DTYPE
+
+
+def uniform_full_mesh(n_vertices: int, latency_ns: int,
+                      reliability: float = 1.0):
+    """Complete graph: every pair at `latency_ns`, self at 1ns.
+
+    Returns (latency_ns [V,V] i64, reliability [V,V] f32).
+    """
+    eye = jnp.eye(n_vertices, dtype=bool)
+    lat = jnp.where(eye, 1, latency_ns).astype(TIME_DTYPE)
+    rel = jnp.where(eye, 1.0, reliability).astype(jnp.float32)
+    return lat, rel

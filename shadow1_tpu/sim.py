@@ -1,0 +1,57 @@
+"""High-level simulation assembly.
+
+The reference assembles a run from shadow.config.xml + a GraphML topology
+(master.c:161-238, slave_addNewVirtualHost).  This module is the
+programmatic equivalent: build params + state + app, then `run`.
+The XML/GraphML front end (config/) lowers onto these calls.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .apps import phold as phold_app
+from .core import engine, simtime
+from .core.params import make_net_params
+from .core.state import make_sim_state
+from .routing.synthetic import uniform_full_mesh
+from .transport import udp
+
+
+def build_phold(num_hosts: int,
+                latency_ns: int = 10 * simtime.SIMTIME_ONE_MILLISECOND,
+                reliability: float = 1.0,
+                msgs_per_host: int = 1,
+                mean_delay_ns: int = 10 * simtime.SIMTIME_ONE_MILLISECOND,
+                stop_time: int = simtime.SIMTIME_ONE_SECOND,
+                seed: int = 1,
+                sock_slots: int = 4,
+                pool_capacity: int = 1 << 14):
+    """A phold benchmark world on a uniform full-mesh topology."""
+    lat, rel = uniform_full_mesh(num_hosts, latency_ns, reliability)
+    params = make_net_params(
+        latency_ns=lat,
+        reliability=rel,
+        host_vertex=jnp.arange(num_hosts),
+        bw_up_Bps=jnp.full(num_hosts, 1 << 30),
+        bw_down_Bps=jnp.full(num_hosts, 1 << 30),
+        seed=seed,
+        stop_time=stop_time,
+    )
+    state = make_sim_state(num_hosts, sock_slots=sock_slots,
+                           pool_capacity=pool_capacity)
+    state = state.replace(
+        socks=udp.open_bind_all(state.socks, slot=0, port=phold_app.PHOLD_PORT),
+        # rng_ctr starts at 1: counter value 0 is reserved for the initial
+        # send-time draws in phold_app.init_state.
+        hosts=state.hosts.replace(rng_ctr=state.hosts.rng_ctr + 1),
+    )
+    app = phold_app.Phold(mean_delay_ns=mean_delay_ns, sock_slot=0)
+    state = state.replace(app=phold_app.init_state(
+        num_hosts, params, msgs_per_host, mean_delay_ns))
+    return state, params, app
+
+
+def run(state, params, app, until=None):
+    t = params.stop_time if until is None else until
+    return engine.run_until(state, params, app, t)

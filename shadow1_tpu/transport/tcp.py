@@ -1,0 +1,24 @@
+"""TCP: vectorized userspace TCP state machine.
+
+Stub for now -- the engine calls these three hooks each micro-step; the
+full masked-SoA implementation of the reference's TCP
+(/root/reference/src/main/host/descriptor/tcp.c) lands with the transport
+milestone.
+"""
+
+from __future__ import annotations
+
+
+def process_arrivals(state, params, em, tick_t, slot, mask):
+    """Handle inbound TCP segments selected by the engine (<=1 per host)."""
+    return state, em
+
+
+def run_timers(state, params, em, tick_t, active):
+    """Expire RTO / delayed-ACK / TIME_WAIT timers."""
+    return state, em
+
+
+def transmit(state, params, em, tick_t, active):
+    """Emit new data segments permitted by cwnd/rwnd."""
+    return state, em

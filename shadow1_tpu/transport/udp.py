@@ -1,0 +1,128 @@
+"""UDP: connectionless datagram sockets over the socket table.
+
+The reference implements UDP as a thin vtable over its Socket base with
+FIFO packet queues (/root/reference/src/main/host/descriptor/udp.c:26-30)
+and binds sockets into a per-interface (proto, port, peerIP, peerPort) map
+with specific-before-wildcard lookup
+(network_interface.c:255-308,375-419).  Here both the socket and the
+binding map are rows of the dense SocketTable: "lookup" is a vectorized
+match over the S slot axis, preferring a connected (peer-matching) socket
+over a wildcard bind, lowest slot index breaking ties.
+
+Received datagrams land in a small per-socket ring (`udp_*` fields) that
+the application layer consumes; ring overflow drops the datagram like the
+reference's bounded input buffer would.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import state as st
+from ..core.state import I32, I64, U32, UDP_RING
+
+
+def open_bind(socks: st.SocketTable, host: int, slot: int, port: int,
+              peer_host: int = -1, peer_port: int = 0) -> st.SocketTable:
+    """Host-side (setup time) socket creation: bind a UDP socket in `slot`."""
+    return socks.replace(
+        stype=socks.stype.at[host, slot].set(st.SOCK_UDP),
+        local_port=socks.local_port.at[host, slot].set(port),
+        peer_host=socks.peer_host.at[host, slot].set(peer_host),
+        peer_port=socks.peer_port.at[host, slot].set(peer_port),
+    )
+
+
+def open_bind_all(socks: st.SocketTable, slot: int, port: int) -> st.SocketTable:
+    """Bind a wildcard UDP socket in `slot` on every host at once."""
+    return socks.replace(
+        stype=socks.stype.at[:, slot].set(st.SOCK_UDP),
+        local_port=socks.local_port.at[:, slot].set(port),
+        peer_host=socks.peer_host.at[:, slot].set(-1),
+        peer_port=socks.peer_port.at[:, slot].set(0),
+    )
+
+
+def lookup_socket(socks: st.SocketTable, mask, src, sport, dport):
+    """[H]-vectorized bound-socket lookup for an inbound datagram.
+
+    Returns [H] i32 socket slot, -1 if no match.  Specific (connected)
+    match beats wildcard; lowest slot wins ties — the deterministic analog
+    of the reference's two-pass hashtable probe
+    (network_interface.c:375-419).
+    """
+    is_udp = socks.stype == st.SOCK_UDP
+    port_ok = socks.local_port == dport[:, None]
+    wildcard = socks.peer_host == -1
+    specific = (socks.peer_host == src[:, None]) & (socks.peer_port == sport[:, None])
+    score = jnp.where(is_udp & port_ok & specific, 2,
+                      jnp.where(is_udp & port_ok & wildcard, 1, 0))
+    best = jnp.max(score, axis=1)
+    # lowest slot among those achieving best score
+    slot_ids = jnp.arange(socks.slots, dtype=I32)[None, :]
+    cand = jnp.where(score == best[:, None], slot_ids, socks.slots)
+    slot = jnp.min(cand, axis=1).astype(I32)
+    ok = mask & (best > 0)
+    return jnp.where(ok, slot, -1)
+
+
+def push_ring(socks: st.SocketTable, host_mask, slot, src, sport, length,
+              payload_id):
+    """Append a datagram to each masked host's socket ring. Returns
+    (socks, dropped_mask)."""
+    h = socks.num_hosts
+    rows = jnp.arange(h)
+    safe_slot = jnp.clip(slot, 0, socks.slots - 1)
+    count = socks.udp_count[rows, safe_slot]
+    full = count >= UDP_RING
+    do = host_mask & (slot >= 0) & ~full
+    pos = (socks.udp_head[rows, safe_slot] + count) % UDP_RING
+
+    def scatter(arr, val, dtype):
+        return arr.at[rows, safe_slot, pos].set(
+            jnp.where(do, jnp.asarray(val).astype(dtype), arr[rows, safe_slot, pos]))
+
+    return socks.replace(
+        udp_src=scatter(socks.udp_src, src, I32),
+        udp_sport=scatter(socks.udp_sport, sport, I32),
+        udp_len=scatter(socks.udp_len, length, I32),
+        udp_payload=scatter(socks.udp_payload, payload_id, I32),
+        udp_count=socks.udp_count.at[rows, safe_slot].add(
+            jnp.where(do, 1, 0).astype(I32)),
+        bytes_recv=socks.bytes_recv.at[rows, safe_slot].add(
+            jnp.where(do, length, 0).astype(I64)),
+    ), (host_mask & (slot >= 0) & full)
+
+
+def pop_ring(socks: st.SocketTable, host_mask, slot):
+    """Pop the oldest datagram from each masked host's socket ring.
+
+    Returns (socks, got_mask, src, sport, length, payload_id)."""
+    h = socks.num_hosts
+    rows = jnp.arange(h)
+    safe_slot = jnp.clip(slot, 0, socks.slots - 1)
+    count = socks.udp_count[rows, safe_slot]
+    got = host_mask & (slot >= 0) & (count > 0)
+    head = socks.udp_head[rows, safe_slot]
+    src = socks.udp_src[rows, safe_slot, head]
+    sport = socks.udp_sport[rows, safe_slot, head]
+    length = socks.udp_len[rows, safe_slot, head]
+    payload = socks.udp_payload[rows, safe_slot, head]
+    socks = socks.replace(
+        udp_head=socks.udp_head.at[rows, safe_slot].set(
+            jnp.where(got, (head + 1) % UDP_RING, head)),
+        udp_count=socks.udp_count.at[rows, safe_slot].add(
+            jnp.where(got, -1, 0).astype(I32)),
+    )
+    return socks, got, src, sport, length, payload
+
+
+def deliver(socks: st.SocketTable, host_mask, src, sport, dport, length,
+            payload_id):
+    """Deliver one inbound datagram per masked host. Returns
+    (socks, accepted_mask)."""
+    slot = lookup_socket(socks, host_mask, src, sport, dport)
+    socks, dropped_full = push_ring(socks, host_mask, slot, src, sport,
+                                    length, payload_id)
+    accepted = host_mask & (slot >= 0) & ~dropped_full
+    return socks, accepted

@@ -1,0 +1,20 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip hardware is not available in CI; shardings are validated on a
+virtual 8-device CPU mesh exactly as the driver's dryrun does.  Must run
+before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+# Force CPU: the ambient environment exports JAX_PLATFORMS=axon (the real
+# TPU tunnel), which tests must never touch.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,65 @@
+"""Core type tests: time, rng determinism, state construction."""
+
+import jax
+import jax.numpy as jnp
+
+from shadow1_tpu.core import rng, simtime, state
+from shadow1_tpu.core.params import make_net_params
+
+
+def test_x64_enabled():
+    assert jnp.asarray(1, jnp.int64).dtype == jnp.int64
+
+
+def test_simtime_constants():
+    assert simtime.SIMTIME_ONE_SECOND == 10**9
+    assert simtime.from_seconds(2.5) == 2_500_000_000
+    assert simtime.SIMTIME_INVALID > simtime.SIMTIME_MAX
+    # Emulated clock starts at Jan 1 2000.
+    assert int(simtime.emulated_time(0)) == 946_684_800 * 10**9
+
+
+def test_rng_keyed_draws_are_order_independent():
+    key = rng.purpose_key(rng.root_key(42), rng.PURPOSE_PACKET_DROP)
+    # Scalar draw == the same draw inside a batch, any batch order.
+    a = rng.keyed_uniform(key, 7, 1234)
+    batch = rng.keyed_uniform(key, jnp.arange(10), jnp.full(10, 1234))
+    assert float(a) == float(batch[7])
+    perm = rng.keyed_uniform(key, jnp.arange(10)[::-1], jnp.full(10, 1234))
+    assert float(perm[2]) == float(batch[7])
+
+
+def test_rng_purpose_decorrelates():
+    k1 = rng.purpose_key(rng.root_key(42), rng.PURPOSE_PACKET_DROP)
+    k2 = rng.purpose_key(rng.root_key(42), rng.PURPOSE_HOST_APP)
+    assert float(rng.keyed_uniform(k1, 1)) != float(rng.keyed_uniform(k2, 1))
+
+
+def test_state_construction_shapes():
+    s = state.make_sim_state(num_hosts=4, sock_slots=8, pool_capacity=64)
+    assert s.pool.capacity == 64
+    assert s.socks.num_hosts == 4 and s.socks.slots == 8
+    assert s.hosts.num_hosts == 4
+    assert s.pool.time.dtype == jnp.int64
+    assert bool(jnp.all(s.pool.stage == state.STAGE_FREE))
+    # State is a pytree: flatten/unflatten roundtrip (checkpointability).
+    leaves, treedef = jax.tree_util.tree_flatten(s)
+    s2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert s2.socks.slots == 8
+
+
+def test_net_params_min_latency():
+    lat = jnp.array(
+        [[0, 5_000_000, 30_000_000],
+         [5_000_000, 0, 10_000_000],
+         [30_000_000, 10_000_000, 0]]
+    )
+    p = make_net_params(
+        latency_ns=lat,
+        reliability=jnp.ones((3, 3)),
+        host_vertex=jnp.array([0, 1, 2, 0]),
+        bw_up_Bps=jnp.full(4, 1_000_000),
+        bw_down_Bps=jnp.full(4, 1_000_000),
+    )
+    assert int(p.min_latency_ns) == 5_000_000
+    assert int(p.pair_latency(0, 2)) == 30_000_000

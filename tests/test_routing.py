@@ -1,0 +1,95 @@
+"""Routing layer tests: GraphML ingest, APSP, attachment ladder."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow1_tpu.routing import apsp, graphml
+
+SIMPLE = """<?xml version="1.0" encoding="utf-8"?>
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d6" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d5" />
+  <key attr.name="packetloss" attr.type="double" for="node" id="d4" />
+  <key attr.name="countrycode" attr.type="string" for="node" id="d3" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d2" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d1" />
+  <key attr.name="ip" attr.type="string" for="node" id="d0" />
+  <key attr.name="type" attr.type="string" for="node" id="d7" />
+  <graph edgedefault="undirected">
+    <node id="a"><data key="d0">10.0.0.1</data><data key="d1">1000</data>
+      <data key="d2">1000</data><data key="d3">US</data>
+      <data key="d4">0.0</data><data key="d7">client</data></node>
+    <node id="b"><data key="d0">0.0.0.0</data><data key="d1">2000</data>
+      <data key="d2">2000</data><data key="d3">US</data>
+      <data key="d4">0.1</data><data key="d7">relay</data></node>
+    <node id="c"><data key="d0">0.0.0.0</data><data key="d1">3000</data>
+      <data key="d2">3000</data><data key="d3">DE</data>
+      <data key="d4">0.0</data><data key="d7">relay</data></node>
+    <edge source="a" target="b"><data key="d5">10.0</data><data key="d6">0.0</data></edge>
+    <edge source="b" target="c"><data key="d5">20.0</data><data key="d6">0.0</data></edge>
+    <edge source="a" target="c"><data key="d5">100.0</data><data key="d6">0.0</data></edge>
+    <edge source="a" target="a"><data key="d5">0.5</data><data key="d6">0.0</data></edge>
+  </graph>
+</graphml>"""
+
+
+def test_load_and_apsp_shortest_path():
+    topo = graphml.load(SIMPLE)
+    assert topo.num_vertices == 3
+    assert topo.bw_up_KiBps.tolist() == [1000, 2000, 3000]
+    lat_ns, rel = apsp.build_matrices(
+        jnp.asarray(topo.lat_ms), jnp.asarray(topo.edge_rel),
+        jnp.asarray(topo.self_lat_ms), jnp.asarray(topo.self_rel))
+    # a->c goes via b (10+20=30ms), beating the direct 100ms edge.
+    assert int(lat_ns[0, 2]) == 30_000_000
+    assert int(lat_ns[0, 1]) == 10_000_000
+    # Vertex packetloss at b folds into edges entering b.
+    np.testing.assert_allclose(float(rel[0, 1]), 0.9, rtol=1e-6)
+    # a->c reliability: through b: (1-0)*(1-0.1 at b) * 1.0 into c = 0.9.
+    np.testing.assert_allclose(float(rel[0, 2]), 0.9, rtol=1e-6)
+    # Explicit self-loop on a: 0.5ms, not doubled-nearest (2*10ms).
+    assert int(lat_ns[0, 0]) == 500_000
+    # No self-loop on b: doubled min incident edge = 2*10ms.
+    assert int(lat_ns[1, 1]) == 20_000_000
+
+
+def test_multi_edge_keeps_fastest_edge_attributes():
+    xml = SIMPLE.replace(
+        '<edge source="a" target="b"><data key="d5">10.0</data><data key="d6">0.0</data></edge>',
+        '<edge source="a" target="b"><data key="d5">10.0</data><data key="d6">0.0</data></edge>'
+        '<edge source="a" target="b"><data key="d5">5.0</data><data key="d6">0.5</data></edge>')
+    topo = graphml.load(xml)
+    # The 5ms/50%-loss edge wins (lower latency) and brings ITS loss.
+    assert float(topo.lat_ms[0, 1]) == 5.0
+    np.testing.assert_allclose(float(topo.edge_rel[0, 1]), 0.5 * 0.9, rtol=1e-6)
+
+
+def test_attach_ladder():
+    topo = graphml.load(SIMPLE)
+    rng = np.random.default_rng(0)
+    # iphint exact match wins outright.
+    assert graphml.attach(topo, {"iphint": "10.0.0.1"}, rng) == 0
+    # country + type narrows to vertex b.
+    assert graphml.attach(topo, {"countrycodehint": "US",
+                                 "typehint": "relay"}, rng) == 1
+    # unmatched hint is skipped, later hints still apply.
+    assert graphml.attach(topo, {"citycodehint": "NOPE",
+                                 "countrycodehint": "DE"}, rng) == 2
+    # attach_all is deterministic in the seed, independent of host order.
+    hints = [{"typehint": "relay"} for _ in range(6)]
+    a1 = graphml.attach_all(topo, hints, seed=42)
+    a2 = graphml.attach_all(topo, hints, seed=42)
+    assert (a1 == a2).all()
+    assert set(a1.tolist()) <= {1, 2}
+
+
+def test_unreachable_pair_not_routable():
+    xml = SIMPLE.replace(
+        '<edge source="b" target="c"><data key="d5">20.0</data><data key="d6">0.0</data></edge>', ''
+    ).replace(
+        '<edge source="a" target="c"><data key="d5">100.0</data><data key="d6">0.0</data></edge>', '')
+    topo = graphml.load(xml)
+    lat_ns, rel = apsp.build_matrices(jnp.asarray(topo.lat_ms),
+                                      jnp.asarray(topo.edge_rel))
+    routable = apsp.is_routable(lat_ns)
+    assert bool(routable[0, 1]) and not bool(routable[0, 2])
