@@ -231,6 +231,7 @@ class SocketTable:
     fin_seq: jnp.ndarray      # [H,S] u32 peer FIN sequence, 0 = none seen
 
     # --- timers & RTT (reference tcp.c:175-220) ---
+    ts_recent: jnp.ndarray    # [H,S] i64 last in-window segment timestamp (TS.recent)
     srtt: jnp.ndarray         # [H,S] i64 ns, 0 = no sample yet
     rttvar: jnp.ndarray       # [H,S] i64 ns
     rto: jnp.ndarray          # [H,S] i64 ns
@@ -290,6 +291,7 @@ def make_socket_table(num_hosts: int, slots: int) -> SocketTable:
         rcv_buf_cap=_zeros(hs, I32),
         ooo_mask=_zeros(hs + (OOO_WORDS,), U32),
         fin_seq=_zeros(hs, U32),
+        ts_recent=_zeros(hs, I64),
         srtt=_zeros(hs, I64),
         rttvar=_zeros(hs, I64),
         rto=_zeros(hs, I64),

@@ -1,0 +1,24 @@
+"""Multi-chip scale-out: shard the host axis over a device mesh.
+
+The reference parallelizes by partitioning hosts across worker pthreads
+with locked per-host queues and barrier rounds
+(/root/reference/src/main/core/scheduler/scheduler.c:359-414) and
+exchanges cross-host packets through those locked queues
+(worker.c:243-304).  The TPU-native equivalent shards every
+leading-`hosts`-axis array of the simulation state over a
+`jax.sharding.Mesh`, keeps the packet pool sharded over its own axis, and
+lets XLA/GSPMD insert the ICI collectives that realize the inter-host
+packet exchange and the min-next-event reduction (the analog of the
+master's window advance, master.c:450-480).
+"""
+
+from .sharding import (HOST_AXIS, make_mesh, shard_params, shard_state,
+                       sharded_run_until)
+
+__all__ = [
+    "HOST_AXIS",
+    "make_mesh",
+    "shard_params",
+    "shard_state",
+    "sharded_run_until",
+]
