@@ -1,0 +1,138 @@
+"""Bulk transfer: a modeled tgen-style file-transfer application.
+
+The reference's bring-up workload is a 2-host tgen file transfer
+(/root/reference/resource/examples/shadow.config.xml with
+tgen.client.graphml.xml / tgen.server.graphml.xml): clients open TCP
+connections to a server and move a configured number of bytes, and the
+transfer completion time is the headline observable.  Here the application
+is an on-device model: per-host role/size/start arrays, with connect /
+write / close driven through the vectorized TCP API each engine tick.
+
+Per-host config lives in `BulkState` (a pytree, so it shards with the
+hosts axis):
+
+* `is_client` [H] bool  -- this host actively transfers
+* `dst`       [H] i32   -- server host index
+* `total`     [H] i64   -- bytes to send
+* `start_t`   [H] i64   -- connection start time
+
+Observables: `finish_t` (time the client's FIN was acknowledged, i.e. all
+bytes delivered and the close handshake completed through FIN-ACK) and the
+socket byte counters.
+"""
+
+from __future__ import annotations
+
+from flax import struct
+import jax.numpy as jnp
+
+from ..core import simtime
+from ..core.state import (I32, I64, U32, SOCK_TCP, TCPS_CLOSED,
+                          TCPS_CLOSEWAIT, TCPS_FINWAIT2, TCPS_TIMEWAIT)
+from ..transport import tcp
+
+SERVER_PORT = 80
+CLIENT_PORT = 40000
+LISTEN_SLOT = 0
+CLIENT_SLOT = 1
+
+INV = simtime.SIMTIME_INVALID
+
+
+@struct.dataclass
+class BulkState:
+    is_client: jnp.ndarray   # [H] bool
+    dst: jnp.ndarray         # [H] i32
+    total: jnp.ndarray       # [H] i64
+    start_t: jnp.ndarray     # [H] i64
+    phase: jnp.ndarray       # [H] i32 0=idle 1=running 2=done
+    finish_t: jnp.ndarray    # [H] i64 completion time, INV until done
+
+
+class Bulk:
+    """Static app config (hashable: jitted engine calls cache per config)."""
+
+    def __init__(self, server_port: int = SERVER_PORT,
+                 client_slot: int = CLIENT_SLOT):
+        self.server_port = int(server_port)
+        self.client_slot = int(client_slot)
+
+    def __hash__(self):
+        return hash(("bulk", self.server_port, self.client_slot))
+
+    def __eq__(self, other):
+        return (isinstance(other, Bulk)
+                and other.server_port == self.server_port
+                and other.client_slot == self.client_slot)
+
+    # -- engine hooks -------------------------------------------------------
+
+    def next_time(self, state):
+        a = state.app
+        return jnp.where(a.is_client & (a.phase == 0), a.start_t,
+                         jnp.asarray(INV, I64))
+
+    def on_tick(self, state, params, em, tick_t, active):
+        a = state.app
+        socks = state.socks
+        h = a.phase.shape[0]
+        slot = jnp.full((h,), self.client_slot, I32)
+
+        # 1. Start due clients: active open to (dst, server_port).
+        starting = active & a.is_client & (a.phase == 0) & \
+            (a.start_t <= tick_t)
+        socks = tcp.connect_v(socks, starting, slot, a.dst,
+                              self.server_port, CLIENT_PORT, tick_t)
+        a = a.replace(phase=jnp.where(starting, 1, a.phase))
+
+        # 2. Running clients: stream bytes into the send buffer, then close.
+        running = active & a.is_client & (a.phase == 1)
+        target_end = (jnp.uint32(1) + a.total.astype(U32))
+        socks = tcp.write_v(socks, running, slot, target_end)
+        rows = jnp.arange(h)
+        sslot = jnp.clip(slot, 0, socks.slots - 1)
+        all_written = socks.snd_end[rows, sslot] == target_end
+        socks = tcp.close_v(socks, running & all_written, slot)
+
+        # 3. Completion: the client's FIN has been ACKed, which requires
+        # every byte to be delivered first (snd_una == stream end + FIN).
+        # A socket torn down by RST/timeout has error != 0 and moves to
+        # phase 3 (failed) instead -- never counted as success.
+        cstate = socks.tcp_state[rows, sslot]
+        closed = (cstate == TCPS_FINWAIT2) | (cstate == TCPS_TIMEWAIT) | \
+            (cstate == TCPS_CLOSED)
+        all_acked = socks.snd_una[rows, sslot] == \
+            (target_end + jnp.uint32(1))
+        failed = running & (socks.error[rows, sslot] != 0)
+        done = running & closed & all_acked & ~failed
+        a = a.replace(
+            phase=jnp.where(done, 2, jnp.where(failed, 3, a.phase)),
+            finish_t=jnp.where(done, tick_t, a.finish_t),
+        )
+
+        # 4. Sink policy on every host: consume all received bytes (keeps
+        # the advertised window open) and close-when-peer-closed.
+        socks = tcp.consume_all(socks)
+        socks = socks.replace(app_closed=jnp.where(
+            (socks.stype == SOCK_TCP) & (socks.tcp_state == TCPS_CLOSEWAIT),
+            True, socks.app_closed))
+
+        return state.replace(app=a, socks=socks), em
+
+
+def init_state(num_hosts: int, is_client, dst, total_bytes, start_t):
+    return BulkState(
+        is_client=jnp.asarray(is_client, bool),
+        dst=jnp.asarray(dst, I32),
+        total=jnp.asarray(total_bytes, I64),
+        start_t=jnp.asarray(start_t, I64),
+        phase=jnp.zeros((num_hosts,), I32),
+        finish_t=jnp.full((num_hosts,), INV, I64),
+    )
+
+
+def setup_servers(socks, is_server, port: int = SERVER_PORT,
+                  slot: int = LISTEN_SLOT):
+    """Install TCP listeners on server hosts (setup time)."""
+    return tcp.listen_v(socks, jnp.asarray(is_server, bool),
+                        jnp.full((socks.num_hosts,), slot, I32), port)

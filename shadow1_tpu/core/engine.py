@@ -42,13 +42,16 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from . import emit, rng, simtime
+from . import emit, nic, rng, simtime
 # Reliability-dropped packets are never materialized in the pool (they are
 # counted in HostTable.pkts_dropped_inet instead), so PDS_INET_DROPPED is
 # deliberately absent here.
 from .state import (ERR_POOL_OVERFLOW, I32, I64, PROTO_TCP, PROTO_UDP,
-                    STAGE_FREE, STAGE_IN_FLIGHT,
-                    PDS_INET_SENT, PDS_RCV_SOCKET_PROCESSED, SimState)
+                    STAGE_FREE, STAGE_IN_FLIGHT, STAGE_RX_QUEUED,
+                    STAGE_TX_QUEUED, TCP_HEADER_SIZE, UDP_HEADER_SIZE,
+                    PDS_INET_SENT, PDS_RCV_SOCKET_PROCESSED,
+                    PDS_ROUTER_DROPPED, PDS_ROUTER_ENQUEUED,
+                    PDS_SND_CREATED, PDS_SND_INTERFACE_SENT, SimState)
 
 INV = simtime.SIMTIME_INVALID
 
@@ -86,33 +89,116 @@ def next_times(state: SimState, params, app):
 
 
 # ---------------------------------------------------------------------------
-# Phase A: arrival selection + delivery
+# Phase A: router enqueue -> NIC receive (token bucket + CoDel) -> delivery
 # ---------------------------------------------------------------------------
 
 
-def _select_arrivals(state: SimState, tick_t, active):
-    """Pick per host the earliest due in-flight packet (deterministic by
-    (time, pkt_id)).
+def _wire_bytes(proto, length):
+    """On-the-wire size charged against token buckets (payload + header;
+    reference packet_getTotalSize with CONFIG_HEADER_SIZE_*)."""
+    return length + jnp.where(proto == PROTO_TCP, TCP_HEADER_SIZE,
+                              UDP_HEADER_SIZE)
+
+
+def _select_queued(pool, seg, stage, tick_t, active, h):
+    """Pick per host the earliest due packet in `stage`, deterministic by
+    (time, pkt_id); `seg` is the owning-host axis (dst for RX, src for TX).
 
     Returns ([H] pool index or -1, [P] chosen mask).  The mask is what pool
     updates must use: indexing the pool by the clipped per-host slot would
     produce duplicate-index scatters whose write order is undefined.
     """
-    pool = state.pool
-    h = state.hosts.num_hosts
     p = pool.capacity
-
-    due = (pool.stage == STAGE_IN_FLIGHT) & (pool.time <= tick_t[pool.dst]) \
-        & active[pool.dst]
-    tmin = _seg_min(pool.time, pool.dst, h, due)
-    at_min = due & (pool.time == tmin[pool.dst])
-    idmin = _seg_min(pool.pkt_id, pool.dst, h, at_min)
-    chosen = at_min & (pool.pkt_id == idmin[pool.dst])
-    # Scatter pool index to the destination host (<=1 chosen per host;
+    due = (pool.stage == stage) & (pool.time <= tick_t[seg]) & active[seg]
+    tmin = _seg_min(pool.time, seg, h, due)
+    at_min = due & (pool.time == tmin[seg])
+    idmin = _seg_min(pool.pkt_id, seg, h, at_min)
+    chosen = at_min & (pool.pkt_id == idmin[seg])
+    # Scatter pool index to the owning host (<=1 chosen per host;
     # .max makes the -1 fillers harmless regardless of write order).
     idx = jnp.where(chosen, jnp.arange(p, dtype=I32), -1)
-    slot_of_host = jnp.full((h,), -1, I32).at[pool.dst].max(idx)
+    slot_of_host = jnp.full((h,), -1, I32).at[seg].max(idx)
     return slot_of_host, chosen
+
+
+def _router_enqueue(state: SimState, tick_t, active):
+    """Move due in-flight packets into the destination's upstream-router
+    queue (reference _worker_runDeliverPacketTask -> router_enqueue,
+    worker.c:236-241, router.c:104-123).  Purely a stage tag flip; `time`
+    keeps the wire-arrival instant so CoDel can compute sojourn."""
+    pool, hosts = state.pool, state.hosts
+    h = hosts.num_hosts
+    due = (pool.stage == STAGE_IN_FLIGHT) & (pool.time <= tick_t[pool.dst]) \
+        & active[pool.dst]
+    pool = pool.replace(
+        stage=jnp.where(due, STAGE_RX_QUEUED, pool.stage),
+        status=jnp.where(due, pool.status | PDS_ROUTER_ENQUEUED, pool.status),
+    )
+    counts = jax.ops.segment_sum(jnp.where(due, 1, 0), pool.dst,
+                                 num_segments=h)
+    hosts = hosts.replace(rx_queued=hosts.rx_queued + counts.astype(I32))
+    return state.replace(pool=pool, hosts=hosts)
+
+
+def _rx_drain(state: SimState, params, tick_t, active):
+    """NIC receive: drain one packet per host from the router queue,
+    gated by the downstream token bucket and the CoDel drop law
+    (reference networkinterface_receivePackets, network_interface.c:421-455
+    + router_queue_codel.c).  Returns (state, slot_of_host, chosen_deliver)
+    for the transport layer."""
+    pool, hosts = state.pool, state.hosts
+    h = hosts.num_hosts
+
+    slot_of_host, chosen = _select_queued(pool, pool.dst, STAGE_RX_QUEUED,
+                                          tick_t, active, h)
+    have = slot_of_host >= 0
+    slot = jnp.clip(slot_of_host, 0, pool.capacity - 1)
+
+    tokens, last = nic.refill(hosts.tokens_rx, hosts.last_refill_rx,
+                              params.bw_down_Bps, tick_t, active)
+    size = _wire_bytes(pool.proto[slot], pool.length[slot]).astype(I64) \
+        * nic.SCALE
+    loop = pool.src[slot] == pool.dst[slot]
+    boot = tick_t < params.bootstrap_end
+    free_pass = loop | boot
+    funded = have & (free_pass | (tokens >= size))
+
+    # CoDel decision for funded, non-loopback dequeues.
+    sojourn = tick_t - pool.time[slot]
+    backlog_after = hosts.rx_queued - 1
+    hosts, drop = nic.codel_dequeue(hosts, funded & ~loop, tick_t, sojourn,
+                                    backlog_after)
+    deliver = funded & ~drop
+
+    # Charge the bucket for everything dequeued (delivered or dropped).
+    tokens = tokens - jnp.where(funded & ~free_pass, size, 0)
+    hosts = hosts.replace(tokens_rx=tokens, last_refill_rx=last)
+
+    # Dropped packets leave the pool.
+    chosen_drop = chosen & drop[pool.dst]
+    pool = pool.replace(
+        stage=jnp.where(chosen_drop, STAGE_FREE, pool.stage),
+        status=jnp.where(chosen_drop, pool.status | PDS_ROUTER_DROPPED,
+                         pool.status),
+    )
+    hosts = hosts.replace(
+        rx_queued=hosts.rx_queued - jnp.where(funded, 1, 0).astype(I32),
+        pkts_dropped_router=hosts.pkts_dropped_router +
+        jnp.where(drop, 1, 0),
+    )
+
+    # Wake-ups: backlog remains -> re-tick now; starved -> when tokens
+    # accrue for this packet.
+    t_tok = tick_t + nic.time_until(size - tokens, params.bw_down_Bps)
+    t_res = jnp.where(
+        have & ~funded, t_tok,
+        jnp.where(funded & (hosts.rx_queued > 0), tick_t,
+                  jnp.asarray(INV, I64)))
+    hosts = hosts.replace(t_resume=jnp.minimum(hosts.t_resume, t_res))
+
+    state = state.replace(pool=pool, hosts=hosts)
+    slot_deliver = jnp.where(deliver, slot_of_host, -1)
+    return state, slot_deliver, chosen & deliver[pool.dst]
 
 
 def _deliver(state: SimState, params, em, tick_t, pool_slot, chosen, app):
@@ -160,15 +246,19 @@ def _deliver(state: SimState, params, em, tick_t, pool_slot, chosen, app):
 # ---------------------------------------------------------------------------
 
 
-def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t):
+def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
+                     active):
     """Assign pkt_ids, apply routing latency + reliability drops, and
-    scatter staged emissions into free pool slots.
+    scatter staged emissions into free pool slots -- direct to IN_FLIGHT
+    when the tx token bucket covers them, else parked in TX_QUEUED.
 
-    The reference equivalent is worker_sendPacket
-    (/root/reference/src/main/core/worker.c:243-304): reliability draw,
-    latency lookup, push event to the destination host queue.  Loopback
-    bypasses the topology with a 1ns delay like the reference's local path
-    (network_interface.c:548-555).
+    The reference equivalent is the interface send path + worker_sendPacket
+    (/root/reference/src/main/host/network_interface.c:466-540,
+    src/main/core/worker.c:243-304): qdisc select under token budget,
+    reliability draw, latency lookup, push event to the destination host
+    queue.  Loopback bypasses the NIC with a 1ns delay like the
+    reference's local path (network_interface.c:548-555); the bootstrap
+    period bypasses bandwidth (network_interface.c:432-434,522).
     """
     pool, hosts = state.pool, state.hosts
     h, e = em.valid.shape
@@ -214,6 +304,34 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t):
     send_t = jnp.broadcast_to(tick_t[:, None], (h, e)).reshape(-1)
     arr_t = send_t + lat.reshape(-1)
 
+    # --- NIC tx admission: direct-admit under the token budget, else park
+    # in TX_QUEUED for _tx_drain (FIFO is preserved because any backlog
+    # forces parking).
+    tokens, last = nic.refill(hosts.tokens_tx, hosts.last_refill_tx,
+                              params.bw_up_Bps, tick_t, active)
+    sizes = _wire_bytes(em.proto, em.length).astype(I64) * nic.SCALE
+    nonloop_live = live & ~loop
+    sizes_nl = jnp.where(nonloop_live, sizes, 0)
+    prefix = jnp.cumsum(sizes_nl, axis=1)
+    boot2 = (tick_t < params.bootstrap_end)[:, None]
+    ok_budget = (hosts.tx_queued == 0)[:, None] & (prefix <= tokens[:, None])
+    admit = live & (loop | boot2 | ok_budget)
+    spent = jnp.sum(jnp.where(admit & ~loop & ~boot2, sizes, 0), axis=1)
+    tokens = tokens - spent
+    admitf = admit.reshape(-1)
+    parked = live & ~admit
+    hosts = hosts.replace(
+        tokens_tx=tokens, last_refill_tx=last,
+        tx_queued=hosts.tx_queued +
+        jnp.sum(parked, axis=1).astype(I32))
+
+    stage_v = jnp.where(admitf, STAGE_IN_FLIGHT, STAGE_TX_QUEUED)
+    time_v = jnp.where(admitf, arr_t, send_t)
+    status_v = jnp.where(
+        admitf,
+        PDS_SND_CREATED | PDS_SND_INTERFACE_SENT | PDS_INET_SENT,
+        PDS_SND_CREATED)
+
     def sc(a, val, dtype=None):
         v = val.reshape(-1) if hasattr(val, "reshape") else val
         if dtype is not None:
@@ -221,7 +339,7 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t):
         return a.at[slot].set(v, mode="drop")
 
     pool = pool.replace(
-        stage=sc(pool.stage, jnp.full((h * e,), STAGE_IN_FLIGHT, I32)),
+        stage=sc(pool.stage, stage_v),
         src=sc(pool.src, src2),
         dst=sc(pool.dst, em.dst),
         sport=sc(pool.sport, em.sport),
@@ -232,13 +350,13 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t):
         ack=sc(pool.ack, em.ack),
         wnd=sc(pool.wnd, em.wnd),
         length=sc(pool.length, em.length),
-        time=sc(pool.time, arr_t),
+        time=sc(pool.time, time_v),
         pkt_id=sc(pool.pkt_id, pkt_id2),
         ts=sc(pool.ts, send_t),
         ts_echo=sc(pool.ts_echo, em.ts_echo),
         payload_id=sc(pool.payload_id, em.payload_id),
         priority=sc(pool.priority, em.priority),
-        status=sc(pool.status, jnp.full((h * e,), PDS_INET_SENT, I32)),
+        status=sc(pool.status, status_v),
     )
 
     sent_bytes = jnp.sum(jnp.where(live, em.length, 0), axis=1).astype(I64)
@@ -250,6 +368,55 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t):
     )
     err = state.err | jnp.where(overflow, ERR_POOL_OVERFLOW, 0).astype(jnp.int32)
     return state.replace(pool=pool, hosts=hosts, err=err)
+
+
+def _tx_drain(state: SimState, params, tick_t, active):
+    """Drain one parked TX_QUEUED packet per host onto the wire, gated by
+    the upstream token bucket (reference _networkinterface_sendPackets,
+    network_interface.c:519-561: dequeue under token budget, then
+    router_forward -> worker_sendPacket)."""
+    pool, hosts = state.pool, state.hosts
+    h = hosts.num_hosts
+
+    slot_of_host, chosen = _select_queued(pool, pool.src, STAGE_TX_QUEUED,
+                                          tick_t, active, h)
+    have = slot_of_host >= 0
+    slot = jnp.clip(slot_of_host, 0, pool.capacity - 1)
+
+    tokens, last = nic.refill(hosts.tokens_tx, hosts.last_refill_tx,
+                              params.bw_up_Bps, tick_t, active)
+    size = _wire_bytes(pool.proto[slot], pool.length[slot]).astype(I64) \
+        * nic.SCALE
+    boot = tick_t < params.bootstrap_end
+    funded = have & (boot | (tokens >= size))
+    tokens = tokens - jnp.where(funded & ~boot, size, 0)
+
+    # Departure: arrival = now + path latency (drop draw already happened
+    # at staging, keyed by pkt_id, so loss is independent of queueing).
+    nv = params.host_vertex.shape[0]
+    vs = params.host_vertex[jnp.clip(pool.src[slot], 0, h - 1)]
+    vd = params.host_vertex[jnp.clip(pool.dst[slot], 0, nv - 1)]
+    arr = tick_t + params.latency_ns[vs, vd]
+    chosen_dep = chosen & funded[pool.src]
+    pool = pool.replace(
+        stage=jnp.where(chosen_dep, STAGE_IN_FLIGHT, pool.stage),
+        time=jnp.where(chosen_dep, arr[pool.src], pool.time),
+        status=jnp.where(chosen_dep,
+                         pool.status | PDS_SND_INTERFACE_SENT | PDS_INET_SENT,
+                         pool.status),
+    )
+
+    hosts = hosts.replace(
+        tokens_tx=tokens, last_refill_tx=last,
+        tx_queued=hosts.tx_queued - jnp.where(funded, 1, 0).astype(I32))
+
+    t_tok = tick_t + nic.time_until(size - tokens, params.bw_up_Bps)
+    t_res = jnp.where(
+        have & ~funded, t_tok,
+        jnp.where(funded & (hosts.tx_queued > 0), tick_t,
+                  jnp.asarray(INV, I64)))
+    hosts = hosts.replace(t_resume=jnp.minimum(hosts.t_resume, t_res))
+    return state.replace(pool=pool, hosts=hosts)
 
 
 # ---------------------------------------------------------------------------
@@ -265,14 +432,18 @@ def microstep(state: SimState, params, app, t_h, window_end):
     active = t_h < window_end
     tick_t = jnp.where(active, t_h, window_end)
 
-    # Hosts resume flags are re-armed by this tick's phases.
+    # Active hosts' resume flags are re-armed by this tick's phases;
+    # inactive hosts keep theirs (token-accrual wake-ups must survive).
     state = state.replace(
-        hosts=state.hosts.replace(t_resume=jnp.full((h,), INV, I64)))
+        hosts=state.hosts.replace(t_resume=jnp.where(
+            active, jnp.asarray(INV, I64), state.hosts.t_resume)))
 
     em = emit.empty(h)
 
-    # Phase A: arrivals.
-    pool_slot, chosen = _select_arrivals(state, tick_t, active)
+    # Phase A: wire arrivals -> router queue -> NIC rx (tokens + CoDel)
+    # -> transport delivery.
+    state = _router_enqueue(state, tick_t, active)
+    state, pool_slot, chosen = _rx_drain(state, params, tick_t, active)
     state, em = _deliver(state, params, em, tick_t, pool_slot, chosen, app)
 
     # Phase B: transport timers.
@@ -282,9 +453,11 @@ def microstep(state: SimState, params, app, t_h, window_end):
     if app is not None:
         state, em = app.on_tick(state, params, em, tick_t, active)
 
-    # Phase D: TCP transmission, then flush all staged packets.
+    # Phase D: TCP transmission, flush staged emissions through the NIC tx
+    # bucket (direct-admit or park), then drain parked packets.
     state, em = tcp_mod.transmit(state, params, em, tick_t, active)
-    state = _stage_emissions(state, params, em, tick_t)
+    state = _stage_emissions(state, params, em, tick_t, active)
+    state = _tx_drain(state, params, tick_t, active)
     return state
 
 

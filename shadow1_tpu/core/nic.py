@@ -1,0 +1,109 @@
+"""NIC token buckets and the upstream-router CoDel AQM control law.
+
+The reference gives every host a per-interface pair of token buckets
+refilled every 1ms of sim time by self-scheduled tasks
+(/root/reference/src/main/host/network_interface.c:32-40,93-190), with
+capacity = one refill + MTU (network_interface.c:192-226), and an
+upstream-ISP router whose queue runs CoDel per RFC 8289: target 10ms,
+interval 100ms, drop-next spacing interval/sqrt(count)
+(/root/reference/src/main/routing/router_queue_codel.c:33-56,198-267).
+
+TPU-shaped differences:
+
+* Refill is lazy and continuous: tokens accrue as `(now - last) * rate`
+  in **scaled units of byte-nanoseconds** (1 byte == 1e9 units), so
+  integer accrual is exact with no per-ms events and no rounding drift.
+  The reference's 1ms quantization is a burstier special case; capacity
+  is the same one-interval + MTU.
+* CoDel drops at most one packet per dequeue; the engine re-ticks the
+  host at the same instant to continue draining, which reproduces the
+  reference's dequeue-while-dropping loop across micro-steps.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import simtime
+from .state import I32, I64, MTU
+
+SCALE = 1_000_000_000  # token units per byte (1 byte-second / 1e9 ns)
+
+REFILL_INTERVAL_NS = simtime.SIMTIME_ONE_MILLISECOND
+
+CODEL_TARGET_NS = 10 * simtime.SIMTIME_ONE_MILLISECOND
+CODEL_INTERVAL_NS = 100 * simtime.SIMTIME_ONE_MILLISECOND
+
+
+def bucket_capacity(rate_Bps):
+    """Scaled capacity: one refill interval of line rate plus an MTU."""
+    return rate_Bps * REFILL_INTERVAL_NS + MTU * SCALE
+
+
+def refill(tokens, last, rate_Bps, now, mask):
+    """Lazy continuous refill ([H] scaled tokens).  Returns (tokens, last)
+    updated where mask.  dt is clamped to the bucket fill time so
+    `dt * rate` cannot overflow i64 after long idle periods."""
+    fill_time = REFILL_INTERVAL_NS + (MTU * SCALE) // jnp.maximum(rate_Bps, 1) + 1
+    dt = jnp.clip(now - last, 0, fill_time)
+    accrued = jnp.minimum(bucket_capacity(rate_Bps), tokens + dt * rate_Bps)
+    return (jnp.where(mask, accrued, tokens),
+            jnp.where(mask, now, last))
+
+
+def time_until(deficit_scaled, rate_Bps):
+    """ns until `deficit_scaled` more tokens accrue (ceil)."""
+    r = jnp.maximum(rate_Bps, 1)
+    return (deficit_scaled + r - 1) // r
+
+
+def codel_dequeue(hosts, mask, now, sojourn, backlog_after):
+    """One CoDel dequeue decision per masked host.
+
+    Args: `sojourn` [H] ns the candidate packet spent queued,
+    `backlog_after` [H] i32 packets that would remain after this dequeue.
+    Returns (hosts', drop [H] bool): drop=True means discard the candidate
+    instead of delivering it.  State fields follow RFC 8289 pseudocode /
+    the reference's _codel_* helpers.
+    """
+    count = hosts.codel_count
+    dropping = hosts.codel_dropping
+    fa = hosts.codel_first_above
+    drop_next = hosts.codel_drop_next
+
+    # ok_to_drop: sojourn above target for a full interval, and the queue
+    # is not nearly-empty (reference checks bytes <= MTU; one queued
+    # packet is our analog).
+    below = (sojourn < CODEL_TARGET_NS) | (backlog_after <= 0)
+    fa_new = jnp.where(below, 0,
+                       jnp.where(fa == 0, now + CODEL_INTERVAL_NS, fa))
+    ok = mask & ~below & (fa_new != 0) & (now >= fa_new)
+
+    def spacing(cnt):
+        return (CODEL_INTERVAL_NS /
+                jnp.sqrt(jnp.maximum(cnt, 1).astype(jnp.float32))).astype(I64)
+
+    # In dropping state: leave it if not ok; else drop when due.
+    drop_in = dropping & ok & (now >= drop_next)
+    count_in = count + jnp.where(drop_in, 1, 0)
+    next_in = jnp.where(drop_in, drop_next + spacing(count_in), drop_next)
+
+    # Entering dropping state.
+    recent = (now - drop_next) < (16 * CODEL_INTERVAL_NS)
+    enter = mask & ~dropping & ok
+    count_enter = jnp.where(recent & (count > 2), count - 2, 1)
+    next_enter = now + spacing(count_enter)
+
+    drop = drop_in | enter
+    new_dropping = jnp.where(mask, (dropping & ok) | enter, dropping)
+    new_count = jnp.where(enter, count_enter,
+                          jnp.where(mask & dropping, count_in, count))
+    new_next = jnp.where(enter, next_enter,
+                         jnp.where(mask & dropping, next_in, drop_next))
+    hosts = hosts.replace(
+        codel_first_above=jnp.where(mask, fa_new, fa),
+        codel_dropping=new_dropping,
+        codel_count=new_count.astype(I32),
+        codel_drop_next=new_next.astype(I64),
+    )
+    return hosts, drop

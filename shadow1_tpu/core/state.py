@@ -220,7 +220,11 @@ class SocketTable:
     dup_acks: jnp.ndarray     # [H,S] i32
     recover: jnp.ndarray      # [H,S] u32 fast-recovery high-water mark
     in_recovery: jnp.ndarray  # [H,S] bool
-    retrans_nxt: jnp.ndarray  # [H,S] u32 next seq to retransmit (< snd_nxt when retransmitting)
+    retrans_nxt: jnp.ndarray  # [H,S] u32 retransmission cursor
+    retrans_end: jnp.ndarray  # [H,S] u32 retransmission bound: retx pending
+                              # while retrans_nxt < min(retrans_end, snd_nxt).
+                              # Fast retransmit/partial ACK set a one-segment
+                              # span; RTO sets the full go-back-N window.
     app_closed: jnp.ndarray   # [H,S] bool app called close(); FIN at snd_end
 
     # --- receive side ---
@@ -285,6 +289,7 @@ def make_socket_table(num_hosts: int, slots: int) -> SocketTable:
         recover=_zeros(hs, U32),
         in_recovery=_zeros(hs, jnp.bool_),
         retrans_nxt=_zeros(hs, U32),
+        retrans_end=_zeros(hs, U32),
         app_closed=_zeros(hs, jnp.bool_),
         rcv_nxt=_zeros(hs, U32),
         rcv_read=_zeros(hs, U32),
@@ -321,11 +326,14 @@ class HostTable:
     """[H] per-host state outside the socket table.
 
     Token buckets mirror the reference's per-interface up/down buckets with
-    1ms refill (network_interface.c:93-190); refill is computed lazily from
-    `last_refill` instead of scheduling a refill event per ms per host.
-    NOTE: bandwidth enforcement is NOT yet wired into the engine -- the
-    token fields exist but emissions currently go straight to IN_FLIGHT
-    (the NIC/qdisc/CoDel milestone turns them on).
+    1ms refill (network_interface.c:93-190); refill is computed lazily and
+    continuously from `last_refill` instead of scheduling a refill event
+    per ms per host (smoother than the reference's 1ms quantization;
+    capacity is one refill interval + MTU like network_interface.c:192-226).
+
+    CoDel fields implement the RFC 8289 control law of the reference's
+    upstream-router queue (router_queue_codel.c:33-56,198-267): target
+    sojourn 10ms, interval 100ms, drop-next spacing interval/sqrt(count).
     """
 
     rng_ctr: jnp.ndarray       # [H] u32 per-host app draw counter
@@ -334,7 +342,15 @@ class HostTable:
                                # TCP window not fully transmitted); SIMTIME_INVALID = none
     tokens_tx: jnp.ndarray     # [H] i64 bytes available to transmit
     tokens_rx: jnp.ndarray     # [H] i64 bytes available to receive
-    last_refill: jnp.ndarray   # [H] i64 last lazy-refill timestamp (ms-aligned)
+    last_refill_tx: jnp.ndarray  # [H] i64 last lazy-refill timestamp
+    last_refill_rx: jnp.ndarray  # [H] i64 last lazy-refill timestamp
+    tx_queued: jnp.ndarray     # [H] i32 packets parked in STAGE_TX_QUEUED
+    rx_queued: jnp.ndarray     # [H] i32 packets parked in STAGE_RX_QUEUED
+    # CoDel AQM state (reference router_queue_codel.c).
+    codel_count: jnp.ndarray       # [H] i32 drops in current dropping cycle
+    codel_dropping: jnp.ndarray    # [H] bool in dropping state
+    codel_first_above: jnp.ndarray  # [H] i64 when sojourn first exceeded target
+    codel_drop_next: jnp.ndarray   # [H] i64 next scheduled drop time
     # Tracker counters (reference tracker.c).
     bytes_sent: jnp.ndarray    # [H] i64
     bytes_recv: jnp.ndarray    # [H] i64
@@ -356,7 +372,14 @@ def make_host_table(num_hosts: int) -> HostTable:
         t_resume=_full(h, I64, simtime.SIMTIME_INVALID),
         tokens_tx=_zeros(h, I64),
         tokens_rx=_zeros(h, I64),
-        last_refill=_zeros(h, I64),
+        last_refill_tx=_zeros(h, I64),
+        last_refill_rx=_zeros(h, I64),
+        tx_queued=_zeros(h, I32),
+        rx_queued=_zeros(h, I32),
+        codel_count=_zeros(h, I32),
+        codel_dropping=_zeros(h, jnp.bool_),
+        codel_first_above=_zeros(h, I64),
+        codel_drop_next=_zeros(h, I64),
         bytes_sent=_zeros(h, I64),
         bytes_recv=_zeros(h, I64),
         pkts_sent=_zeros(h, I64),

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from .apps import bulk as bulk_app
 from .apps import phold as phold_app
 from .core import engine, simtime
 from .core.params import make_net_params
@@ -26,17 +27,21 @@ def build_phold(num_hosts: int,
                 stop_time: int = simtime.SIMTIME_ONE_SECOND,
                 seed: int = 1,
                 sock_slots: int = 4,
-                pool_capacity: int = 1 << 14):
+                pool_capacity: int = 1 << 14,
+                bw_up_Bps: int = 1 << 30,
+                bw_down_Bps: int = 1 << 30,
+                bootstrap_end: int = 0):
     """A phold benchmark world on a uniform full-mesh topology."""
     lat, rel = uniform_full_mesh(num_hosts, latency_ns, reliability)
     params = make_net_params(
         latency_ns=lat,
         reliability=rel,
         host_vertex=jnp.arange(num_hosts),
-        bw_up_Bps=jnp.full(num_hosts, 1 << 30),
-        bw_down_Bps=jnp.full(num_hosts, 1 << 30),
+        bw_up_Bps=jnp.full(num_hosts, bw_up_Bps),
+        bw_down_Bps=jnp.full(num_hosts, bw_down_Bps),
         seed=seed,
         stop_time=stop_time,
+        bootstrap_end=bootstrap_end,
     )
     state = make_sim_state(num_hosts, sock_slots=sock_slots,
                            pool_capacity=pool_capacity)
@@ -49,6 +54,49 @@ def build_phold(num_hosts: int,
     app = phold_app.Phold(mean_delay_ns=mean_delay_ns, sock_slot=0)
     state = state.replace(app=phold_app.init_state(
         num_hosts, params, msgs_per_host, mean_delay_ns))
+    return state, params, app
+
+
+def build_bulk(num_hosts: int,
+               server: int = 0,
+               bytes_per_client: int = 1 << 20,
+               latency_ns: int = 10 * simtime.SIMTIME_ONE_MILLISECOND,
+               reliability: float = 1.0,
+               start_time: int = simtime.SIMTIME_ONE_MILLISECOND,
+               stop_time: int = 60 * simtime.SIMTIME_ONE_SECOND,
+               seed: int = 1,
+               sock_slots: int = 16,
+               pool_capacity: int = 1 << 14,
+               bw_up_Bps: int = 1 << 30,
+               bw_down_Bps: int = 1 << 30,
+               bootstrap_end: int = 0):
+    """Bulk TCP transfers: every host but `server` sends
+    `bytes_per_client` to the server (the reference's tgen file-transfer
+    bring-up config, resource/examples/shadow.config.xml)."""
+    lat, rel = uniform_full_mesh(num_hosts, latency_ns, reliability)
+    params = make_net_params(
+        latency_ns=lat,
+        reliability=rel,
+        host_vertex=jnp.arange(num_hosts),
+        bw_up_Bps=jnp.full(num_hosts, bw_up_Bps),
+        bw_down_Bps=jnp.full(num_hosts, bw_down_Bps),
+        seed=seed,
+        stop_time=stop_time,
+        bootstrap_end=bootstrap_end,
+    )
+    state = make_sim_state(num_hosts, sock_slots=sock_slots,
+                           pool_capacity=pool_capacity)
+    ids = jnp.arange(num_hosts)
+    is_server = ids == server
+    state = state.replace(socks=bulk_app.setup_servers(state.socks, is_server))
+    app = bulk_app.Bulk()
+    state = state.replace(app=bulk_app.init_state(
+        num_hosts,
+        is_client=~is_server,
+        dst=jnp.full(num_hosts, server),
+        total_bytes=jnp.where(is_server, 0, bytes_per_client),
+        start_t=jnp.full(num_hosts, start_time),
+    ))
     return state, params, app
 
 

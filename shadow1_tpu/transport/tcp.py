@@ -1,24 +1,788 @@
-"""TCP: vectorized userspace TCP state machine.
+"""TCP: the full userspace TCP state machine as vectorized SoA transitions.
 
-Stub for now -- the engine calls these three hooks each micro-step; the
-full masked-SoA implementation of the reference's TCP
-(/root/reference/src/main/host/descriptor/tcp.c) lands with the transport
-milestone.
+The reference implements TCP as a 2.5k-LoC stateful object per socket
+(/root/reference/src/main/host/descriptor/tcp.c): a TCPS_* state machine
+(tcp.c:41-55), send/receive sequence windows (tcp.c:125-173), a retransmit
+queue + RTO timer (tcp.c:175-190,923-1060), delayed ACKs, RTT estimation
+(tcp.c:206-220), and pluggable Reno congestion control
+(tcp_cong_reno.c:13-60).  Here the same machine runs for every socket of
+every host simultaneously: each per-socket scalar is a cell of an [H, S]
+array (core/state.py SocketTable), and each protocol rule is a masked
+vector update.  The engine guarantees at most one inbound segment per host
+per micro-step, so arrival processing is gather(one socket per host) ->
+compute -> scatter.
+
+Fidelity/divergence notes vs the reference:
+
+* Sequence numbers are u32 with standard wraparound comparisons; ISS is 0
+  (the stream starts at seq 1) -- deterministic, unlike the reference's
+  random ISS, and fin_seq==0 can then safely mean "no FIN seen".
+* Out-of-order segments are kept in a 256-segment bitmap per socket
+  (`ooo_mask`) instead of the reference's unordered-input pqueue + SACK
+  list (tcp.c:222-230).  Senders always emit MSS-sized segments except the
+  stream tail, so OOO segments are MSS-aligned relative to rcv_nxt and one
+  bit per segment suffices; the cumulative-ACK jump after a hole fills
+  reproduces SACK-free NewReno recovery dynamics.
+* Loss recovery is NewReno (fast retransmit on 3 dup ACKs, partial-ACK
+  hole retransmission, full-window go-back-N on RTO) matching the
+  reference's Reno hooks (tcp_cong_reno.c) with the retransmit-tally
+  range arithmetic (tcp_retransmit_tally.cc) collapsed into the single
+  `retrans_nxt` cursor -- ranges are unnecessary without SACK scoreboard.
+* RTT sampling uses the timestamp echo the packets already carry
+  (pool.ts / ts_echo), i.e. RFC 7323 TS rather than the reference's
+  per-segment timers; constants follow RFC 6298 and the reference's
+  definitions.h:107-131 (RTO init 1s, min 200ms, max 120s, delack 40ms).
 """
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
+from ..core import emit, simtime
+from ..core import state as st
+from ..core.state import (ERR_SOCKET_OVERFLOW,
+                          I32, I64, U32, OOO_WORDS, SOCK_FREE, SOCK_TCP,
+                          TCP_FLAG_ACK, TCP_FLAG_FIN, TCP_FLAG_RST,
+                          TCP_FLAG_SYN, TCP_MSS, TCPS_CLOSED, TCPS_CLOSEWAIT,
+                          TCPS_CLOSING, TCPS_ESTABLISHED, TCPS_FINWAIT1,
+                          TCPS_FINWAIT2, TCPS_LASTACK, TCPS_LISTEN,
+                          TCPS_SYNRECEIVED, TCPS_SYNSENT, TCPS_TIMEWAIT)
+
+INV = simtime.SIMTIME_INVALID
+
+# Reference definitions.h:107-131 (net/tcp.h lineage).
+RTO_INIT = simtime.SIMTIME_ONE_SECOND
+RTO_MIN = simtime.SIMTIME_ONE_SECOND // 5          # 200ms
+RTO_MAX = 120 * simtime.SIMTIME_ONE_SECOND
+DELACK_DELAY = simtime.SIMTIME_ONE_SECOND // 25    # 40ms
+# Reference CONFIG_TCPCLOSETIMER_DELAY (definitions.h) = 60s.
+TIMEWAIT_DELAY = 60 * simtime.SIMTIME_ONE_SECOND
+# Reference CONFIG_SEND_BUFFER_SIZE / CONFIG_RECV_BUFFER_SIZE.
+SND_BUF_DEFAULT = 131072
+RCV_BUF_DEFAULT = 174760
+INIT_CWND = 10 * TCP_MSS
+SSTHRESH_INIT = 1 << 30
+MAX_OOO_SEGS = 32 * OOO_WORDS
+
+_SENDABLE = (TCPS_ESTABLISHED, TCPS_CLOSEWAIT, TCPS_FINWAIT1, TCPS_CLOSING,
+             TCPS_LASTACK)
+
+
+# ---------------------------------------------------------------------------
+# u32 sequence arithmetic (wraparound-safe)
+# ---------------------------------------------------------------------------
+
+
+def _sdiff(a, b):
+    """Signed distance a-b in sequence space ([i32], wrap-safe)."""
+    return (a.astype(U32) - b.astype(U32)).astype(I32)
+
+
+def _seq_lt(a, b):
+    return _sdiff(a, b) < 0
+
+
+def _seq_leq(a, b):
+    return _sdiff(a, b) <= 0
+
+
+def _seq_min(a, b):
+    return jnp.where(_seq_lt(a, b), a, b)
+
+
+def _in_state(tcp_state, states):
+    m = tcp_state == states[0]
+    for s in states[1:]:
+        m = m | (tcp_state == s)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# gather / scatter helpers: one socket per host
+# ---------------------------------------------------------------------------
+
+
+class _Sock:
+    """Per-host gathered view of one socket slot; mutate fields freely, then
+    `scatter` writes changed fields back under a mask."""
+
+    FIELDS = [
+        "stype", "tcp_state", "local_port", "peer_host", "peer_port",
+        "parent", "accepted", "child_order", "backlog",
+        "snd_una", "snd_nxt", "snd_end", "snd_wnd", "snd_buf_cap",
+        "cwnd", "ssthresh", "dup_acks", "recover", "in_recovery",
+        "retrans_nxt", "retrans_end", "app_closed",
+        "rcv_nxt", "rcv_read", "rcv_buf_cap", "fin_seq",
+        "ts_recent", "srtt", "rttvar", "rto",
+        "t_rto", "t_delack", "t_tw", "delack_pending",
+        "error", "bytes_sent", "bytes_recv",
+    ]
+
+    def __init__(self, socks: st.SocketTable, slot):
+        self._rows = jnp.arange(socks.num_hosts)
+        self._slot = jnp.clip(slot, 0, socks.slots - 1)
+        for f in self.FIELDS:
+            setattr(self, f, getattr(socks, f)[self._rows, self._slot])
+        self.ooo = socks.ooo_mask[self._rows, self._slot, :]   # [H, W]
+
+    def scatter(self, socks: st.SocketTable, mask) -> st.SocketTable:
+        upd = {}
+        for f in self.FIELDS:
+            cur = getattr(socks, f)
+            old = cur[self._rows, self._slot]
+            new = jnp.where(mask, getattr(self, f), old)
+            upd[f] = cur.at[self._rows, self._slot].set(new)
+        old_ooo = socks.ooo_mask[self._rows, self._slot, :]
+        new_ooo = jnp.where(mask[:, None], self.ooo, old_ooo)
+        upd["ooo_mask"] = socks.ooo_mask.at[self._rows, self._slot, :].set(new_ooo)
+        return socks.replace(**upd)
+
+    def setwhere(self, mask, **kv):
+        for f, v in kv.items():
+            cur = getattr(self, f)
+            setattr(self, f, jnp.where(mask, jnp.asarray(v).astype(cur.dtype),
+                                       cur))
+
+
+_DEFAULTS = dict(
+    stype=SOCK_FREE, tcp_state=TCPS_CLOSED, local_port=0, peer_host=-1,
+    peer_port=0, parent=-1, accepted=False, child_order=0, backlog=0,
+    snd_una=0, snd_nxt=0, snd_end=1, snd_wnd=TCP_MSS,
+    snd_buf_cap=SND_BUF_DEFAULT, cwnd=INIT_CWND, ssthresh=SSTHRESH_INIT,
+    dup_acks=0, recover=0, in_recovery=False, retrans_nxt=1, retrans_end=1,
+    app_closed=False,
+    rcv_nxt=0, rcv_read=0, rcv_buf_cap=RCV_BUF_DEFAULT, fin_seq=0,
+    ts_recent=0, srtt=0, rttvar=0, rto=RTO_INIT,
+    t_rto=INV, t_delack=INV, t_tw=INV, delack_pending=0,
+    error=0, bytes_sent=0, bytes_recv=0,
+)
+
+
+def _reset_slot(socks: st.SocketTable, slot, mask) -> st.SocketTable:
+    """Reset every field of socket `slot` (per-host [H] i32) to defaults
+    where mask; the vectorized analog of tcp_new (reference tcp.c)."""
+    rows = jnp.arange(socks.num_hosts)
+    sslot = jnp.clip(slot, 0, socks.slots - 1)
+    upd = {}
+    for f, dv in _DEFAULTS.items():
+        cur = getattr(socks, f)
+        old = cur[rows, sslot]
+        new = jnp.where(mask, jnp.asarray(dv).astype(cur.dtype), old)
+        upd[f] = cur.at[rows, sslot].set(new)
+    old_ooo = socks.ooo_mask[rows, sslot, :]
+    upd["ooo_mask"] = socks.ooo_mask.at[rows, sslot, :].set(
+        jnp.where(mask[:, None], jnp.zeros_like(old_ooo), old_ooo))
+    # udp ring fields stay; they are ignored for TCP sockets.
+    return socks.replace(**upd)
+
+
+# ---------------------------------------------------------------------------
+# Host-side / app-side socket API (vectorized over hosts)
+# ---------------------------------------------------------------------------
+
+
+def listen(socks: st.SocketTable, host: int, slot: int, port: int,
+           backlog: int = 64) -> st.SocketTable:
+    """Setup-time: make (host, slot) a TCP listener on `port`."""
+    h = socks.num_hosts
+    mask = jnp.arange(h) == host
+    return listen_v(socks, mask, slot, port, backlog)
+
+
+def listen_v(socks: st.SocketTable, mask, slot, port,
+             backlog: int = 64) -> st.SocketTable:
+    """Vectorized listen: where mask, socket `slot` becomes a listener."""
+    slot = jnp.broadcast_to(jnp.asarray(slot, I32), (socks.num_hosts,))
+    socks = _reset_slot(socks, slot, mask)
+    sv = _Sock(socks, slot)
+    sv.setwhere(mask, stype=SOCK_TCP, tcp_state=TCPS_LISTEN, local_port=port,
+                backlog=backlog)
+    return sv.scatter(socks, mask)
+
+
+def connect_v(socks: st.SocketTable, mask, slot, dst_host, dst_port,
+              local_port, now) -> st.SocketTable:
+    """Vectorized connect: where mask, open an active connection from socket
+    `slot` to (dst_host, dst_port).  The SYN is emitted by the RTO timer
+    path on the next micro-step at `now` (first fire = first transmission,
+    reference tcp_connectToPeer tcp.c:1462)."""
+    slot = jnp.broadcast_to(jnp.asarray(slot, I32), (socks.num_hosts,))
+    socks = _reset_slot(socks, slot, mask)
+    sv = _Sock(socks, slot)
+    sv.setwhere(mask, stype=SOCK_TCP, tcp_state=TCPS_SYNSENT,
+                local_port=local_port, peer_host=dst_host,
+                peer_port=dst_port, snd_una=0, snd_nxt=0, rcv_nxt=0,
+                t_rto=now)
+    return sv.scatter(socks, mask)
+
+
+def write_v(socks: st.SocketTable, mask, slot, target_end) -> st.SocketTable:
+    """App write: advance snd_end toward `target_end` (u32 seq, exclusive)
+    bounded by the send buffer (snd_end - snd_una <= snd_buf_cap);
+    reference tcp_sendUserData (tcp.c:2126)."""
+    sv = _Sock(socks, slot)
+    cap_end = (sv.snd_una + sv.snd_buf_cap.astype(U32)).astype(U32)
+    tgt = jnp.asarray(target_end).astype(U32)
+    new_end = jnp.where(_seq_lt(tgt, cap_end), tgt, cap_end)
+    grow = mask & _seq_lt(sv.snd_end, new_end)
+    sv.setwhere(grow, snd_end=new_end)
+    return sv.scatter(socks, grow)
+
+
+def close_v(socks: st.SocketTable, mask, slot) -> st.SocketTable:
+    """App close: mark FIN-at-end-of-stream (reference tcp_close)."""
+    sv = _Sock(socks, slot)
+    do = mask & (sv.stype == SOCK_TCP) & ~sv.app_closed
+    sv.setwhere(do, app_closed=True)
+    return sv.scatter(socks, do)
+
+
+def consume_all(socks: st.SocketTable) -> st.SocketTable:
+    """Sink helper: mark all received TCP bytes as read on every socket
+    (infinite application consumer), opening the advertised window."""
+    is_tcp = socks.stype == SOCK_TCP
+    return socks.replace(
+        rcv_read=jnp.where(is_tcp, socks.rcv_nxt, socks.rcv_read))
+
+
+def recv_window(sv: _Sock):
+    used = _sdiff(sv.rcv_nxt, sv.rcv_read)
+    return jnp.maximum(sv.rcv_buf_cap - used, 0)
+
+
+# ---------------------------------------------------------------------------
+# OOO bitmap ops ([H, W] u32, bit k = segment rcv_nxt + k*MSS)
+# ---------------------------------------------------------------------------
+
+
+def _ctz32(x):
+    """Count trailing zeros of u32 (32 when x == 0)."""
+    lsb = x & (~x + jnp.uint32(1))
+    return jnp.where(x == 0, 32,
+                     jax.lax.population_count(lsb - jnp.uint32(1)).astype(I32))
+
+
+def _ooo_run(bm):
+    """Number of contiguous set bits from bit 0 across words ([H] i32)."""
+    run = jnp.zeros(bm.shape[:-1], I32)
+    carry = jnp.ones(bm.shape[:-1], bool)
+    for w in range(bm.shape[-1]):
+        word = bm[..., w]
+        ones = _ctz32(~word)
+        run = run + jnp.where(carry, ones, 0)
+        carry = carry & (word == jnp.uint32(0xFFFFFFFF))
+    return run
+
+
+def _ooo_shift(bm, nbits):
+    """Shift the whole bitmap right by nbits ([H] i32, 0..256)."""
+    w = bm.shape[-1]
+    s = nbits // 32
+    r = (nbits % 32).astype(U32)
+    idx = jnp.arange(w, dtype=I32)[None, :] + s[:, None]          # [H, W]
+    ok0 = idx < w
+    ok1 = (idx + 1) < w
+    g0 = jnp.take_along_axis(bm, jnp.clip(idx, 0, w - 1), axis=-1)
+    g0 = jnp.where(ok0, g0, 0)
+    g1 = jnp.take_along_axis(bm, jnp.clip(idx + 1, 0, w - 1), axis=-1)
+    g1 = jnp.where(ok1, g1, 0)
+    r2 = r[:, None]
+    lo = g0 >> r2
+    hi = jnp.where(r2 == 0, jnp.uint32(0), g1 << (jnp.uint32(32) - r2))
+    return lo | hi
+
+
+def _ooo_set_bit(bm, mask, k):
+    """Set bit k ([H] i32) where mask."""
+    w = bm.shape[-1]
+    word = jnp.clip(k // 32, 0, w - 1)
+    bit = (jnp.uint32(1) << (k % 32).astype(U32))
+    onehot = (jnp.arange(w, dtype=I32)[None, :] == word[:, None])
+    add = jnp.where(onehot & mask[:, None], bit[:, None], jnp.uint32(0))
+    return bm | add
+
+
+# ---------------------------------------------------------------------------
+# RTT / RTO (RFC 6298; reference tcp.c:206-220)
+# ---------------------------------------------------------------------------
+
+
+def _rtt_update(sv: _Sock, mask, rtt):
+    first = sv.srtt == 0
+    srtt_n = jnp.where(first, rtt, sv.srtt - sv.srtt // 8 + rtt // 8)
+    dev = jnp.abs(srtt_n - rtt)
+    rttvar_n = jnp.where(first, rtt // 2, sv.rttvar - sv.rttvar // 4 + dev // 4)
+    rto_n = jnp.clip(srtt_n + jnp.maximum(4 * rttvar_n,
+                                          simtime.SIMTIME_ONE_MILLISECOND),
+                     RTO_MIN, RTO_MAX)
+    sv.setwhere(mask & (rtt > 0), srtt=srtt_n, rttvar=rttvar_n, rto=rto_n)
+
+
+# ---------------------------------------------------------------------------
+# Arrival processing (reference tcp_processPacket, tcp.c:1777)
+# ---------------------------------------------------------------------------
+
 
 def process_arrivals(state, params, em, tick_t, slot, mask):
-    """Handle inbound TCP segments selected by the engine (<=1 per host)."""
-    return state, em
+    """Handle <=1 inbound TCP segment per host.
+
+    `slot` is the pool index per host (already clipped), `mask` [H] marks
+    hosts that actually have a TCP arrival this tick.
+    """
+    socks = state.socks
+    pool = state.pool
+    h = socks.num_hosts
+
+    g = lambda a: a[slot]
+    p_src, p_sport, p_dport = g(pool.src), g(pool.sport), g(pool.dport)
+    p_flags, p_seq, p_ack = g(pool.flags), g(pool.seq), g(pool.ack)
+    p_wnd, p_len = g(pool.wnd), g(pool.length)
+    p_ts, p_tse = g(pool.ts), g(pool.ts_echo)
+    p_id = g(pool.pkt_id)
+
+    f_syn = (p_flags & TCP_FLAG_SYN) != 0
+    f_ack = (p_flags & TCP_FLAG_ACK) != 0
+    f_fin = (p_flags & TCP_FLAG_FIN) != 0
+    f_rst = (p_flags & TCP_FLAG_RST) != 0
+
+    # --- socket match -------------------------------------------------------
+    is_tcp = socks.stype == SOCK_TCP
+    port_ok = socks.local_port == p_dport[:, None]
+    peer_ok = (socks.peer_host == p_src[:, None]) & \
+        (socks.peer_port == p_sport[:, None])
+    not_listen = (socks.tcp_state != TCPS_LISTEN) & \
+        (socks.tcp_state != TCPS_CLOSED)
+    conn_m = is_tcp & port_ok & peer_ok & not_listen
+    lsn_m = is_tcp & port_ok & (socks.tcp_state == TCPS_LISTEN)
+
+    slot_ids = jnp.arange(socks.slots, dtype=I32)[None, :]
+    conn_slot = jnp.min(jnp.where(conn_m, slot_ids, socks.slots), axis=1)
+    has_conn = mask & (conn_slot < socks.slots)
+    conn_slot = jnp.clip(conn_slot, 0, socks.slots - 1)
+    lsn_slot = jnp.min(jnp.where(lsn_m, slot_ids, socks.slots), axis=1)
+    has_lsn = mask & (lsn_slot < socks.slots)
+
+    # --- passive open: SYN -> new child socket (reference server
+    # multiplexing, tcp.c:91-115; _tcp_processPacket LISTEN branch) --------
+    want_child = mask & ~has_conn & has_lsn & f_syn & ~f_ack & ~f_rst
+    free_m = socks.stype == SOCK_FREE
+    child_slot = jnp.min(jnp.where(free_m, slot_ids, socks.slots), axis=1)
+    have_free = child_slot < socks.slots
+    spawn = want_child & have_free
+    child_slot = jnp.clip(child_slot, 0, socks.slots - 1)
+    # Slot-table exhaustion: the SYN is dropped (client retries / times
+    # out, like a full accept backlog) but the capacity escape-hatch flag
+    # is raised so the caller can resize the socket table.
+    slot_overflow = jnp.any(want_child & ~have_free)
+
+    socks = _reset_slot(socks, child_slot, spawn)
+    cv = _Sock(socks, child_slot)
+    cv.setwhere(spawn, stype=SOCK_TCP, tcp_state=TCPS_SYNRECEIVED,
+                local_port=p_dport, peer_host=p_src, peer_port=p_sport,
+                parent=lsn_slot, child_order=p_id,
+                rcv_nxt=(p_seq + jnp.uint32(1)).astype(U32),
+                rcv_read=(p_seq + jnp.uint32(1)).astype(U32),
+                snd_una=0, snd_nxt=1, snd_wnd=p_wnd, ts_recent=p_ts,
+                t_rto=tick_t + RTO_INIT)
+    socks = cv.scatter(socks, spawn)
+
+    # --- connected-socket processing ---------------------------------------
+    sv = _Sock(socks, conn_slot)
+    m = has_conn
+
+    # Reply accumulator (at most one reply per host this tick).
+    rep = jnp.zeros((h,), bool)
+    rep_flags = jnp.zeros((h,), I32)
+
+    # RST teardown (reference _tcp_processPacket RST handling).
+    rst_hit = m & f_rst
+    sv.setwhere(rst_hit, tcp_state=TCPS_CLOSED, stype=SOCK_FREE,
+                error=104,  # ECONNRESET
+                t_rto=INV, t_delack=INV, t_tw=INV)
+    m_live = m & ~f_rst
+
+    # SYN-ACK at SYNSENT: active open completes.
+    synack = m_live & f_syn & f_ack & (sv.tcp_state == TCPS_SYNSENT) & \
+        (p_ack == sv.snd_nxt)
+    # NB: snd_end is NOT reset here -- the app may have written data during
+    # SYNSENT (write_v), and the stream starts at seq 1 regardless.
+    sv.setwhere(synack,
+                tcp_state=TCPS_ESTABLISHED,
+                rcv_nxt=(p_seq + jnp.uint32(1)).astype(U32),
+                rcv_read=(p_seq + jnp.uint32(1)).astype(U32),
+                snd_una=p_ack, retrans_nxt=sv.snd_nxt,
+                retrans_end=sv.snd_nxt,
+                snd_wnd=jnp.maximum(p_wnd, TCP_MSS),
+                ts_recent=p_ts, t_rto=INV)
+    _rtt_update(sv, synack & (p_tse > 0), tick_t - p_tse)
+    rep = rep | synack
+    rep_flags = jnp.where(synack, TCP_FLAG_ACK, rep_flags)
+
+    # Dup SYN at SYNRECEIVED (our SYN-ACK was lost): re-ACK via SYN-ACK.
+    dup_syn = m_live & f_syn & ~f_ack & (sv.tcp_state == TCPS_SYNRECEIVED)
+    rep = rep | dup_syn
+    rep_flags = jnp.where(dup_syn, TCP_FLAG_SYN | TCP_FLAG_ACK, rep_flags)
+
+    # Handshake-completing ACK at SYNRECEIVED.
+    hs_done = m_live & f_ack & ~f_syn & (sv.tcp_state == TCPS_SYNRECEIVED) & \
+        (p_ack == sv.snd_nxt)
+    sv.setwhere(hs_done, tcp_state=TCPS_ESTABLISHED,
+                snd_una=p_ack, retrans_nxt=sv.snd_nxt,
+                retrans_end=sv.snd_nxt,
+                snd_wnd=jnp.maximum(p_wnd, TCP_MSS), t_rto=INV)
+    _rtt_update(sv, hs_done & (p_tse > 0), tick_t - p_tse)
+
+    # ---- ACK processing (established states) -------------------------------
+    est_like = _in_state(sv.tcp_state, (TCPS_ESTABLISHED, TCPS_FINWAIT1,
+                                        TCPS_FINWAIT2, TCPS_CLOSING,
+                                        TCPS_CLOSEWAIT, TCPS_LASTACK))
+    ackp = m_live & f_ack & ~f_syn & est_like
+
+    new_ack = ackp & _seq_lt(sv.snd_una, p_ack) & _seq_leq(p_ack, sv.snd_nxt)
+    acked_bytes = jnp.where(new_ack, _sdiff(p_ack, sv.snd_una), 0)
+
+    # Window update on any acceptable ACK.
+    sv.setwhere(ackp & _seq_leq(p_ack, sv.snd_nxt), snd_wnd=p_wnd)
+
+    # RTT sample (Karn via timestamp echo: only segments we stamped).
+    _rtt_update(sv, new_ack & (p_tse > 0), tick_t - p_tse)
+
+    # NewReno (reference tcp_cong_reno.c:13-60).
+    flight = _sdiff(sv.snd_nxt, sv.snd_una)
+    exit_rec = new_ack & sv.in_recovery & _seq_leq(sv.recover, p_ack)
+    partial = new_ack & sv.in_recovery & ~exit_rec
+    normal = new_ack & ~sv.in_recovery
+
+    ss = normal & (sv.cwnd < sv.ssthresh)
+    sv.setwhere(ss, cwnd=jnp.minimum(sv.cwnd + acked_bytes, sv.ssthresh))
+    ca = normal & ~ss
+    sv.setwhere(ca, cwnd=sv.cwnd + jnp.maximum(
+        (TCP_MSS * TCP_MSS) // jnp.maximum(sv.cwnd, 1), 1))
+    sv.setwhere(exit_rec, cwnd=sv.ssthresh, in_recovery=False, dup_acks=0)
+    # Partial ACK: retransmit exactly the next hole (one segment, RFC 6582),
+    # deflate cwnd.
+    sv.setwhere(partial,
+                retrans_nxt=p_ack,
+                retrans_end=(p_ack + jnp.uint32(TCP_MSS)),
+                cwnd=jnp.maximum(sv.cwnd - acked_bytes + TCP_MSS, TCP_MSS))
+    sv.setwhere(normal, dup_acks=0)
+    sv.setwhere(new_ack, snd_una=p_ack,
+                retrans_nxt=jnp.where(_seq_lt(sv.retrans_nxt, p_ack),
+                                      p_ack, sv.retrans_nxt))
+    # RTO rearm: fresh timer when data remains, off when all acked
+    # (reference _tcp_setRetransmitTimer / clear, tcp.c:923-1060).
+    still_out = _seq_lt(p_ack, sv.snd_nxt)
+    sv.setwhere(new_ack, t_rto=jnp.where(still_out, tick_t + sv.rto, INV))
+
+    # Duplicate ACKs -> fast retransmit (3rd dup).
+    dup = ackp & (p_ack == sv.snd_una) & (p_len == 0) & ~f_fin & \
+        (_sdiff(sv.snd_nxt, sv.snd_una) > 0) & ~new_ack
+    sv.setwhere(dup, dup_acks=sv.dup_acks + 1)
+    # Fast retransmit resends ONE segment at the hole (snd_una); go-back-N
+    # is reserved for RTO.
+    fr = dup & (sv.dup_acks == 3) & ~sv.in_recovery
+    sv.setwhere(fr,
+                ssthresh=jnp.maximum(flight // 2, 2 * TCP_MSS),
+                cwnd=jnp.maximum(flight // 2, 2 * TCP_MSS) + 3 * TCP_MSS,
+                in_recovery=True, recover=sv.snd_nxt,
+                retrans_nxt=sv.snd_una,
+                retrans_end=(sv.snd_una + jnp.uint32(TCP_MSS)))
+    inflate = dup & sv.in_recovery & (sv.dup_acks > 3)
+    sv.setwhere(inflate, cwnd=sv.cwnd + TCP_MSS)
+
+    # FIN-of-ours acked: state advances (fin seq = snd_end).
+    fin_sent = sv.app_closed & (sv.snd_nxt == (sv.snd_end + jnp.uint32(1)))
+    fin_acked = new_ack & fin_sent & (p_ack == sv.snd_nxt)
+    sv.setwhere(fin_acked & (sv.tcp_state == TCPS_FINWAIT1),
+                tcp_state=TCPS_FINWAIT2)
+    sv.setwhere(fin_acked & (sv.tcp_state == TCPS_CLOSING),
+                tcp_state=TCPS_TIMEWAIT, t_tw=tick_t + TIMEWAIT_DELAY)
+    sv.setwhere(fin_acked & (sv.tcp_state == TCPS_LASTACK),
+                tcp_state=TCPS_CLOSED, stype=SOCK_FREE,
+                t_rto=INV, t_delack=INV, t_tw=INV)
+
+    # ---- data reception ----------------------------------------------------
+    can_rcv = m_live & est_like & ~f_syn & (p_len > 0)
+    off = _sdiff(p_seq, sv.rcv_nxt)
+    in_order = can_rcv & (off == 0)
+    old_data = can_rcv & (off < 0)
+    # OOO: MSS-aligned full segments within the bitmap horizon.
+    seg_idx = off // TCP_MSS
+    ooo_ok = can_rcv & (off > 0) & (off % TCP_MSS == 0) & \
+        (seg_idx < MAX_OOO_SEGS) & (p_len == TCP_MSS)
+    fits = _sdiff(p_seq + p_len.astype(U32), sv.rcv_read) <= sv.rcv_buf_cap
+    in_order = in_order & fits
+    ooo_ok = ooo_ok & fits
+
+    sv.ooo = _ooo_set_bit(sv.ooo, ooo_ok, seg_idx)
+    sv.setwhere(in_order, ts_recent=p_ts)
+    adv = jnp.where(in_order, p_len, 0)
+    sv.setwhere(in_order, rcv_nxt=(sv.rcv_nxt + p_len.astype(U32)))
+    # Re-anchor the bitmap at the new rcv_nxt: shift out the segments the
+    # in-order advance just covered.  Senders only emit sub-MSS segments at
+    # the stream tail (see transmit), so a non-MSS-multiple advance means
+    # no OOO data can follow -- clear defensively to avoid desync.
+    shift0 = adv // TCP_MSS
+    aligned = (adv % TCP_MSS) == 0
+    sv.ooo = jnp.where((in_order & ~aligned)[:, None],
+                       jnp.zeros_like(sv.ooo), sv.ooo)
+    sv.ooo = jnp.where((in_order & aligned & (shift0 > 0))[:, None],
+                       _ooo_shift(sv.ooo, shift0), sv.ooo)
+    # Drain the contiguous OOO run now uncovered (the cumulative-ACK jump
+    # after a hole fills).
+    run = jnp.where(in_order & aligned, _ooo_run(sv.ooo), 0)
+    sv.ooo = jnp.where((run > 0)[:, None], _ooo_shift(sv.ooo, run), sv.ooo)
+    sv.setwhere(run > 0, rcv_nxt=sv.rcv_nxt + (run * TCP_MSS).astype(U32))
+    sv.setwhere(in_order, bytes_recv=sv.bytes_recv + adv + run * TCP_MSS)
+
+    # ---- FIN reception -----------------------------------------------------
+    fin_pos = (p_seq + p_len.astype(U32)).astype(U32)
+    sv.setwhere(m_live & f_fin & est_like, fin_seq=fin_pos)
+    fin_now = m_live & est_like & (sv.fin_seq != 0) & (sv.rcv_nxt == sv.fin_seq)
+    sv.setwhere(fin_now, rcv_nxt=sv.rcv_nxt + jnp.uint32(1))
+    st_ = sv.tcp_state
+    sv.setwhere(fin_now & (st_ == TCPS_ESTABLISHED), tcp_state=TCPS_CLOSEWAIT)
+    our_fin_acked = sv.app_closed & \
+        (sv.snd_una == (sv.snd_end + jnp.uint32(1)))
+    sv.setwhere(fin_now & (st_ == TCPS_FINWAIT1) & ~our_fin_acked,
+                tcp_state=TCPS_CLOSING)
+    sv.setwhere(fin_now & ((st_ == TCPS_FINWAIT2) |
+                           ((st_ == TCPS_FINWAIT1) & our_fin_acked)),
+                tcp_state=TCPS_TIMEWAIT, t_tw=tick_t + TIMEWAIT_DELAY)
+
+    # ---- ACK generation ----------------------------------------------------
+    # Immediate ACK: OOO/old data (dup ACK), FIN, second in-order segment
+    # (delack threshold, reference delayed-ACK handling) or retransmitted
+    # FIN while in TIMEWAIT.
+    tw_refin = m_live & f_fin & (sv.tcp_state == TCPS_TIMEWAIT)
+    pend = sv.delack_pending + jnp.where(in_order, 1, 0)
+    ack_now = ooo_ok | old_data | (can_rcv & (off > 0) & ~ooo_ok) | fin_now | \
+        tw_refin | (in_order & (pend >= 2))
+    delay_ack = in_order & ~ack_now
+    sv.setwhere(delay_ack, delack_pending=pend,
+                t_delack=jnp.where(sv.t_delack == INV, tick_t + DELACK_DELAY,
+                                   sv.t_delack))
+    sv.setwhere(ack_now, delack_pending=0, t_delack=INV)
+    rep_flags = jnp.where(ack_now & (rep_flags == 0), TCP_FLAG_ACK, rep_flags)
+    rep = rep | ack_now
+
+    socks = sv.scatter(socks, m)
+
+    # --- replies ------------------------------------------------------------
+    # Child SYN-ACK (new connection) takes the reply slot on spawn hosts.
+    sv2 = _Sock(socks, jnp.where(spawn, child_slot, conn_slot))
+    reply = (m & rep) | spawn
+    r_flags = jnp.where(spawn, TCP_FLAG_SYN | TCP_FLAG_ACK, rep_flags)
+    r_seq = jnp.where(spawn | dup_syn, jnp.uint32(0), sv2.snd_nxt)
+    # RST for segments with no matching socket (reference closed-port reset).
+    orphan = mask & ~has_conn & ~spawn & ~dup_syn & ~f_rst & \
+        ~(has_lsn & f_syn)
+    rst_flags = TCP_FLAG_RST | TCP_FLAG_ACK
+    reply_any = reply | orphan
+    em = emit.put(
+        em, reply_any, emit.SLOT_RX_REPLY,
+        dst=p_src, sport=p_dport, dport=p_sport, proto=st.PROTO_TCP,
+        flags=jnp.where(orphan, rst_flags, r_flags),
+        seq=jnp.where(orphan, p_ack, r_seq),
+        ack=jnp.where(orphan, (p_seq + p_len.astype(U32) + jnp.uint32(1)),
+                      sv2.rcv_nxt),
+        wnd=recv_window(sv2), ts_echo=jnp.where(reply, sv2.ts_recent, 0),
+    )
+    err = state.err | jnp.where(slot_overflow, ERR_SOCKET_OVERFLOW,
+                                0).astype(state.err.dtype)
+    return state.replace(socks=socks, err=err), em
+
+
+# ---------------------------------------------------------------------------
+# Timers (reference RTO/delack/close timers via Timer descriptors)
+# ---------------------------------------------------------------------------
+
+_K_RTO, _K_DELACK, _K_TW = 0, 1, 2
 
 
 def run_timers(state, params, em, tick_t, active):
-    """Expire RTO / delayed-ACK / TIME_WAIT timers."""
-    return state, em
+    socks = state.socks
+    h, s = socks.num_hosts, socks.slots
+
+    cand = jnp.stack([socks.t_rto, socks.t_delack, socks.t_tw], axis=-1)
+    cand2 = cand.reshape(h, s * 3)
+    due = cand2 <= tick_t[:, None]
+    due = due & active[:, None]
+    tmin = jnp.min(jnp.where(due, cand2, INV), axis=1)
+    at_min = due & (cand2 == tmin[:, None])
+    flat = jnp.arange(s * 3, dtype=I32)[None, :]
+    pick = jnp.min(jnp.where(at_min, flat, s * 3), axis=1)
+    have = pick < s * 3
+    pick = jnp.clip(pick, 0, s * 3 - 1)
+    slot = pick // 3
+    kind = pick % 3
+
+    sv = _Sock(socks, slot)
+    m = have
+
+    # --- RTO fire -----------------------------------------------------------
+    rto_f = m & (kind == _K_RTO)
+    # First transmission of SYN (connect_v arms t_rto=now with snd_nxt==0).
+    syn_first = rto_f & (sv.tcp_state == TCPS_SYNSENT) & (sv.snd_nxt == 0)
+    sv.setwhere(syn_first, snd_nxt=1, t_rto=tick_t + sv.rto)
+    syn_re = rto_f & (sv.tcp_state == TCPS_SYNSENT) & ~syn_first
+    synack_re = rto_f & (sv.tcp_state == TCPS_SYNRECEIVED)
+    backoff = syn_re | synack_re
+    timed_out = backoff & (sv.rto >= RTO_MAX)
+    sv.setwhere(timed_out, tcp_state=TCPS_CLOSED, stype=SOCK_FREE,
+                error=110,  # ETIMEDOUT
+                t_rto=INV, t_delack=INV, t_tw=INV)
+    backoff = backoff & ~timed_out
+    sv.setwhere(backoff, rto=jnp.minimum(sv.rto * 2, RTO_MAX))
+    sv.setwhere(backoff, t_rto=tick_t + sv.rto)
+
+    # Established-state RTO: go-back-N + multiplicative backoff
+    # (reference _tcp_retransmitTimerExpired; reno timeout_ev).
+    est_like = _in_state(sv.tcp_state, _SENDABLE)
+    has_out = _sdiff(sv.snd_nxt, sv.snd_una) > 0
+    est_rto = rto_f & est_like & has_out
+    flight = _sdiff(sv.snd_nxt, sv.snd_una)
+    sv.setwhere(est_rto,
+                ssthresh=jnp.maximum(flight // 2, 2 * TCP_MSS),
+                cwnd=TCP_MSS, retrans_nxt=sv.snd_una,
+                retrans_end=sv.snd_nxt,  # full go-back-N window
+                in_recovery=False, dup_acks=0,
+                rto=jnp.minimum(sv.rto * 2, RTO_MAX))
+    sv.setwhere(est_rto, t_rto=tick_t + sv.rto)
+    # Stale RTO with nothing outstanding: disarm.
+    sv.setwhere(rto_f & ~syn_first & ~syn_re & ~synack_re & ~est_rto & ~timed_out,
+                t_rto=INV)
+
+    # --- delayed-ACK fire ---------------------------------------------------
+    da_f = m & (kind == _K_DELACK)
+    send_ack = da_f & (sv.delack_pending > 0)
+    sv.setwhere(da_f, t_delack=INV, delack_pending=0)
+
+    # --- TIME_WAIT fire -----------------------------------------------------
+    tw_f = m & (kind == _K_TW) & (sv.tcp_state == TCPS_TIMEWAIT)
+    sv.setwhere(tw_f, tcp_state=TCPS_CLOSED, stype=SOCK_FREE,
+                t_rto=INV, t_delack=INV, t_tw=INV)
+    sv.setwhere(m & (kind == _K_TW) & ~tw_f, t_tw=INV)
+
+    socks = sv.scatter(socks, m)
+
+    # --- timer emissions (SLOT_TIMER; one per host per tick) ----------------
+    sv2 = _Sock(socks, slot)
+    syn_emit = syn_first | syn_re
+    emit_any = syn_emit | synack_re | send_ack
+    flags = jnp.where(syn_emit & ~synack_re, TCP_FLAG_SYN,
+                      jnp.where(synack_re, TCP_FLAG_SYN | TCP_FLAG_ACK,
+                                TCP_FLAG_ACK))
+    em = emit.put(
+        em, emit_any, emit.SLOT_TIMER,
+        dst=sv2.peer_host, sport=sv2.local_port, dport=sv2.peer_port,
+        proto=st.PROTO_TCP, flags=flags,
+        seq=jnp.where(syn_emit | synack_re, jnp.uint32(0), sv2.snd_nxt),
+        ack=jnp.where(syn_emit & ~synack_re, jnp.uint32(0), sv2.rcv_nxt),
+        wnd=recv_window(sv2),
+        ts_echo=jnp.where(send_ack, sv2.ts_recent, 0),
+    )
+    return state.replace(socks=socks), em
+
+
+# ---------------------------------------------------------------------------
+# Transmission (reference _tcp_flush tcp.c:1121 + tcp_sendUserData)
+# ---------------------------------------------------------------------------
+
+
+def _tx_eligibility(socks: st.SocketTable):
+    """[H,S] masks: (retransmit-pending, new-data-or-FIN sendable)."""
+    sendable = _in_state(socks.tcp_state, _SENDABLE)
+    inflight = _sdiff(socks.snd_nxt, socks.snd_una)
+    allowed = jnp.minimum(socks.cwnd, jnp.maximum(socks.snd_wnd, 0))
+
+    retx_bound = _seq_min(socks.retrans_end, socks.snd_nxt)
+    retx = sendable & _seq_lt(socks.retrans_nxt, retx_bound) & \
+        (_sdiff(socks.retrans_nxt, socks.snd_una) < allowed)
+
+    room = allowed - inflight
+    data_left = _sdiff(socks.snd_end, socks.snd_nxt)
+    # Full-MSS segments only, except the stream tail: keeps every non-tail
+    # segment MSS-aligned (the OOO bitmap invariant) and avoids
+    # silly-window dribble; a window with < MSS room waits for an ACK.
+    can_new = sendable & (
+        ((data_left >= TCP_MSS) & (room >= TCP_MSS)) |
+        ((data_left > 0) & (data_left < TCP_MSS) & (room >= data_left)))
+
+    fin_ready = sendable & socks.app_closed & (socks.snd_nxt == socks.snd_end) \
+        & _in_state(socks.tcp_state, (TCPS_ESTABLISHED, TCPS_CLOSEWAIT))
+    return retx, can_new, fin_ready
 
 
 def transmit(state, params, em, tick_t, active):
-    """Emit new data segments permitted by cwnd/rwnd."""
-    return state, em
+    socks = state.socks
+    h = socks.num_hosts
+    slot_ids = jnp.arange(socks.slots, dtype=I32)[None, :]
+
+    for k in range(emit.TX_SLOTS):
+        retx, can_new, fin_ready = _tx_eligibility(socks)
+        want = (retx | can_new | fin_ready) & active[:, None]
+        pick = jnp.min(jnp.where(want, slot_ids, socks.slots), axis=1)
+        have = pick < socks.slots
+        pick = jnp.clip(pick, 0, socks.slots - 1)
+        sv = _Sock(socks, pick)
+        rows = jnp.arange(h)
+        do_retx = have & retx[rows, pick]
+        do_new = have & ~do_retx & can_new[rows, pick]
+        do_fin_only = have & ~do_retx & ~do_new & fin_ready[rows, pick]
+
+        # Segment geometry: min(MSS, remaining stream).  Eligibility already
+        # guaranteed window room for a full segment (or the tail), and
+        # room must never truncate a segment -- every non-tail segment is
+        # exactly MSS so the receive-side OOO bitmap stays aligned.
+        seq = jnp.where(do_retx, sv.retrans_nxt, sv.snd_nxt)
+        data_left = jnp.where(
+            do_retx, _sdiff(sv.snd_end, sv.retrans_nxt),
+            _sdiff(sv.snd_end, sv.snd_nxt))
+        seg_len = jnp.clip(jnp.minimum(TCP_MSS, data_left), 0, TCP_MSS)
+        # Retransmit of the FIN octet itself (retrans_nxt == snd_end).
+        retx_fin = do_retx & (data_left == 0) & sv.app_closed
+        seg_len = jnp.where(retx_fin | do_fin_only, 0, seg_len)
+        send_fin = retx_fin | do_fin_only | \
+            (do_new & sv.app_closed &
+             ((seq + seg_len.astype(U32)) == sv.snd_end))
+        # Piggybacked FIN consumes one extra sequence number.
+        consumed = seg_len.astype(U32) + jnp.where(send_fin, 1, 0).astype(U32)
+
+        doing = do_retx | do_new | do_fin_only
+        flags = jnp.where(doing, TCP_FLAG_ACK, 0) | \
+            jnp.where(send_fin & doing, TCP_FLAG_FIN, 0)
+
+        em = emit.put(
+            em, doing, emit.SLOT_TX_BASE + k,
+            dst=sv.peer_host, sport=sv.local_port, dport=sv.peer_port,
+            proto=st.PROTO_TCP, flags=flags, seq=seq, ack=sv.rcv_nxt,
+            wnd=recv_window(sv), length=seg_len, ts_echo=sv.ts_recent)
+
+        # Cursor updates.
+        sv.setwhere(do_retx, retrans_nxt=sv.retrans_nxt + consumed)
+        adv_new = (do_new | do_fin_only)
+        sv.setwhere(adv_new, snd_nxt=seq + consumed)
+        sv.setwhere(adv_new, bytes_sent=sv.bytes_sent + seg_len)
+        # First FIN transmission moves the state machine
+        # (reference tcp_close / FIN enqueue).
+        first_fin = (do_new | do_fin_only) & send_fin
+        sv.setwhere(first_fin & (sv.tcp_state == TCPS_ESTABLISHED),
+                    tcp_state=TCPS_FINWAIT1)
+        sv.setwhere(first_fin & (sv.tcp_state == TCPS_CLOSEWAIT),
+                    tcp_state=TCPS_LASTACK)
+        # Sending data piggybacks an ACK.
+        sv.setwhere(doing, delack_pending=0, t_delack=INV)
+        # Arm RTO if off.
+        sv.setwhere(doing & (sv.t_rto == INV), t_rto=tick_t + sv.rto)
+
+        socks = sv.scatter(socks, doing)
+
+    # More sendable work remains at this instant -> re-tick the host.
+    retx, can_new, fin_ready = _tx_eligibility(socks)
+    more = jnp.any((retx | can_new | fin_ready), axis=1) & active
+    hosts = state.hosts
+    hosts = hosts.replace(
+        t_resume=jnp.where(more, tick_t, hosts.t_resume))
+    return state.replace(socks=socks, hosts=hosts), em

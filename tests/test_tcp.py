@@ -1,0 +1,172 @@
+"""TCP state-machine tests: handshake, transfer, loss recovery, teardown,
+determinism.
+
+The reference's test model (SURVEY.md §4) runs client/server programs
+through the simulator (src/test/tcp/ blocking/epoll x loopback/lossless/
+lossy) and diffs determinism across runs.  These tests exercise the same
+behaviors on the vectorized machine via the bulk-transfer app.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from shadow1_tpu import sim
+from shadow1_tpu.core import engine, simtime
+from shadow1_tpu.core.state import (SOCK_TCP, TCPS_CLOSED, TCPS_ESTABLISHED,
+                                    TCPS_TIMEWAIT)
+
+MS = simtime.SIMTIME_ONE_MILLISECOND
+SEC = simtime.SIMTIME_ONE_SECOND
+
+
+def _run_bulk(**kw):
+    state, params, app = sim.build_bulk(**kw)
+    out = sim.run(state, params, app)
+    return out, params, app
+
+
+class TestHandshakeAndTransfer:
+    def test_two_host_transfer_completes(self):
+        total = 200_000
+        out, _, _ = _run_bulk(num_hosts=2, server=0, bytes_per_client=total,
+                              latency_ns=10 * MS, stop_time=30 * SEC)
+        assert int(out.err) == 0
+        # Client (host 1) finished.
+        assert int(out.app.phase[1]) == 2
+        finish = int(out.app.finish_t[1])
+        assert finish < 30 * SEC
+        # The server-side child socket saw every byte: bytes_recv counts
+        # in-order stream delivery on host 0's sockets.
+        recv = int(out.socks.bytes_recv[0].sum())
+        assert recv == total
+        # Sanity on timing: at least a handshake RTT plus transfer time.
+        assert finish > 3 * 10 * MS
+
+    def test_transfer_faster_with_lower_latency(self):
+        total = 500_000
+        out_fast, _, _ = _run_bulk(num_hosts=2, bytes_per_client=total,
+                                   latency_ns=1 * MS, stop_time=30 * SEC)
+        out_slow, _, _ = _run_bulk(num_hosts=2, bytes_per_client=total,
+                                   latency_ns=50 * MS, stop_time=60 * SEC)
+        f = int(out_fast.app.finish_t[1])
+        s = int(out_slow.app.finish_t[1])
+        assert int(out_fast.app.phase[1]) == 2
+        assert int(out_slow.app.phase[1]) == 2
+        assert f < s
+
+    def test_connection_teardown(self):
+        out, _, _ = _run_bulk(num_hosts=2, bytes_per_client=50_000,
+                              latency_ns=5 * MS, stop_time=30 * SEC)
+        # Client socket ends in TIME_WAIT (or already closed); server child
+        # ends CLOSED (LAST_ACK -> ACKed -> freed).
+        cstate = int(out.socks.tcp_state[1, 1])
+        assert cstate in (TCPS_TIMEWAIT, TCPS_CLOSED)
+        # No socket stuck half-open anywhere.
+        live = (out.socks.stype == SOCK_TCP) & \
+            (out.socks.tcp_state == TCPS_ESTABLISHED)
+        assert not bool(jnp.any(live))
+
+
+class TestLossRecovery:
+    def test_lossy_transfer_completes(self):
+        total = 100_000
+        out, _, _ = _run_bulk(num_hosts=2, bytes_per_client=total,
+                              latency_ns=10 * MS, reliability=0.9,
+                              stop_time=120 * SEC, seed=7)
+        assert int(out.err) == 0
+        assert int(out.app.phase[1]) == 2, "lossy transfer did not finish"
+        assert int(out.socks.bytes_recv[0].sum()) == total
+        # Loss actually happened (otherwise the test is vacuous).
+        assert int(out.hosts.pkts_dropped_inet.sum()) > 0
+
+    def test_very_lossy_transfer_completes(self):
+        total = 30_000
+        out, _, _ = _run_bulk(num_hosts=2, bytes_per_client=total,
+                              latency_ns=10 * MS, reliability=0.7,
+                              stop_time=300 * SEC, seed=3)
+        assert int(out.app.phase[1]) == 2
+        assert int(out.socks.bytes_recv[0].sum()) == total
+
+
+class TestManyClients:
+    def test_fan_in(self):
+        # 8 clients -> 1 server concurrently (children multiplexing,
+        # reference tcp.c:91-115 server-socket hash).
+        n = 9
+        total = 50_000
+        out, _, _ = _run_bulk(num_hosts=n, server=0, bytes_per_client=total,
+                              latency_ns=10 * MS, stop_time=60 * SEC)
+        assert int(out.err) == 0
+        phases = [int(p) for p in out.app.phase[1:]]
+        assert phases == [2] * (n - 1), f"unfinished clients: {phases}"
+        assert int(out.socks.bytes_recv[0].sum()) == (n - 1) * total
+
+
+class TestDeterminism:
+    def test_bitwise_identical_runs(self):
+        a, _, _ = _run_bulk(num_hosts=4, bytes_per_client=80_000,
+                            latency_ns=10 * MS, reliability=0.9,
+                            stop_time=60 * SEC, seed=11)
+        b, _, _ = _run_bulk(num_hosts=4, bytes_per_client=80_000,
+                            latency_ns=10 * MS, reliability=0.9,
+                            stop_time=60 * SEC, seed=11)
+        assert jnp.array_equal(a.app.finish_t, b.app.finish_t)
+        assert jnp.array_equal(a.socks.bytes_recv, b.socks.bytes_recv)
+        assert jnp.array_equal(a.hosts.pkts_sent, b.hosts.pkts_sent)
+        assert jnp.array_equal(a.hosts.pkts_dropped_inet,
+                               b.hosts.pkts_dropped_inet)
+
+    def test_seed_changes_trajectory(self):
+        a, _, _ = _run_bulk(num_hosts=2, bytes_per_client=80_000,
+                            latency_ns=10 * MS, reliability=0.9,
+                            stop_time=60 * SEC, seed=1)
+        b, _, _ = _run_bulk(num_hosts=2, bytes_per_client=80_000,
+                            latency_ns=10 * MS, reliability=0.9,
+                            stop_time=60 * SEC, seed=2)
+        # Different loss patterns -> different packet counts.
+        assert int(a.hosts.pkts_dropped_inet.sum()) != \
+            int(b.hosts.pkts_dropped_inet.sum()) or \
+            int(a.app.finish_t[1]) != int(b.app.finish_t[1])
+
+
+class TestOooBitmap:
+    def test_set_run_shift_roundtrip(self):
+        from shadow1_tpu.transport.tcp import (_ooo_run, _ooo_set_bit,
+                                               _ooo_shift)
+        bm = jnp.zeros((2, 8), jnp.uint32)
+        m = jnp.array([True, True])
+        # Host 0: bits 0,1,2 and 40; host 1: bit 33 only.
+        for k in (0, 1, 2, 40):
+            bm = bm.at[0:1].set(_ooo_set_bit(bm, m, jnp.array([k, 999]))[0:1])
+        bm = _ooo_set_bit(bm, jnp.array([False, True]), jnp.array([0, 33]))
+        run = _ooo_run(bm)
+        assert run.tolist() == [3, 0]
+        bm2 = _ooo_shift(bm, run)
+        # After draining 3 bits, host 0's bit 40 sits at 37.
+        assert int(bm2[0, 1]) == (1 << (37 - 32))
+        assert int(bm2[0, 0]) == 0
+        # Host 1 unshifted (run 0): bit 33 intact.
+        assert int(bm2[1, 1]) == (1 << 1)
+
+    def test_shift_across_words(self):
+        from shadow1_tpu.transport.tcp import _ooo_run, _ooo_shift
+        bm = jnp.full((1, 8), jnp.uint32(0xFFFFFFFF))
+        assert int(_ooo_run(bm)[0]) == 256
+        out = _ooo_shift(bm, jnp.array([70]))
+        # 256 - 70 = 186 bits remain, right-aligned from bit 0.
+        total = sum(bin(int(w)).count("1") for w in out[0])
+        assert total == 186
+        assert int(out[0, 0]) == 0xFFFFFFFF
+
+
+class TestThroughputShape:
+    def test_rtt_bound(self):
+        # Without bandwidth caps, transfer time is dominated by slow-start
+        # RTTs: ~log2(total/MSS/IW) + 1 round trips.  50KB at 2*10ms RTT
+        # must finish well under a second but can't beat 2 RTTs.
+        total = 50_000
+        out, _, _ = _run_bulk(num_hosts=2, bytes_per_client=total,
+                              latency_ns=10 * MS, stop_time=10 * SEC)
+        finish = int(out.app.finish_t[1]) - MS  # minus start time
+        assert finish >= 2 * 2 * 10 * MS
+        assert finish < 1 * SEC
