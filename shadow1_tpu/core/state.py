@@ -186,8 +186,8 @@ def make_packet_pool(capacity: int) -> PacketPool:
 # Socket table
 # ---------------------------------------------------------------------------
 
-OOO_WORDS = 8  # out-of-order bitmap: 8 * 32 = 256 MSS segments beyond rcv_nxt
-UDP_RING = 8   # per-UDP-socket datagram ring entries
+SACK_RANGES = 8  # out-of-order reassembly: byte ranges held past rcv_nxt
+UDP_RING = 8     # per-UDP-socket datagram ring entries
 
 
 @struct.dataclass
@@ -231,7 +231,13 @@ class SocketTable:
     rcv_nxt: jnp.ndarray      # [H,S] u32 next expected
     rcv_read: jnp.ndarray     # [H,S] u32 seq consumed by app
     rcv_buf_cap: jnp.ndarray  # [H,S] i32
-    ooo_mask: jnp.ndarray     # [H,S,OOO_WORDS] u32 bitmap of segments past rcv_nxt
+    # Out-of-order reassembly scoreboard: up to SACK_RANGES disjoint byte
+    # ranges [lo, hi) held past rcv_nxt, sorted by distance from rcv_nxt;
+    # empty slot encoded as lo == hi.  The vectorized analog of the
+    # reference's unordered-input pqueue + SACK list (tcp.c:222-230) and
+    # the remora range arithmetic (tcp_retransmit_tally.cc).
+    sack_lo: jnp.ndarray      # [H,S,SACK_RANGES] u32
+    sack_hi: jnp.ndarray      # [H,S,SACK_RANGES] u32
     fin_seq: jnp.ndarray      # [H,S] u32 peer FIN sequence, 0 = none seen
 
     # --- timers & RTT (reference tcp.c:175-220) ---
@@ -294,7 +300,8 @@ def make_socket_table(num_hosts: int, slots: int) -> SocketTable:
         rcv_nxt=_zeros(hs, U32),
         rcv_read=_zeros(hs, U32),
         rcv_buf_cap=_zeros(hs, I32),
-        ooo_mask=_zeros(hs + (OOO_WORDS,), U32),
+        sack_lo=_zeros(hs + (SACK_RANGES,), U32),
+        sack_hi=_zeros(hs + (SACK_RANGES,), U32),
         fin_seq=_zeros(hs, U32),
         ts_recent=_zeros(hs, I64),
         srtt=_zeros(hs, I64),

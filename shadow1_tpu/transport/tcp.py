@@ -17,12 +17,16 @@ Fidelity/divergence notes vs the reference:
 * Sequence numbers are u32 with standard wraparound comparisons; ISS is 0
   (the stream starts at seq 1) -- deterministic, unlike the reference's
   random ISS, and fin_seq==0 can then safely mean "no FIN seen".
-* Out-of-order segments are kept in a 256-segment bitmap per socket
-  (`ooo_mask`) instead of the reference's unordered-input pqueue + SACK
-  list (tcp.c:222-230).  Senders always emit MSS-sized segments except the
-  stream tail, so OOO segments are MSS-aligned relative to rcv_nxt and one
-  bit per segment suffices; the cumulative-ACK jump after a hole fills
-  reproduces SACK-free NewReno recovery dynamics.
+* Out-of-order segments are kept in a per-socket byte-range reassembly
+  scoreboard (`sack_lo`/`sack_hi`, up to SACK_RANGES disjoint ranges)
+  instead of the reference's unordered-input pqueue + SACK list
+  (tcp.c:222-230); the insert/merge/drain operations are the vectorized
+  analog of the remora range arithmetic (tcp_retransmit_tally.cc:177-285).
+  Ranges are byte-granular, so arbitrary segment sizes and alignments
+  reassemble correctly; the cumulative-ACK jump after a hole fills
+  reproduces SACK-free NewReno recovery dynamics.  If a segment would
+  create more than SACK_RANGES disjoint ranges it is dropped (the sender
+  retransmits) -- graceful degradation, like a finite reassembly buffer.
 * Loss recovery is NewReno (fast retransmit on 3 dup ACKs, partial-ACK
   hole retransmission, full-window go-back-N on RTO) matching the
   reference's Reno hooks (tcp_cong_reno.c) with the retransmit-tally
@@ -42,7 +46,7 @@ import jax.numpy as jnp
 from ..core import emit, simtime
 from ..core import state as st
 from ..core.state import (ERR_SOCKET_OVERFLOW,
-                          I32, I64, U32, OOO_WORDS, SOCK_FREE, SOCK_TCP,
+                          I32, I64, U32, SACK_RANGES, SOCK_FREE, SOCK_TCP,
                           TCP_FLAG_ACK, TCP_FLAG_FIN, TCP_FLAG_RST,
                           TCP_FLAG_SYN, TCP_MSS, TCPS_CLOSED, TCPS_CLOSEWAIT,
                           TCPS_CLOSING, TCPS_ESTABLISHED, TCPS_FINWAIT1,
@@ -63,7 +67,6 @@ SND_BUF_DEFAULT = 131072
 RCV_BUF_DEFAULT = 174760
 INIT_CWND = 10 * TCP_MSS
 SSTHRESH_INIT = 1 << 30
-MAX_OOO_SEGS = 32 * OOO_WORDS
 
 _SENDABLE = (TCPS_ESTABLISHED, TCPS_CLOSEWAIT, TCPS_FINWAIT1, TCPS_CLOSING,
              TCPS_LASTACK)
@@ -119,12 +122,15 @@ class _Sock:
         "error", "bytes_sent", "bytes_recv",
     ]
 
+    RANGE_FIELDS = ["sack_lo", "sack_hi"]
+
     def __init__(self, socks: st.SocketTable, slot):
         self._rows = jnp.arange(socks.num_hosts)
         self._slot = jnp.clip(slot, 0, socks.slots - 1)
         for f in self.FIELDS:
             setattr(self, f, getattr(socks, f)[self._rows, self._slot])
-        self.ooo = socks.ooo_mask[self._rows, self._slot, :]   # [H, W]
+        for f in self.RANGE_FIELDS:
+            setattr(self, f, getattr(socks, f)[self._rows, self._slot, :])
 
     def scatter(self, socks: st.SocketTable, mask) -> st.SocketTable:
         upd = {}
@@ -133,9 +139,11 @@ class _Sock:
             old = cur[self._rows, self._slot]
             new = jnp.where(mask, getattr(self, f), old)
             upd[f] = cur.at[self._rows, self._slot].set(new)
-        old_ooo = socks.ooo_mask[self._rows, self._slot, :]
-        new_ooo = jnp.where(mask[:, None], self.ooo, old_ooo)
-        upd["ooo_mask"] = socks.ooo_mask.at[self._rows, self._slot, :].set(new_ooo)
+        for f in self.RANGE_FIELDS:
+            cur = getattr(socks, f)
+            old = cur[self._rows, self._slot, :]
+            new = jnp.where(mask[:, None], getattr(self, f), old)
+            upd[f] = cur.at[self._rows, self._slot, :].set(new)
         return socks.replace(**upd)
 
     def setwhere(self, mask, **kv):
@@ -170,9 +178,11 @@ def _reset_slot(socks: st.SocketTable, slot, mask) -> st.SocketTable:
         old = cur[rows, sslot]
         new = jnp.where(mask, jnp.asarray(dv).astype(cur.dtype), old)
         upd[f] = cur.at[rows, sslot].set(new)
-    old_ooo = socks.ooo_mask[rows, sslot, :]
-    upd["ooo_mask"] = socks.ooo_mask.at[rows, sslot, :].set(
-        jnp.where(mask[:, None], jnp.zeros_like(old_ooo), old_ooo))
+    for f in _Sock.RANGE_FIELDS:
+        cur = getattr(socks, f)
+        old = cur[rows, sslot, :]
+        upd[f] = cur.at[rows, sslot, :].set(
+            jnp.where(mask[:, None], jnp.zeros_like(old), old))
     # udp ring fields stay; they are ignored for TCP sockets.
     return socks.replace(**upd)
 
@@ -252,55 +262,78 @@ def recv_window(sv: _Sock):
 
 
 # ---------------------------------------------------------------------------
-# OOO bitmap ops ([H, W] u32, bit k = segment rcv_nxt + k*MSS)
+# Byte-range reassembly scoreboard ([H, R] u32 lo/hi pairs, lo==hi = empty)
+#
+# The vectorized analog of the reference's C++ remora range arithmetic
+# (tcp_retransmit_tally.cc:177-285: merge/normalize sorted seq ranges) --
+# fixed-capacity, branchless, unrolled over R (static, small).
 # ---------------------------------------------------------------------------
 
 
-def _ctz32(x):
-    """Count trailing zeros of u32 (32 when x == 0)."""
-    lsb = x & (~x + jnp.uint32(1))
-    return jnp.where(x == 0, 32,
-                     jax.lax.population_count(lsb - jnp.uint32(1)).astype(I32))
+def _ranges_insert(lo, hi, mask, s, e, base):
+    """Insert [s, e) into each host's range set where `mask`; merge
+    overlapping/adjacent ranges and keep them sorted by distance from
+    `base` (= rcv_nxt).  lo/hi: [H, R] u32; s/e/base: [H] u32.
+
+    If the insert would create more than R disjoint ranges, the range
+    farthest from `base` is dropped (sender retransmits it later)."""
+    h, r = lo.shape
+    big = jnp.int64(1) << 40
+    s = jnp.where(mask, s, 0).astype(U32)
+    e = jnp.where(mask, e, 0).astype(U32)
+    lo1 = jnp.concatenate([lo, s[:, None]], axis=1)
+    hi1 = jnp.concatenate([hi, e[:, None]], axis=1)
+    valid = lo1 != hi1
+    key = jnp.where(valid, _sdiff(lo1, base[:, None]).astype(jnp.int64), big)
+    order = jnp.argsort(key, axis=1)
+    lo1 = jnp.take_along_axis(lo1, order, axis=1)
+    hi1 = jnp.take_along_axis(hi1, order, axis=1)
+    valid = lo1 != hi1
+
+    out_lo = jnp.zeros_like(lo)
+    out_hi = jnp.zeros_like(hi)
+    ptr = jnp.zeros((h,), I32)
+    cur_lo = jnp.zeros((h,), U32)
+    cur_hi = jnp.zeros((h,), U32)
+    cur_valid = jnp.zeros((h,), bool)
+    slots = jnp.arange(r, dtype=I32)[None, :]
+
+    def _emit(out_lo, out_hi, ptr, do):
+        onehot = (slots == ptr[:, None]) & (do & (ptr < r))[:, None]
+        return (jnp.where(onehot, cur_lo[:, None], out_lo),
+                jnp.where(onehot, cur_hi[:, None], out_hi),
+                ptr + jnp.where(do, 1, 0))
+
+    for i in range(r + 1):
+        li, hii, vi = lo1[:, i], hi1[:, i], valid[:, i]
+        merge = vi & cur_valid & _seq_leq(li, cur_hi)
+        start = vi & ~merge
+        out_lo, out_hi, ptr = _emit(out_lo, out_hi, ptr, start & cur_valid)
+        cur_hi = jnp.where(merge & _seq_lt(cur_hi, hii), hii, cur_hi)
+        cur_lo = jnp.where(start, li, cur_lo)
+        cur_hi = jnp.where(start, hii, cur_hi)
+        cur_valid = cur_valid | vi
+    out_lo, out_hi, ptr = _emit(out_lo, out_hi, ptr, cur_valid)
+    return out_lo, out_hi
 
 
-def _ooo_run(bm):
-    """Number of contiguous set bits from bit 0 across words ([H] i32)."""
-    run = jnp.zeros(bm.shape[:-1], I32)
-    carry = jnp.ones(bm.shape[:-1], bool)
-    for w in range(bm.shape[-1]):
-        word = bm[..., w]
-        ones = _ctz32(~word)
-        run = run + jnp.where(carry, ones, 0)
-        carry = carry & (word == jnp.uint32(0xFFFFFFFF))
-    return run
-
-
-def _ooo_shift(bm, nbits):
-    """Shift the whole bitmap right by nbits ([H] i32, 0..256)."""
-    w = bm.shape[-1]
-    s = nbits // 32
-    r = (nbits % 32).astype(U32)
-    idx = jnp.arange(w, dtype=I32)[None, :] + s[:, None]          # [H, W]
-    ok0 = idx < w
-    ok1 = (idx + 1) < w
-    g0 = jnp.take_along_axis(bm, jnp.clip(idx, 0, w - 1), axis=-1)
-    g0 = jnp.where(ok0, g0, 0)
-    g1 = jnp.take_along_axis(bm, jnp.clip(idx + 1, 0, w - 1), axis=-1)
-    g1 = jnp.where(ok1, g1, 0)
-    r2 = r[:, None]
-    lo = g0 >> r2
-    hi = jnp.where(r2 == 0, jnp.uint32(0), g1 << (jnp.uint32(32) - r2))
-    return lo | hi
-
-
-def _ooo_set_bit(bm, mask, k):
-    """Set bit k ([H] i32) where mask."""
-    w = bm.shape[-1]
-    word = jnp.clip(k // 32, 0, w - 1)
-    bit = (jnp.uint32(1) << (k % 32).astype(U32))
-    onehot = (jnp.arange(w, dtype=I32)[None, :] == word[:, None])
-    add = jnp.where(onehot & mask[:, None], bit[:, None], jnp.uint32(0))
-    return bm | add
+def _ranges_drain(lo, hi, nxt, mask):
+    """Advance `nxt` [H] u32 through any ranges it reaches (lo <= nxt),
+    popping them; returns (lo, hi, nxt, drained_bytes).  The cumulative-ACK
+    jump after a retransmitted hole fills."""
+    drained = jnp.zeros(nxt.shape, I32)
+    r = lo.shape[1]
+    for _ in range(r):
+        v = lo[:, 0] != hi[:, 0]
+        take = mask & v & _seq_leq(lo[:, 0], nxt)
+        new_nxt = jnp.where(take & _seq_lt(nxt, hi[:, 0]), hi[:, 0], nxt)
+        drained = drained + jnp.where(take, _sdiff(new_nxt, nxt), 0)
+        nxt = new_nxt
+        lo_s = jnp.roll(lo, -1, axis=1).at[:, -1].set(0)
+        hi_s = jnp.roll(hi, -1, axis=1).at[:, -1].set(0)
+        lo = jnp.where(take[:, None], lo_s, lo)
+        hi = jnp.where(take[:, None], hi_s, hi)
+    return lo, hi, nxt, drained
 
 
 # ---------------------------------------------------------------------------
@@ -505,36 +538,27 @@ def process_arrivals(state, params, em, tick_t, slot, mask):
     # ---- data reception ----------------------------------------------------
     can_rcv = m_live & est_like & ~f_syn & (p_len > 0)
     off = _sdiff(p_seq, sv.rcv_nxt)
-    in_order = can_rcv & (off == 0)
-    old_data = can_rcv & (off < 0)
-    # OOO: MSS-aligned full segments within the bitmap horizon.
-    seg_idx = off // TCP_MSS
-    ooo_ok = can_rcv & (off > 0) & (off % TCP_MSS == 0) & \
-        (seg_idx < MAX_OOO_SEGS) & (p_len == TCP_MSS)
-    fits = _sdiff(p_seq + p_len.astype(U32), sv.rcv_read) <= sv.rcv_buf_cap
-    in_order = in_order & fits
-    ooo_ok = ooo_ok & fits
+    end_seq = (p_seq + p_len.astype(U32)).astype(U32)
+    new_bytes = _sdiff(end_seq, sv.rcv_nxt)
+    fits = _sdiff(end_seq, sv.rcv_read) <= sv.rcv_buf_cap
+    # In-order (or overlapping-but-extending) data advances rcv_nxt by the
+    # new bytes; fully-old data just re-ACKs; anything past rcv_nxt goes to
+    # the reassembly scoreboard.  Byte-granular -- no alignment assumption.
+    in_adv = can_rcv & (off <= 0) & (new_bytes > 0) & fits
+    old_data = can_rcv & (new_bytes <= 0)
+    ooo_ok = can_rcv & (off > 0) & fits
 
-    sv.ooo = _ooo_set_bit(sv.ooo, ooo_ok, seg_idx)
-    sv.setwhere(in_order, ts_recent=p_ts)
-    adv = jnp.where(in_order, p_len, 0)
-    sv.setwhere(in_order, rcv_nxt=(sv.rcv_nxt + p_len.astype(U32)))
-    # Re-anchor the bitmap at the new rcv_nxt: shift out the segments the
-    # in-order advance just covered.  Senders only emit sub-MSS segments at
-    # the stream tail (see transmit), so a non-MSS-multiple advance means
-    # no OOO data can follow -- clear defensively to avoid desync.
-    shift0 = adv // TCP_MSS
-    aligned = (adv % TCP_MSS) == 0
-    sv.ooo = jnp.where((in_order & ~aligned)[:, None],
-                       jnp.zeros_like(sv.ooo), sv.ooo)
-    sv.ooo = jnp.where((in_order & aligned & (shift0 > 0))[:, None],
-                       _ooo_shift(sv.ooo, shift0), sv.ooo)
-    # Drain the contiguous OOO run now uncovered (the cumulative-ACK jump
-    # after a hole fills).
-    run = jnp.where(in_order & aligned, _ooo_run(sv.ooo), 0)
-    sv.ooo = jnp.where((run > 0)[:, None], _ooo_shift(sv.ooo, run), sv.ooo)
-    sv.setwhere(run > 0, rcv_nxt=sv.rcv_nxt + (run * TCP_MSS).astype(U32))
-    sv.setwhere(in_order, bytes_recv=sv.bytes_recv + adv + run * TCP_MSS)
+    sv.sack_lo, sv.sack_hi = _ranges_insert(
+        sv.sack_lo, sv.sack_hi, ooo_ok, p_seq, end_seq, sv.rcv_nxt)
+    sv.setwhere(in_adv, ts_recent=p_ts)
+    adv = jnp.where(in_adv, new_bytes, 0)
+    sv.setwhere(in_adv, rcv_nxt=(sv.rcv_nxt + adv.astype(U32)))
+    # Drain any scoreboard ranges the advance reached (the cumulative-ACK
+    # jump after a hole fills).
+    sv.sack_lo, sv.sack_hi, new_nxt, drained = _ranges_drain(
+        sv.sack_lo, sv.sack_hi, sv.rcv_nxt, in_adv)
+    sv.setwhere(in_adv, rcv_nxt=new_nxt,
+                bytes_recv=sv.bytes_recv + adv + drained)
 
     # ---- FIN reception -----------------------------------------------------
     fin_pos = (p_seq + p_len.astype(U32)).astype(U32)
@@ -552,14 +576,16 @@ def process_arrivals(state, params, em, tick_t, slot, mask):
                 tcp_state=TCPS_TIMEWAIT, t_tw=tick_t + TIMEWAIT_DELAY)
 
     # ---- ACK generation ----------------------------------------------------
-    # Immediate ACK: OOO/old data (dup ACK), FIN, second in-order segment
-    # (delack threshold, reference delayed-ACK handling) or retransmitted
-    # FIN while in TIMEWAIT.
+    # Immediate ACK: OOO/old data (dup ACK), window-full drop, FIN, second
+    # in-order segment (delack threshold, reference delayed-ACK handling)
+    # or retransmitted FIN while in TIMEWAIT.
     tw_refin = m_live & f_fin & (sv.tcp_state == TCPS_TIMEWAIT)
-    pend = sv.delack_pending + jnp.where(in_order, 1, 0)
-    ack_now = ooo_ok | old_data | (can_rcv & (off > 0) & ~ooo_ok) | fin_now | \
-        tw_refin | (in_order & (pend >= 2))
-    delay_ack = in_order & ~ack_now
+    pend = sv.delack_pending + jnp.where(in_adv, 1, 0)
+    # An advance that drained scoreboard ranges filled a hole: ACK at once
+    # (RFC 5681; keeps loss recovery at ~1 RTT instead of +delack).
+    ack_now = ooo_ok | old_data | (can_rcv & ~fits) | fin_now | \
+        tw_refin | (in_adv & (pend >= 2)) | (in_adv & (drained > 0))
+    delay_ack = in_adv & ~ack_now
     sv.setwhere(delay_ack, delack_pending=pend,
                 t_delack=jnp.where(sv.t_delack == INV, tick_t + DELACK_DELAY,
                                    sv.t_delack))
@@ -703,9 +729,10 @@ def _tx_eligibility(socks: st.SocketTable):
 
     room = allowed - inflight
     data_left = _sdiff(socks.snd_end, socks.snd_nxt)
-    # Full-MSS segments only, except the stream tail: keeps every non-tail
-    # segment MSS-aligned (the OOO bitmap invariant) and avoids
-    # silly-window dribble; a window with < MSS room waits for an ACK.
+    # Full-MSS segments preferred; sub-MSS only for the currently-buffered
+    # tail (avoids silly-window dribble); a window with < MSS room waits
+    # for an ACK.  The receive side reassembles byte ranges, so alignment
+    # is an efficiency choice, not a correctness invariant.
     can_new = sendable & (
         ((data_left >= TCP_MSS) & (room >= TCP_MSS)) |
         ((data_left > 0) & (data_left < TCP_MSS) & (room >= data_left)))
@@ -733,9 +760,7 @@ def transmit(state, params, em, tick_t, active):
         do_fin_only = have & ~do_retx & ~do_new & fin_ready[rows, pick]
 
         # Segment geometry: min(MSS, remaining stream).  Eligibility already
-        # guaranteed window room for a full segment (or the tail), and
-        # room must never truncate a segment -- every non-tail segment is
-        # exactly MSS so the receive-side OOO bitmap stays aligned.
+        # guaranteed window room for a full segment (or the tail).
         seq = jnp.where(do_retx, sv.retrans_nxt, sv.snd_nxt)
         data_left = jnp.where(
             do_retx, _sdiff(sv.snd_end, sv.retrans_nxt),

@@ -129,34 +129,92 @@ class TestDeterminism:
             int(a.app.finish_t[1]) != int(b.app.finish_t[1])
 
 
-class TestOooBitmap:
-    def test_set_run_shift_roundtrip(self):
-        from shadow1_tpu.transport.tcp import (_ooo_run, _ooo_set_bit,
-                                               _ooo_shift)
-        bm = jnp.zeros((2, 8), jnp.uint32)
-        m = jnp.array([True, True])
-        # Host 0: bits 0,1,2 and 40; host 1: bit 33 only.
-        for k in (0, 1, 2, 40):
-            bm = bm.at[0:1].set(_ooo_set_bit(bm, m, jnp.array([k, 999]))[0:1])
-        bm = _ooo_set_bit(bm, jnp.array([False, True]), jnp.array([0, 33]))
-        run = _ooo_run(bm)
-        assert run.tolist() == [3, 0]
-        bm2 = _ooo_shift(bm, run)
-        # After draining 3 bits, host 0's bit 40 sits at 37.
-        assert int(bm2[0, 1]) == (1 << (37 - 32))
-        assert int(bm2[0, 0]) == 0
-        # Host 1 unshifted (run 0): bit 33 intact.
-        assert int(bm2[1, 1]) == (1 << 1)
+class TestReassemblyRanges:
+    """The byte-range scoreboard (vectorized analog of the reference's
+    remora range arithmetic, tcp_retransmit_tally.cc:177-285)."""
 
-    def test_shift_across_words(self):
-        from shadow1_tpu.transport.tcp import _ooo_run, _ooo_shift
-        bm = jnp.full((1, 8), jnp.uint32(0xFFFFFFFF))
-        assert int(_ooo_run(bm)[0]) == 256
-        out = _ooo_shift(bm, jnp.array([70]))
-        # 256 - 70 = 186 bits remain, right-aligned from bit 0.
-        total = sum(bin(int(w)).count("1") for w in out[0])
-        assert total == 186
-        assert int(out[0, 0]) == 0xFFFFFFFF
+    def _mk(self, n=2, r=8):
+        return (jnp.zeros((n, r), jnp.uint32), jnp.zeros((n, r), jnp.uint32))
+
+    def test_insert_merge_adjacent_and_overlap(self):
+        from shadow1_tpu.transport.tcp import _ranges_insert
+        lo, hi = self._mk()
+        base = jnp.zeros((2,), jnp.uint32)
+        t = jnp.array([True, True])
+        f = jnp.array([True, False])
+        u = lambda *v: jnp.asarray(v, jnp.uint32)
+        # host0: [100,200) + [300,400); host1: [100,200) only
+        lo, hi = _ranges_insert(lo, hi, t, u(100, 100), u(200, 200), base)
+        lo, hi = _ranges_insert(lo, hi, f, u(300, 0), u(400, 0), base)
+        assert lo[0, :2].tolist() == [100, 300]
+        assert hi[0, :2].tolist() == [200, 400]
+        assert lo[1, :1].tolist() == [100]
+        # adjacent [200,300) on host0 bridges both into [100,400)
+        lo, hi = _ranges_insert(lo, hi, f, u(200, 0), u(300, 0), base)
+        assert (int(lo[0, 0]), int(hi[0, 0])) == (100, 400)
+        assert int(lo[0, 1]) == int(hi[0, 1])  # second slot now empty
+        # overlapping extension [350,500)
+        lo, hi = _ranges_insert(lo, hi, f, u(350, 0), u(500, 0), base)
+        assert (int(lo[0, 0]), int(hi[0, 0])) == (100, 500)
+
+    def test_drain_jumps_through_covered_ranges(self):
+        from shadow1_tpu.transport.tcp import _ranges_drain, _ranges_insert
+        lo, hi = self._mk(1)
+        base = jnp.zeros((1,), jnp.uint32)
+        t = jnp.array([True])
+        u = lambda v: jnp.asarray([v], jnp.uint32)
+        lo, hi = _ranges_insert(lo, hi, t, u(100), u(200), base)
+        lo, hi = _ranges_insert(lo, hi, t, u(200), u(250), base)  # merges
+        lo, hi = _ranges_insert(lo, hi, t, u(400), u(450), base)
+        # nxt reaches 100: drains [100,250), stops before [400,450)
+        lo, hi, nxt, drained = _ranges_drain(lo, hi, u(100), t)
+        assert int(nxt[0]) == 250 and int(drained[0]) == 150
+        assert (int(lo[0, 0]), int(hi[0, 0])) == (400, 450)
+        # a later advance overlapping the next range drains it too
+        lo, hi, nxt, drained = _ranges_drain(lo, hi, u(420), t)
+        assert int(nxt[0]) == 450 and int(drained[0]) == 30
+        assert int(lo[0, 0]) == int(hi[0, 0])
+
+    def test_wraparound_sequence_space(self):
+        from shadow1_tpu.transport.tcp import _ranges_drain, _ranges_insert
+        lo, hi = self._mk(1)
+        near_wrap = (1 << 32) - 100
+        base = jnp.asarray([near_wrap], jnp.uint32)
+        t = jnp.array([True])
+        u = lambda v: jnp.asarray([v & 0xFFFFFFFF], jnp.uint32)
+        # range straddling the wrap: [base+50, base+150)
+        lo, hi = _ranges_insert(lo, hi, t, u(near_wrap + 50),
+                                u(near_wrap + 150), base)
+        lo, hi, nxt, drained = _ranges_drain(lo, hi, u(near_wrap + 50), t)
+        assert int(nxt[0]) == (near_wrap + 150) % (1 << 32)
+        assert int(drained[0]) == 100
+
+    def test_overflow_drops_farthest(self):
+        from shadow1_tpu.transport.tcp import _ranges_insert
+        lo, hi = self._mk(1, r=4)
+        base = jnp.zeros((1,), jnp.uint32)
+        t = jnp.array([True])
+        u = lambda v: jnp.asarray([v], jnp.uint32)
+        for k in range(5):  # 5 disjoint ranges into 4 slots
+            lo, hi = _ranges_insert(lo, hi, t, u(100 * k + 10),
+                                    u(100 * k + 20), base)
+        kept = [(int(lo[0, i]), int(hi[0, i])) for i in range(4)]
+        assert kept == [(10, 20), (110, 120), (210, 220), (310, 320)]
+
+
+class TestMisalignedStream:
+    def test_sub_mss_tail_then_loss_recovers_fast(self):
+        # A bandwidth-limited transfer interleaves sub-MSS tail segments
+        # (send-buffer drain) with losses: the byte-range scoreboard must
+        # keep recovery at ~1 RTT per loss event, not 1 MSS per RTT.
+        total = 600_000
+        bw = 1_000_000
+        out, _, _ = _run_bulk(num_hosts=2, server=0, bytes_per_client=total,
+                              latency_ns=5 * MS, stop_time=60 * SEC,
+                              bw_down_Bps=bw, bw_up_Bps=1 << 30)
+        assert int(out.app.phase[1]) == 2
+        dur_s = (int(out.app.finish_t[1]) - MS) / SEC
+        assert dur_s < total / bw * 2.0, dur_s
 
 
 class TestThroughputShape:
