@@ -48,8 +48,8 @@ def main():
     jax.block_until_ready(warm)
 
     t0 = time.perf_counter()
-    out = engine.run_until(warm, params, app,
-                           SIM_SECONDS * simtime.SIMTIME_ONE_SECOND)
+    out = engine.run_chunked(warm, params, app,
+                             SIM_SECONDS * simtime.SIMTIME_ONE_SECOND)
     jax.block_until_ready(out)
     wall = time.perf_counter() - t0
 

@@ -1,0 +1,461 @@
+"""Modeled tgen: a traffic-generator action-graph interpreter.
+
+The reference's bring-up workloads run the real `tgen` plugin, which walks
+a GraphML *action graph* (/root/reference/resource/examples/
+tgen.client.graphml.xml): `start` (peers list) -> `stream`/`transfer`
+(sendsize/recvsize) -> `end` (count/time bounds) -> `pause` (time choices)
+-> back to `start`.  Servers run a graph with a single `start` node
+carrying `serverport`.
+
+Here the same graphs drive an on-device model: the parsed action tables
+live in the app-state pytree, every host holds a cursor into its graph,
+and one vectorized tick advances every host's interpreter.  A stream is a
+paired TCP exchange: the client connects, writes `sendsize` bytes and
+half-closes; the server (which learns the stream spec from the peer's
+app state -- the modeled equivalent of tgen's stream header) replies with
+`recvsize` bytes and closes.  Completion = the client saw the full reply
+and the connection tore down cleanly.
+
+This is the stepping stone to the real-code substrate: the interpreter
+consumes exactly the information a real tgen would put on the wire, so
+swapping in real process execution changes the driver, not the protocol
+stack underneath.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import xml.etree.ElementTree as ET
+
+from flax import struct
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng, simtime
+from ..core.state import (I32, I64, U32, SOCK_TCP, TCPS_CLOSED,
+                          TCPS_CLOSEWAIT, TCPS_ESTABLISHED, TCPS_LASTACK,
+                          TCPS_TIMEWAIT)
+from ..transport import tcp
+
+INV = simtime.SIMTIME_INVALID
+SEC = simtime.SIMTIME_ONE_SECOND
+
+# Action-node types.
+NT_START = 0
+NT_STREAM = 1
+NT_END = 2
+NT_PAUSE = 3
+
+CLIENT_SLOT = 1      # client-side connection slot (0 = server listener)
+EPH_BASE = 41000     # ephemeral local ports cycle so 4-tuples never collide
+EPH_RANGE = 20000
+
+
+# ---------------------------------------------------------------------------
+# tgen GraphML parsing (host-side, setup time)
+# ---------------------------------------------------------------------------
+
+_NS = "{http://graphml.graphdrawing.org/xmlns}"
+
+_SIZE_UNITS = {
+    "b": 1, "byte": 1, "bytes": 1,
+    "kb": 10**3, "mb": 10**6, "gb": 10**9, "tb": 10**12,
+    "kib": 1 << 10, "mib": 1 << 20, "gib": 1 << 30, "tib": 1 << 40,
+}
+
+
+def parse_size(text: str) -> int:
+    """'1 MiB' / '100 kb' / '5242880' -> bytes (tgen size grammar)."""
+    parts = str(text).strip().split()
+    if len(parts) == 1:
+        return int(float(parts[0]))
+    if len(parts) == 2:
+        unit = parts[1].lower()
+        if unit not in _SIZE_UNITS:
+            raise ValueError(f"unknown size unit {parts[1]!r}")
+        return int(float(parts[0]) * _SIZE_UNITS[unit])
+    raise ValueError(f"cannot parse size {text!r}")
+
+
+def _parse_times_s(text: str):
+    """'1,2,3' or '5' -> list of seconds (floats allowed)."""
+    return [float(x) for x in str(text).split(",") if x != ""]
+
+
+@dataclasses.dataclass
+class TgenGraph:
+    """One parsed tgen action graph (host-side)."""
+
+    node_ids: list          # node id strings
+    ntype: np.ndarray       # [N] NT_*
+    nxt: np.ndarray         # [N] successor node (local index), -1 = none
+    sendsize: np.ndarray    # [N] i64 bytes (stream nodes)
+    recvsize: np.ndarray    # [N] i64 bytes
+    count: np.ndarray      # [N] i64 loop bound (end nodes), 0 = unbounded
+    pause_s: list           # [N] list of pause-time choices (seconds)
+    peers: list             # [N] list of "host:port" strings (start nodes)
+    serverport: int         # > 0 if this graph is a server
+    start_node: int         # entry node index
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.node_ids)
+
+
+_NODE_TYPES = {"start": NT_START, "stream": NT_STREAM, "transfer": NT_STREAM,
+               "end": NT_END, "pause": NT_PAUSE}
+
+
+def parse_tgen(source: str) -> TgenGraph:
+    """Parse a tgen GraphML action graph (path or literal XML)."""
+    text = source
+    if not source.lstrip().startswith("<"):
+        with open(source) as f:
+            text = f.read()
+    root = ET.fromstring(text)
+    keys = {}
+    for k in root.iter(_NS + "key"):
+        keys[k.get("id")] = k.get("attr.name")
+    graph = root.find(_NS + "graph")
+    if graph is None:
+        raise ValueError("tgen graphml has no <graph>")
+
+    ids, attrs = [], []
+    for node in graph.findall(_NS + "node"):
+        ids.append(node.get("id"))
+        d = {}
+        for data in node.findall(_NS + "data"):
+            d[keys.get(data.get("key"), data.get("key"))] = data.text or ""
+        attrs.append(d)
+    index = {n: i for i, n in enumerate(ids)}
+    n = len(ids)
+
+    # Node type from the id prefix (tgen convention: ids are the action
+    # name, optionally suffixed, e.g. "stream", "pause2").
+    ntype = np.zeros(n, np.int32)
+    for i, nid in enumerate(ids):
+        base = "".join(c for c in nid if not c.isdigit()).strip("-_")
+        if base not in _NODE_TYPES:
+            raise ValueError(f"unknown tgen action {nid!r}")
+        ntype[i] = _NODE_TYPES[base]
+
+    nxt = np.full(n, -1, np.int32)
+    for edge in graph.findall(_NS + "edge"):
+        s = index[edge.get("source")]
+        t = index[edge.get("target")]
+        if nxt[s] == -1:  # single-successor model: first edge wins
+            nxt[s] = t
+
+    sendsize = np.zeros(n, np.int64)
+    recvsize = np.zeros(n, np.int64)
+    count = np.zeros(n, np.int64)
+    pause_s = [[] for _ in range(n)]
+    peers = [[] for _ in range(n)]
+    serverport = 0
+    for i, d in enumerate(attrs):
+        if "sendsize" in d:
+            sendsize[i] = parse_size(d["sendsize"])
+        if "recvsize" in d:
+            recvsize[i] = parse_size(d["recvsize"])
+        if "count" in d:
+            count[i] = int(float(d["count"]))
+        if "time" in d and ntype[i] == NT_PAUSE:
+            pause_s[i] = _parse_times_s(d["time"])
+        if "peers" in d:
+            peers[i] = [p.strip() for p in d["peers"].split(",") if p.strip()]
+        if "serverport" in d and ntype[i] == NT_START:
+            serverport = int(d["serverport"])
+
+    start = next(i for i in range(n) if ntype[i] == NT_START)
+    return TgenGraph(node_ids=ids, ntype=ntype, nxt=nxt, sendsize=sendsize,
+                     recvsize=recvsize, count=count, pause_s=pause_s,
+                     peers=peers, serverport=serverport, start_node=start)
+
+
+# ---------------------------------------------------------------------------
+# Device-side interpreter state
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class TgenState:
+    """Concatenated action tables + per-host interpreter registers."""
+
+    # --- static tables (concatenation of every distinct graph) ---
+    ntype: jnp.ndarray       # [N] i32
+    nxt: jnp.ndarray         # [N] i32 global successor, -1 = halt
+    sendsize: jnp.ndarray    # [N] i64
+    recvsize: jnp.ndarray    # [N] i64
+    count: jnp.ndarray      # [N] i64
+    pause_t: jnp.ndarray     # [N,PC] i64 ns choices (0-padded)
+    pause_n: jnp.ndarray     # [N] i32 number of choices
+    peer_host: jnp.ndarray   # [N,MP] i32 resolved host index (-1 pad)
+    peer_port: jnp.ndarray   # [N,MP] i32
+    peer_n: jnp.ndarray      # [N] i32
+
+    # --- per-host registers ---
+    cur: jnp.ndarray         # [H] i32 global node index, -1 = no program
+    start_t: jnp.ndarray     # [H] i64 process starttime
+    stop_t: jnp.ndarray      # [H] i64 process stoptime, INV = none
+    started: jnp.ndarray     # [H] bool
+    finished: jnp.ndarray    # [H] bool (end-count reached or stopped)
+    iters: jnp.ndarray       # [H] i64 completed end-node visits
+    wait_until: jnp.ndarray  # [H] i64 pause deadline, INV = not pausing
+    t_next: jnp.ndarray      # [H] i64 instant-transition re-tick, INV = none
+    stream_active: jnp.ndarray  # [H] bool
+    conn_ctr: jnp.ndarray    # [H] i64 streams initiated (port/peer cycling)
+    cur_send: jnp.ndarray    # [H] i64 active stream spec (read by servers)
+    cur_recv: jnp.ndarray    # [H] i64
+    streams_done: jnp.ndarray   # [H] i64 observable: completed streams
+    streams_failed: jnp.ndarray  # [H] i64
+
+
+class Tgen:
+    """Static app marker (hashable; tables live in TgenState)."""
+
+    def __init__(self, client_slot: int = CLIENT_SLOT):
+        self.client_slot = int(client_slot)
+
+    def __hash__(self):
+        return hash(("tgen", self.client_slot))
+
+    def __eq__(self, other):
+        return isinstance(other, Tgen) and other.client_slot == self.client_slot
+
+    # -- engine hooks -------------------------------------------------------
+
+    def next_time(self, state):
+        a = state.app
+        has = a.cur >= 0
+        t_start = jnp.where(has & ~a.started, a.start_t, INV)
+        t_pause = jnp.where(has & a.started & ~a.finished, a.wait_until, INV)
+        return jnp.minimum(jnp.minimum(t_start, t_pause), a.t_next)
+
+    def on_tick(self, state, params, em, tick_t, active):
+        a = state.app
+        socks = state.socks
+        h = a.cur.shape[0]
+        rows = jnp.arange(h)
+        slot = jnp.full((h,), self.client_slot, I32)
+
+        # -- start / stop ----------------------------------------------------
+        a = a.replace(t_next=jnp.where(active, jnp.asarray(INV, I64), a.t_next))
+        start_now = active & ~a.started & (a.cur >= 0) & (a.start_t <= tick_t)
+        a = a.replace(started=a.started | start_now)
+        stopped = active & a.started & (a.stop_t != INV) & (a.stop_t <= tick_t)
+        a = a.replace(finished=a.finished | stopped)
+
+        run = active & a.started & ~a.finished & (a.cur >= 0)
+        cur = jnp.clip(a.cur, 0, a.ntype.shape[0] - 1)
+        ntype = a.ntype[cur]
+
+        advance = jnp.zeros((h,), bool)   # move cur -> nxt this tick
+
+        # -- START: instant hop into the first action ------------------------
+        advance = advance | (run & (ntype == NT_START))
+
+        # -- STREAM ----------------------------------------------------------
+        at_stream = run & (ntype == NT_STREAM)
+        # initiate: connect to the peers list of the nearest upstream start
+        # node -- tables put the start node's peers on every node of its
+        # graph (see build_state), so gather from `cur` directly.
+        init = at_stream & ~a.stream_active
+        np_ = jnp.maximum(a.peer_n[cur], 1)
+        pk = (a.conn_ctr % np_.astype(I64)).astype(I32)
+        dsth = a.peer_host[cur, jnp.clip(pk, 0, a.peer_host.shape[1] - 1)]
+        dstp = a.peer_port[cur, jnp.clip(pk, 0, a.peer_port.shape[1] - 1)]
+        init = init & (dsth >= 0)
+        lport = (EPH_BASE + (a.conn_ctr % EPH_RANGE)).astype(I32)
+        socks = tcp.connect_v(socks, init, slot, dsth, dstp, lport, tick_t)
+        a = a.replace(
+            stream_active=a.stream_active | init,
+            conn_ctr=a.conn_ctr + jnp.where(init, 1, 0),
+            cur_send=jnp.where(init, a.sendsize[cur], a.cur_send),
+            cur_recv=jnp.where(init, a.recvsize[cur], a.cur_recv),
+        )
+
+        # progress: stream request bytes into the send buffer, half-close
+        # when fully written.
+        streaming = at_stream & a.stream_active
+        target = (jnp.uint32(1) + a.cur_send.astype(U32))
+        socks = tcp.write_v(socks, streaming, slot, target)
+        sslot = jnp.clip(slot, 0, socks.slots - 1)
+        written = socks.snd_end[rows, sslot] == target
+        socks = tcp.close_v(socks, streaming & written, slot)
+
+        # completion / failure.
+        cstate = socks.tcp_state[rows, sslot]
+        got = socks.bytes_recv[rows, sslot]
+        torn = (cstate == TCPS_TIMEWAIT) | (cstate == TCPS_CLOSED)
+        ok = streaming & torn & (got >= a.cur_recv)
+        bad = streaming & ~ok & (
+            (socks.error[rows, sslot] != 0) |
+            (torn & (got < a.cur_recv)))
+        fin_stream = ok | bad
+        a = a.replace(
+            streams_done=a.streams_done + jnp.where(ok, 1, 0),
+            streams_failed=a.streams_failed + jnp.where(bad, 1, 0),
+            stream_active=a.stream_active & ~fin_stream,
+        )
+        advance = advance | fin_stream
+
+        # -- END -------------------------------------------------------------
+        at_end = run & (ntype == NT_END)
+        iters2 = a.iters + jnp.where(at_end, 1, 0)
+        cnt = a.count[cur]
+        done = at_end & (cnt > 0) & (iters2 >= cnt)
+        a = a.replace(iters=iters2, finished=a.finished | done)
+        advance = advance | (at_end & ~done)
+
+        # -- PAUSE -----------------------------------------------------------
+        at_pause = run & (ntype == NT_PAUSE)
+        need_draw = at_pause & (a.wait_until == INV)
+        key = rng.purpose_key(params.seed_key, rng.PURPOSE_HOST_APP)
+        u = rng.keyed_uniform(key, rows.astype(jnp.uint32),
+                              a.conn_ctr.astype(jnp.uint32),
+                              a.iters.astype(jnp.uint32))
+        pn = jnp.maximum(a.pause_n[cur], 1)
+        pick = jnp.minimum((u * pn.astype(jnp.float32)).astype(I32), pn - 1)
+        dur = a.pause_t[cur, jnp.clip(pick, 0, a.pause_t.shape[1] - 1)]
+        a = a.replace(wait_until=jnp.where(need_draw, tick_t + dur,
+                                           a.wait_until))
+        pause_done = at_pause & ~need_draw & (a.wait_until <= tick_t)
+        a = a.replace(wait_until=jnp.where(pause_done, jnp.asarray(INV, I64),
+                                           a.wait_until))
+        advance = advance | pause_done
+
+        # -- cursor advance + instant re-tick --------------------------------
+        nxt = a.nxt[cur]
+        a = a.replace(
+            cur=jnp.where(advance, nxt, a.cur),
+            finished=a.finished | (advance & (nxt < 0)),
+        )
+        # Hosts that advanced onto an instantly-runnable node re-tick now.
+        a = a.replace(t_next=jnp.where(
+            (advance & (nxt >= 0)) | start_now, tick_t, a.t_next))
+
+        # -- server pass (every host, every tick) ----------------------------
+        # A child socket's stream spec comes from the connecting peer's app
+        # registers -- the modeled stream header.
+        child = (socks.stype == SOCK_TCP) & (socks.parent >= 0) & \
+            ((socks.tcp_state == TCPS_ESTABLISHED) |
+             (socks.tcp_state == TCPS_CLOSEWAIT))
+        peer = jnp.clip(socks.peer_host, 0, h - 1)
+        want_send = a.cur_send[peer]
+        want_recv = a.cur_recv[peer]
+        reply_ready = child & (socks.peer_host >= 0) & \
+            (socks.bytes_recv >= want_send) & ~socks.app_closed
+        rtarget = (jnp.uint32(1) + want_recv.astype(U32))
+        # incremental write bounded by the send buffer
+        cap_end = (socks.snd_una + socks.snd_buf_cap.astype(U32)).astype(U32)
+        step_end = jnp.where(
+            (rtarget - socks.snd_una).astype(I32) <=
+            (cap_end - socks.snd_una).astype(I32), rtarget, cap_end)
+        grow = reply_ready & ((step_end - socks.snd_end).astype(I32) > 0)
+        socks = socks.replace(
+            snd_end=jnp.where(grow, step_end, socks.snd_end),
+            app_closed=jnp.where(reply_ready & (socks.snd_end == rtarget),
+                                 True, socks.app_closed),
+        )
+
+        # Sink policy: every host consumes what it receives (keeps windows
+        # open); orphaned CLOSEWAIT sockets (peer closed, nothing to send)
+        # close too.
+        socks = tcp.consume_all(socks)
+
+        return state.replace(app=a, socks=socks), em
+
+
+# ---------------------------------------------------------------------------
+# Assembly: graphs + per-host programs -> TgenState
+# ---------------------------------------------------------------------------
+
+
+def build_state(num_hosts: int, graphs: list, host_graph, host_start_t,
+                host_stop_t=None, resolve_peer=None):
+    """Concatenate parsed TgenGraphs into device tables.
+
+    graphs: list of TgenGraph.
+    host_graph: [H] int, graph index per host (-1 = no tgen program).
+    host_start_t / host_stop_t: [H] ns.
+    resolve_peer: callable "host:port" -> (host_index, port); required if
+      any graph has peers.
+    """
+    max_p = max([1] + [len(g.pause_s[i]) for g in graphs
+                       for i in range(g.num_nodes)])
+    max_peer = max([1] + [len(g.peers[i]) for g in graphs
+                          for i in range(g.num_nodes)])
+    ntype, nxt, sendsize, recvsize, count = [], [], [], [], []
+    pause_t = []
+    pause_n = []
+    peer_host, peer_port, peer_n = [], [], []
+    offsets = []
+    off = 0
+    for g in graphs:
+        offsets.append(off)
+        n = g.num_nodes
+        # peers propagate from the start node to every node of the graph so
+        # stream nodes can gather them without a second indirection.
+        g_ph = [-1] * max_peer
+        g_pp = [0] * max_peer
+        g_pn = 0
+        for i in range(n):
+            if g.peers[i]:
+                for j, spec in enumerate(g.peers[i][:max_peer]):
+                    hidx, port = resolve_peer(spec)
+                    g_ph[j], g_pp[j] = hidx, port
+                g_pn = len(g.peers[i][:max_peer])
+        for i in range(n):
+            ntype.append(int(g.ntype[i]))
+            nxt.append(off + int(g.nxt[i]) if g.nxt[i] >= 0 else -1)
+            sendsize.append(int(g.sendsize[i]))
+            recvsize.append(int(g.recvsize[i]))
+            count.append(int(g.count[i]))
+            ts = [int(round(s * SEC)) for s in g.pause_s[i]][:max_p]
+            pause_t.append(ts + [0] * (max_p - len(ts)))
+            pause_n.append(len(ts))
+            peer_host.append(list(g_ph))
+            peer_port.append(list(g_pp))
+            peer_n.append(g_pn)
+        off += n
+
+    hg = np.asarray(host_graph, np.int64)
+    cur0 = np.full(num_hosts, -1, np.int32)
+    for hh in range(num_hosts):
+        if hg[hh] >= 0:
+            g = graphs[int(hg[hh])]
+            # Server graphs (start node only / no successor) never run an
+            # interpreter; their listener is installed at assembly.
+            if g.serverport <= 0:
+                cur0[hh] = offsets[int(hg[hh])] + g.start_node
+
+    if host_stop_t is None:
+        host_stop_t = np.full(num_hosts, INV, np.int64)
+
+    zh = lambda dt: jnp.zeros((num_hosts,), dt)
+    return TgenState(
+        ntype=jnp.asarray(ntype, I32),
+        nxt=jnp.asarray(nxt, I32),
+        sendsize=jnp.asarray(sendsize, I64),
+        recvsize=jnp.asarray(recvsize, I64),
+        count=jnp.asarray(count, I64),
+        pause_t=jnp.asarray(pause_t, I64),
+        pause_n=jnp.asarray(pause_n, I32),
+        peer_host=jnp.asarray(peer_host, I32),
+        peer_port=jnp.asarray(peer_port, I32),
+        peer_n=jnp.asarray(peer_n, I32),
+        cur=jnp.asarray(cur0, I32),
+        start_t=jnp.asarray(host_start_t, I64),
+        stop_t=jnp.asarray(host_stop_t, I64),
+        started=zh(jnp.bool_),
+        finished=zh(jnp.bool_),
+        iters=zh(I64),
+        wait_until=jnp.full((num_hosts,), INV, I64),
+        t_next=jnp.full((num_hosts,), INV, I64),
+        stream_active=zh(jnp.bool_),
+        conn_ctr=zh(I64),
+        cur_send=zh(I64),
+        cur_recv=zh(I64),
+        streams_done=zh(I64),
+        streams_failed=zh(I64),
+    )

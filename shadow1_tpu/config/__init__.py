@@ -1,0 +1,9 @@
+"""Config front end: shadow.config.xml + GraphML -> runnable simulation.
+
+`shadowxml.parse` reads the reference's XML schema; `assemble.build`
+lowers it onto the TPU engine (the analog of master/slave setup,
+/root/reference/src/main/core/master.c:161-398).
+"""
+
+from .assemble import Assembled, build, load  # noqa: F401
+from .shadowxml import ShadowConfig, parse  # noqa: F401

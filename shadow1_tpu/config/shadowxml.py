@@ -1,0 +1,163 @@
+"""shadow.config.xml parsing.
+
+Implements the reference's simulation-spec schema
+(/root/reference/src/main/core/support/configuration.c per
+configuration.h:24-101): `<shadow stoptime bootstraptime>`, `<topology
+path|cdata>`, `<plugin id path>`, `<host id quantity iphint *hints
+bandwidthdown/up ...>` containing `<process plugin starttime stoptime
+arguments>`.  Existing reference configs parse unchanged; attributes tied
+to real-process execution (preload, startsymbol) are accepted and carried
+through for the future real-code substrate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import xml.etree.ElementTree as ET
+
+
+@dataclasses.dataclass
+class ProcessSpec:
+    plugin: str
+    starttime_s: int
+    arguments: str
+    stoptime_s: int | None = None
+    preload: str | None = None
+
+
+@dataclasses.dataclass
+class HostSpec:
+    id: str
+    processes: list
+    quantity: int = 1
+    iphint: str | None = None
+    citycodehint: str | None = None
+    countrycodehint: str | None = None
+    geocodehint: str | None = None
+    typehint: str | None = None
+    bandwidthdown_KiBps: int | None = None
+    bandwidthup_KiBps: int | None = None
+    interfacebuffer: int | None = None
+    socketrecvbuffer: int | None = None
+    socketsendbuffer: int | None = None
+    cpufrequency: int | None = None
+    loglevel: str | None = None
+    heartbeatfrequency_s: int | None = None
+    logpcap: bool = False
+    pcapdir: str | None = None
+
+    def hints(self) -> dict:
+        return {k: getattr(self, k) for k in
+                ("iphint", "citycodehint", "countrycodehint", "geocodehint",
+                 "typehint") if getattr(self, k)}
+
+
+@dataclasses.dataclass
+class PluginSpec:
+    id: str
+    path: str
+    startsymbol: str | None = None
+
+
+@dataclasses.dataclass
+class ShadowConfig:
+    stoptime_s: int
+    bootstrap_end_s: int
+    topology_path: str | None    # resolved against the config's directory
+    topology_cdata: str | None
+    plugins: dict               # id -> PluginSpec
+    hosts: list                 # [HostSpec]
+    environment: str | None = None
+    preload_path: str | None = None
+    base_dir: str = "."
+
+    def topology_source(self) -> str:
+        """What routing/graphml.load accepts: inline XML or a path."""
+        if self.topology_cdata:
+            return self.topology_cdata
+        if self.topology_path:
+            return self.topology_path
+        raise ValueError("config has no <topology>")
+
+
+def _int(el, name, default=None):
+    v = el.get(name)
+    return default if v is None else int(v)
+
+
+def parse(path_or_xml: str) -> ShadowConfig:
+    """Parse a shadow.config.xml file path or literal XML string."""
+    if path_or_xml.lstrip().startswith("<"):
+        text, base = path_or_xml, "."
+    else:
+        with open(path_or_xml) as f:
+            text = f.read()
+        base = os.path.dirname(os.path.abspath(path_or_xml))
+    root = ET.fromstring(text)
+    if root.tag != "shadow":
+        raise ValueError(f"expected <shadow> root, got <{root.tag}>")
+    stoptime = _int(root, "stoptime")
+    if stoptime is None:
+        raise ValueError("<shadow> requires stoptime")
+
+    topo_path = topo_cdata = None
+    plugins: dict = {}
+    hosts: list = []
+    for el in root:
+        if el.tag == "topology":
+            p = el.get("path")
+            if p:
+                p = os.path.expanduser(p)
+                topo_path = p if os.path.isabs(p) else os.path.join(base, p)
+            if el.text and el.text.strip():
+                topo_cdata = el.text.strip()
+        elif el.tag == "plugin":
+            pid = el.get("id")
+            plugins[pid] = PluginSpec(id=pid, path=el.get("path") or "",
+                                      startsymbol=el.get("startsymbol"))
+        elif el.tag == "host" or el.tag == "node":  # "node" = legacy alias
+            procs = []
+            for pe in el:
+                if pe.tag not in ("process", "application"):
+                    continue
+                st = pe.get("starttime") or pe.get("time")
+                procs.append(ProcessSpec(
+                    plugin=pe.get("plugin"),
+                    starttime_s=int(st) if st is not None else 0,
+                    arguments=pe.get("arguments") or "",
+                    stoptime_s=_int(pe, "stoptime"),
+                    preload=pe.get("preload"),
+                ))
+            hosts.append(HostSpec(
+                id=el.get("id"),
+                processes=procs,
+                quantity=_int(el, "quantity", 1) or 1,
+                iphint=el.get("iphint"),
+                citycodehint=el.get("citycodehint"),
+                countrycodehint=el.get("countrycodehint"),
+                geocodehint=el.get("geocodehint"),
+                typehint=el.get("typehint"),
+                bandwidthdown_KiBps=_int(el, "bandwidthdown"),
+                bandwidthup_KiBps=_int(el, "bandwidthup"),
+                interfacebuffer=_int(el, "interfacebuffer"),
+                socketrecvbuffer=_int(el, "socketrecvbuffer"),
+                socketsendbuffer=_int(el, "socketsendbuffer"),
+                cpufrequency=_int(el, "cpufrequency"),
+                loglevel=el.get("loglevel"),
+                heartbeatfrequency_s=_int(el, "heartbeatfrequency"),
+                logpcap=(el.get("logpcap") or "").lower() == "true",
+                pcapdir=el.get("pcapdir"),
+            ))
+
+    return ShadowConfig(
+        stoptime_s=stoptime,
+        bootstrap_end_s=_int(root, "bootstraptime", 0) or 0,
+        topology_path=topo_path,
+        topology_cdata=topo_cdata,
+        plugins=plugins,
+        hosts=hosts,
+        environment=root.get("environment"),
+        preload_path=root.get("preload"),
+        base_dir=base,
+    )

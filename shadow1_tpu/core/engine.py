@@ -288,21 +288,36 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     dropped = valid & (u >= rel)
     live = valid & ~dropped
 
-    # Allocate free pool slots to live emissions in deterministic flat order.
-    livef = live.reshape(-1)
-    order = jnp.cumsum(livef) - 1                      # [H*E]
-    free = pool.stage == STAGE_FREE
-    nmax = min(p, h * e)
+    # Allocate free pool slots to live emissions from the emitting host's
+    # own slab: the pool is partitioned into H contiguous slabs of K slots
+    # (see make_sim_state), so allocation is a per-slab scan of K elements
+    # -- no full-pool nonzero/cumsum per micro-step (which blew the TPU
+    # scoped-VMEM budget as a [P]-length u32 reduce-window at P=64k) and
+    # no cross-host allocation order to keep deterministic.
+    k = p // h
+    assert p == h * k, "pool capacity must be num_hosts * slab"
+    free = (pool.stage == STAGE_FREE).reshape(h, k)
+    fcum = jnp.cumsum(free.astype(I32), axis=1)        # [H,K] 1-based rank
+    n_free = fcum[:, -1]                               # [H]
+    live_rank = jnp.cumsum(live, axis=1) - 1           # [H,E] 0-based
+    # within[h,j] = index in slab h of the live_rank[h,j]-th free slot.
+    sel = free[:, None, :] & (fcum[:, None, :] - 1 == live_rank[:, :, None])
+    within = jnp.sum(sel * jnp.arange(k, dtype=I32)[None, None, :], axis=2)
+    have_slot = live & (live_rank < n_free[:, None])
     # Sentinel for "no slot" is `p`, NOT -1: negative scatter indices wrap
     # in XLA even under mode='drop'; only >= size is dropped.
-    free_idx = jnp.nonzero(free, size=nmax, fill_value=p)[0]
-    n_free = jnp.sum(free_idx < p)
-    slot = jnp.where(livef & (order < n_free),
-                     free_idx[jnp.clip(order, 0, nmax - 1)], p)
-    overflow = jnp.any(livef & (slot >= p))
+    slot = jnp.where(have_slot,
+                     jnp.arange(h, dtype=I32)[:, None] * k + within,
+                     p).reshape(-1)
+    overflow = jnp.any(live & ~have_slot)
 
     send_t = jnp.broadcast_to(tick_t[:, None], (h, e)).reshape(-1)
     arr_t = send_t + lat.reshape(-1)
+
+    # Only emissions that actually got a pool slot exist from here on:
+    # slab-exhausted ones are counted drops (pkts_dropped_pool below) and
+    # must not charge tokens, park, or count as sent.
+    placed = live & have_slot
 
     # --- NIC tx admission: direct-admit under the token budget, else park
     # in TX_QUEUED for _tx_drain (FIFO is preserved because any backlog
@@ -310,16 +325,16 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     tokens, last = nic.refill(hosts.tokens_tx, hosts.last_refill_tx,
                               params.bw_up_Bps, tick_t, active)
     sizes = _wire_bytes(em.proto, em.length).astype(I64) * nic.SCALE
-    nonloop_live = live & ~loop
-    sizes_nl = jnp.where(nonloop_live, sizes, 0)
+    nonloop = placed & ~loop
+    sizes_nl = jnp.where(nonloop, sizes, 0)
     prefix = jnp.cumsum(sizes_nl, axis=1)
     boot2 = (tick_t < params.bootstrap_end)[:, None]
     ok_budget = (hosts.tx_queued == 0)[:, None] & (prefix <= tokens[:, None])
-    admit = live & (loop | boot2 | ok_budget)
+    admit = placed & (loop | boot2 | ok_budget)
     spent = jnp.sum(jnp.where(admit & ~loop & ~boot2, sizes, 0), axis=1)
     tokens = tokens - spent
     admitf = admit.reshape(-1)
-    parked = live & ~admit
+    parked = placed & ~admit
     hosts = hosts.replace(
         tokens_tx=tokens, last_refill_tx=last,
         tx_queued=hosts.tx_queued +
@@ -359,12 +374,14 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
         status=sc(pool.status, status_v),
     )
 
-    sent_bytes = jnp.sum(jnp.where(live, em.length, 0), axis=1).astype(I64)
+    sent_bytes = jnp.sum(jnp.where(placed, em.length, 0), axis=1).astype(I64)
     hosts = hosts.replace(
         send_ctr=ctr + counts,
-        pkts_sent=hosts.pkts_sent + jnp.sum(live, axis=1),
+        pkts_sent=hosts.pkts_sent + jnp.sum(placed, axis=1),
         bytes_sent=hosts.bytes_sent + sent_bytes,
         pkts_dropped_inet=hosts.pkts_dropped_inet + jnp.sum(dropped, axis=1),
+        pkts_dropped_pool=hosts.pkts_dropped_pool +
+        jnp.sum(live & ~have_slot, axis=1),
     )
     err = state.err | jnp.where(overflow, ERR_POOL_OVERFLOW, 0).astype(jnp.int32)
     return state.replace(pool=pool, hosts=hosts, err=err)
@@ -495,3 +512,20 @@ def run_until(state: SimState, params, app, t_target):
     state, _, _ = jax.lax.while_loop(window_cond, window_body,
                                      (state, t_h0, gmin0))
     return state.replace(now=t_target)
+
+
+# One device launch covers this much simulated time: short enough that no
+# single launch trips device/tunnel watchdogs, long enough to amortize
+# dispatch (the compiled executable is reused -- t_target is traced).
+CHUNK_NS = 250 * simtime.SIMTIME_ONE_MILLISECOND
+
+
+def run_chunked(state: SimState, params, app, t_target: int,
+                chunk_ns: int = CHUNK_NS):
+    """Host-side loop of bounded `run_until` launches up to t_target."""
+    t = int(state.now)
+    t_target = int(t_target)
+    while t < t_target:
+        t = min(t + chunk_ns, t_target)
+        state = run_until(state, params, app, t)
+    return state

@@ -365,6 +365,9 @@ class HostTable:
     pkts_recv: jnp.ndarray     # [H] i64
     pkts_dropped_inet: jnp.ndarray   # [H] i64 reliability drops
     pkts_dropped_router: jnp.ndarray  # [H] i64 CoDel/overflow drops
+    pkts_dropped_pool: jnp.ndarray   # [H] i64 slab-exhaustion drops (the
+                                     # fixed-capacity escape hatch; also
+                                     # raises ERR_POOL_OVERFLOW)
 
     @property
     def num_hosts(self) -> int:
@@ -393,6 +396,7 @@ def make_host_table(num_hosts: int) -> HostTable:
         pkts_recv=_zeros(h, I64),
         pkts_dropped_inet=_zeros(h, I64),
         pkts_dropped_router=_zeros(h, I64),
+        pkts_dropped_pool=_zeros(h, I64),
     )
 
 
@@ -415,6 +419,11 @@ class SimState:
 
 def make_sim_state(num_hosts: int, sock_slots: int = 16,
                    pool_capacity: int = 1 << 15, app=None) -> SimState:
+    # The pool is partitioned into per-host slabs (engine._stage_emissions
+    # allocates from the emitting host's slab): round capacity up to a
+    # multiple of num_hosts, with at least 8 slots per host.
+    slab = max(8, -(-pool_capacity // num_hosts))
+    pool_capacity = num_hosts * slab
     return SimState(
         now=jnp.asarray(0, I64),
         pool=make_packet_pool(pool_capacity),

@@ -1,0 +1,88 @@
+"""Observability: per-host heartbeats + run summary (tracker analog).
+
+The reference Tracker logs per-host heartbeat CSV lines (bytes in/out,
+allocation, socket occupancy) at a configurable interval through the
+shadow logger (/root/reference/src/main/host/tracker.c:419-607), consumed
+by src/tools/parse-shadow.py.  Here the per-host counters already live in
+dense device arrays (HostTable), so a heartbeat is one device_get of the
+counter block per interval, diffed host-side and appended to
+`heartbeat.csv` in the data directory; `tools/parse.py` aggregates them.
+
+The run summary includes an object census (live sockets and packet-pool
+occupancy by lifecycle stage) -- the analog of the reference's
+ObjectCounter leak check printed at slave teardown (slave.c:480-498).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from .core import simtime
+from .core.state import (SOCK_FREE, SOCK_TCP, SOCK_UDP, STAGE_FREE,
+                         STAGE_IN_FLIGHT, STAGE_RX_QUEUED, STAGE_TX_QUEUED)
+
+SEC = simtime.SIMTIME_ONE_SECOND
+
+_FIELDS = ("bytes_sent", "bytes_recv", "pkts_sent", "pkts_recv",
+           "pkts_dropped_inet", "pkts_dropped_router")
+
+
+class Tracker:
+    """Appends per-host heartbeat rows; one instance per run."""
+
+    HEADER = ("time_s,host,bytes_sent_per_s,bytes_recv_per_s,"
+              "pkts_sent,pkts_recv,drops_inet,drops_router,"
+              "tx_queued,rx_queued\n")
+
+    def __init__(self, data_dir: str, hostnames, interval_s: int = 1):
+        self.dir = data_dir
+        self.hostnames = list(hostnames)
+        self.interval_ns = interval_s * SEC
+        os.makedirs(data_dir, exist_ok=True)
+        self.path = os.path.join(data_dir, "heartbeat.csv")
+        with open(self.path, "w") as f:
+            f.write(self.HEADER)
+        self._last = {f: np.zeros(len(self.hostnames), np.int64)
+                      for f in _FIELDS}
+        self._last_t = 0
+
+    def heartbeat(self, state, now_ns: int):
+        cur = {f: np.asarray(getattr(state.hosts, f)) for f in _FIELDS}
+        dt_s = max((now_ns - self._last_t) / SEC, 1e-9)
+        txq = np.asarray(state.hosts.tx_queued)
+        rxq = np.asarray(state.hosts.rx_queued)
+        with open(self.path, "a") as f:
+            for i, name in enumerate(self.hostnames):
+                d = {k: int(cur[k][i] - self._last[k][i]) for k in _FIELDS}
+                f.write(f"{now_ns / SEC:.3f},{name},"
+                        f"{d['bytes_sent'] / dt_s:.1f},"
+                        f"{d['bytes_recv'] / dt_s:.1f},"
+                        f"{d['pkts_sent']},{d['pkts_recv']},"
+                        f"{d['pkts_dropped_inet']},{d['pkts_dropped_router']},"
+                        f"{int(txq[i])},{int(rxq[i])}\n")
+        self._last = cur
+        self._last_t = now_ns
+
+    def summary(self, summary: dict, state):
+        summary = dict(summary)
+        summary["object_census"] = census(state)
+        with open(os.path.join(self.dir, "summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+
+
+def census(state) -> dict:
+    """Live-object census from the dense tables (ObjectCounter analog)."""
+    stage = np.asarray(state.pool.stage)
+    stype = np.asarray(state.socks.stype)
+    return {
+        "packets_free": int((stage == STAGE_FREE).sum()),
+        "packets_tx_queued": int((stage == STAGE_TX_QUEUED).sum()),
+        "packets_in_flight": int((stage == STAGE_IN_FLIGHT).sum()),
+        "packets_rx_queued": int((stage == STAGE_RX_QUEUED).sum()),
+        "sockets_free": int((stype == SOCK_FREE).sum()),
+        "sockets_udp": int((stype == SOCK_UDP).sum()),
+        "sockets_tcp": int((stype == SOCK_TCP).sum()),
+    }

@@ -1,0 +1,117 @@
+"""Config front end + tgen interpreter tests.
+
+The north-star contract (BASELINE.md): existing shadow.config.xml +
+GraphML files drive the simulation unchanged.  These tests run the
+bundled example configs end-to-end -- the analog of the reference's
+config-driven ctest workloads (src/test/*/CMakeLists.txt).
+"""
+
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from shadow1_tpu.apps import tgen as tgen_app
+from shadow1_tpu.config import assemble, shadowxml
+from shadow1_tpu.core import engine, simtime
+from shadow1_tpu.routing.dns import DNS, is_restricted
+
+SEC = simtime.SIMTIME_ONE_SECOND
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+class TestShadowXml:
+    def test_parse_example(self):
+        cfg = shadowxml.parse(os.path.join(EXAMPLES, "tgen-2host",
+                                           "shadow.config.xml"))
+        assert cfg.stoptime_s == 60
+        assert "tgen" in cfg.plugins
+        assert [h.id for h in cfg.hosts] == ["server", "client"]
+        assert cfg.hosts[1].processes[0].starttime_s == 2
+        assert cfg.topology_cdata and "graphml" in cfg.topology_cdata
+
+    def test_quantity_expansion(self):
+        cfg = shadowxml.parse(os.path.join(EXAMPLES, "tgen-100host",
+                                           "shadow.config.xml"))
+        names, specs = assemble._expand_hosts(cfg)
+        assert len(names) == 100
+        assert names[0] == "fileserver"
+        assert names[1] == "web1" and names[99] == "web99"
+
+
+class TestDns:
+    def test_unique_ips_skip_reserved(self):
+        dns = DNS()
+        addrs = [dns.register(i, f"h{i}") for i in range(50)]
+        ips = [a.ip for a in addrs]
+        assert len(set(ips)) == 50
+        assert not any(is_restricted(ip) for ip in ips)
+
+    def test_iphint_and_resolution(self):
+        dns = DNS()
+        a = dns.register(0, "server", requested_ip="11.0.0.1")
+        assert a.ip_str == "11.0.0.1"
+        # restricted hint is ignored, a fresh IP assigned
+        b = dns.register(1, "client", requested_ip="192.168.1.1")
+        assert b.ip_str != "192.168.1.1"
+        assert dns.resolve_name("server").host_index == 0
+        assert dns.resolve_name("11.0.0.1").host_index == 0
+        assert dns.resolve_ip(a.ip).name == "server"
+
+
+class TestTgenParse:
+    def test_sizes(self):
+        assert tgen_app.parse_size("1 MiB") == 1 << 20
+        assert tgen_app.parse_size("100 kb") == 100_000
+        assert tgen_app.parse_size("512") == 512
+
+    def test_client_graph(self):
+        g = tgen_app.parse_tgen(os.path.join(EXAMPLES, "tgen-2host",
+                                             "tgen.client.graphml.xml"))
+        assert g.num_nodes == 4
+        i = g.node_ids.index("stream")
+        assert g.sendsize[i] == 50 * 1024
+        assert g.recvsize[i] == 200 * 1024
+        assert g.peers[g.start_node] == ["server:8888"]
+        assert g.serverport == 0
+
+    def test_server_graph(self):
+        g = tgen_app.parse_tgen(os.path.join(EXAMPLES, "tgen-2host",
+                                             "tgen.server.graphml.xml"))
+        assert g.serverport == 8888
+
+
+class TestEndToEnd:
+    def test_two_host_tgen_transfer(self):
+        asm = assemble.load(os.path.join(EXAMPLES, "tgen-2host",
+                                         "shadow.config.xml"), seed=3)
+        st = asm.state
+        for t in range(1, 31):
+            st = engine.run_until(st, asm.params, asm.app, t * SEC)
+            a = st.app
+            if bool(jnp.all(a.finished | (a.cur < 0))):
+                break
+        a = st.app
+        assert int(st.err) == 0
+        # Client completed its 3 streams (count=3 in the action graph).
+        assert int(a.streams_done[1]) == 3
+        assert int(a.streams_failed.sum()) == 0
+        # Each stream moved 50 KiB up + 200 KiB down (host-level tracker
+        # counters survive socket-slot reuse; per-socket ones reset).
+        assert int(st.hosts.bytes_recv[0]) >= 3 * 50 * 1024
+        assert int(st.hosts.bytes_recv[1]) >= 3 * 200 * 1024
+
+    def test_deterministic_across_runs(self):
+        path = os.path.join(EXAMPLES, "tgen-2host", "shadow.config.xml")
+        outs = []
+        for _ in range(2):
+            asm = assemble.load(path, seed=9)
+            st = engine.run_until(asm.state, asm.params, asm.app, 12 * SEC)
+            outs.append(st)
+        assert jnp.array_equal(outs[0].app.streams_done,
+                               outs[1].app.streams_done)
+        assert jnp.array_equal(outs[0].hosts.pkts_sent,
+                               outs[1].hosts.pkts_sent)
+        assert jnp.array_equal(outs[0].socks.bytes_recv,
+                               outs[1].socks.bytes_recv)

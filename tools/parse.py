@@ -1,0 +1,63 @@
+#!/usr/bin/env python3
+"""Parse a shadow1-tpu data directory into aggregate stats.
+
+The analog of the reference's src/tools/parse-shadow.py (which digests
+shadow-heartbeat log lines into json): reads `heartbeat.csv` +
+`summary.json` written by --data-directory runs and prints per-host and
+whole-run aggregates as one JSON document.
+
+Usage: tools/parse.py <data-directory> [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import sys
+
+
+def parse_dir(data_dir: str) -> dict:
+    hb_path = os.path.join(data_dir, "heartbeat.csv")
+    out: dict = {"hosts": {}, "run": None}
+    if os.path.exists(hb_path):
+        with open(hb_path) as f:
+            for row in csv.DictReader(f):
+                h = out["hosts"].setdefault(row["host"], {
+                    "samples": 0, "peak_recv_Bps": 0.0, "peak_send_Bps": 0.0,
+                    "pkts_sent": 0, "pkts_recv": 0,
+                    "drops_inet": 0, "drops_router": 0,
+                })
+                h["samples"] += 1
+                h["peak_recv_Bps"] = max(h["peak_recv_Bps"],
+                                         float(row["bytes_recv_per_s"]))
+                h["peak_send_Bps"] = max(h["peak_send_Bps"],
+                                         float(row["bytes_sent_per_s"]))
+                h["pkts_sent"] += int(row["pkts_sent"])
+                h["pkts_recv"] += int(row["pkts_recv"])
+                h["drops_inet"] += int(row["drops_inet"])
+                h["drops_router"] += int(row["drops_router"])
+    sm_path = os.path.join(data_dir, "summary.json")
+    if os.path.exists(sm_path):
+        with open(sm_path) as f:
+            out["run"] = json.load(f)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("data_dir")
+    ap.add_argument("--json", default=None, help="also write to this file")
+    args = ap.parse_args(argv)
+    result = parse_dir(args.data_dir)
+    text = json.dumps(result, indent=2, sort_keys=True)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(text + "\n")
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
