@@ -1,0 +1,156 @@
+// Payload arena: host-side storage for packet payload bytes.
+//
+// The device-side engine moves packet *metadata* (a dense SoA pool where
+// each packet carries a payload_id); actual bytes never belong on the
+// accelerator.  This arena is the native analog of the reference's
+// refcounted Payload shared across hosts
+// (/root/reference/src/main/routing/payload.c:16-23): one allocation per
+// logical payload, shared by every in-flight copy of the packet, freed
+// when the last reference drops.
+//
+// Design: slab-of-slots with an intrusive free list.  Ids are
+// (index | generation<<32) so stale ids from a previous occupancy of the
+// same slot are detected instead of silently aliasing.  Thread-safe via a
+// single mutex -- contention is irrelevant at the host-side call rates
+// (payload churn is bounded by app I/O, not the device hot loop).
+//
+// C ABI so Python binds via ctypes (no pybind11 in this toolchain).
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Slot {
+  std::vector<uint8_t> data;
+  uint32_t generation = 0;
+  int32_t refcount = 0;   // 0 = free
+  int64_t next_free = -1;
+};
+
+struct Arena {
+  std::mutex mu;
+  std::vector<Slot> slots;
+  int64_t free_head = -1;
+  uint64_t live = 0;
+  uint64_t live_bytes = 0;
+  uint64_t total_allocs = 0;
+};
+
+constexpr uint64_t kIndexMask = 0xFFFFFFFFull;
+
+inline int64_t slot_of(uint64_t id) {
+  return static_cast<int64_t>(id & kIndexMask);
+}
+inline uint32_t gen_of(uint64_t id) {
+  return static_cast<uint32_t>(id >> 32);
+}
+inline uint64_t make_id(int64_t index, uint32_t gen) {
+  return (static_cast<uint64_t>(gen) << 32) | static_cast<uint64_t>(index);
+}
+
+Slot* checked_slot(Arena* a, uint64_t id) {
+  int64_t idx = slot_of(id);
+  if (idx < 0 || idx >= static_cast<int64_t>(a->slots.size())) return nullptr;
+  Slot* s = &a->slots[idx];
+  if (s->refcount <= 0 || s->generation != gen_of(id)) return nullptr;
+  return s;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque arena handle.
+void* payload_arena_create() { return new Arena(); }
+
+void payload_arena_destroy(void* h) { delete static_cast<Arena*>(h); }
+
+// Store `len` bytes; returns a payload id with refcount 1, or 0 on error
+// (0 is never a valid id: slot 0/gen 0 is burned at creation).
+uint64_t payload_arena_put(void* h, const uint8_t* data, uint64_t len) {
+  Arena* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  if (a->slots.empty()) {
+    // Burn slot 0 so id 0 stays invalid.
+    a->slots.emplace_back();
+    a->slots[0].generation = 1;
+  }
+  int64_t idx;
+  if (a->free_head >= 0) {
+    idx = a->free_head;
+    a->free_head = a->slots[idx].next_free;
+  } else {
+    idx = static_cast<int64_t>(a->slots.size());
+    a->slots.emplace_back();
+  }
+  Slot* s = &a->slots[idx];
+  s->data.assign(data, data + len);
+  s->generation++;
+  s->refcount = 1;
+  a->live++;
+  a->live_bytes += len;
+  a->total_allocs++;
+  return make_id(idx, s->generation);
+}
+
+// Share the payload with one more packet copy (reference payload_ref).
+int payload_arena_ref(void* h, uint64_t id) {
+  Arena* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  Slot* s = checked_slot(a, id);
+  if (!s) return -1;
+  s->refcount++;
+  return 0;
+}
+
+// Drop one reference; frees the slot at zero (reference payload_unref).
+int payload_arena_unref(void* h, uint64_t id) {
+  Arena* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  Slot* s = checked_slot(a, id);
+  if (!s) return -1;
+  if (--s->refcount == 0) {
+    a->live--;
+    a->live_bytes -= s->data.size();
+    s->data.clear();
+    s->data.shrink_to_fit();
+    s->next_free = a->free_head;
+    a->free_head = slot_of(id);
+  }
+  return 0;
+}
+
+// Payload size in bytes, or -1 for an invalid/stale id.
+int64_t payload_arena_size(void* h, uint64_t id) {
+  Arena* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  Slot* s = checked_slot(a, id);
+  return s ? static_cast<int64_t>(s->data.size()) : -1;
+}
+
+// Copy up to `cap` bytes into `out`; returns bytes copied or -1.
+int64_t payload_arena_get(void* h, uint64_t id, uint8_t* out, uint64_t cap) {
+  Arena* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  Slot* s = checked_slot(a, id);
+  if (!s) return -1;
+  uint64_t n = s->data.size() < cap ? s->data.size() : cap;
+  std::memcpy(out, s->data.data(), n);
+  return static_cast<int64_t>(n);
+}
+
+// Live payload count / bytes / lifetime allocations (the object-census
+// hook, reference object_counter.c).
+void payload_arena_stats(void* h, uint64_t* live, uint64_t* live_bytes,
+                         uint64_t* total) {
+  Arena* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  *live = a->live;
+  *live_bytes = a->live_bytes;
+  *total = a->total_allocs;
+}
+
+}  // extern "C"

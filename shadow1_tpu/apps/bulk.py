@@ -88,7 +88,7 @@ class Bulk:
         # 2. Running clients: stream bytes into the send buffer, then close.
         running = active & a.is_client & (a.phase == 1)
         target_end = (jnp.uint32(1) + a.total.astype(U32))
-        socks = tcp.write_v(socks, running, slot, target_end)
+        socks = tcp.write_v(socks, running, slot, target_end, now=tick_t)
         rows = jnp.arange(h)
         sslot = jnp.clip(slot, 0, socks.slots - 1)
         all_written = socks.snd_end[rows, sslot] == target_end

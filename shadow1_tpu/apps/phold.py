@@ -37,6 +37,10 @@ class PholdState:
 class Phold:
     """Static app config; hashable so jitted engine calls cache per config."""
 
+    # Pure-UDP workload: the engine traces the TCP machine out of the
+    # compiled step entirely (engine._uses_tcp).
+    uses_tcp = False
+
     def __init__(self, mean_delay_ns: int, sock_slot: int = 0):
         self.mean_delay_ns = int(mean_delay_ns)
         self.sock_slot = int(sock_slot)

@@ -278,7 +278,7 @@ class Tgen:
         # when fully written.
         streaming = at_stream & a.stream_active
         target = (jnp.uint32(1) + a.cur_send.astype(U32))
-        socks = tcp.write_v(socks, streaming, slot, target)
+        socks = tcp.write_v(socks, streaming, slot, target, now=tick_t)
         sslot = jnp.clip(slot, 0, socks.slots - 1)
         written = socks.snd_end[rows, sslot] == target
         socks = tcp.close_v(socks, streaming & written, slot)

@@ -1,0 +1,69 @@
+"""Checkpoint / resume: serialize the whole simulation to disk.
+
+The reference has no checkpointing at all (SURVEY.md §5 flags it as a
+cheap win for the rebuild): Shadow runs must complete in one process
+lifetime.  Here the entire simulation -- packet pool, socket table, host
+counters, application state, and the run's NetParams -- is one pytree of
+dense arrays, so a checkpoint is a flat .npz of its leaves and resume is
+bitwise-exact: run(save -> load -> continue) equals run-straight.
+
+Format: numpy .npz with keys "s<N>" / "p<N>" for the N-th leaf of the
+state / params pytree (in tree order), plus tree-structure fingerprints
+to catch template mismatches at load time.  Loading requires a *template*
+(state, params) pair built the same way as the saved run (same config,
+shapes, apps); the template supplies the pytree structure, the file
+supplies every value.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+
+def _fingerprint(tree) -> str:
+    return str(jax.tree_util.tree_structure(tree))
+
+
+def save(path: str, state, params) -> None:
+    """Write state + params to `path` (.npz)."""
+    s_leaves = jax.tree_util.tree_leaves(state)
+    p_leaves = jax.tree_util.tree_leaves(params)
+    out = {f"s{i}": np.asarray(x) for i, x in enumerate(s_leaves)}
+    out.update({f"p{i}": np.asarray(x) for i, x in enumerate(p_leaves)})
+    out["_s_struct"] = np.array(_fingerprint(state))
+    out["_p_struct"] = np.array(_fingerprint(params))
+    with open(path, "wb") as f:
+        np.savez(f, **out)
+
+
+def load(path: str, template_state, template_params):
+    """Rebuild (state, params) from `path` using the templates' structure.
+
+    Every leaf value comes from the file; shapes and dtypes must match the
+    template (same config/apps), which is also verified structurally.
+    """
+    with np.load(path, allow_pickle=False) as z:
+        if str(z["_s_struct"]) != _fingerprint(template_state) or \
+                str(z["_p_struct"]) != _fingerprint(template_params):
+            raise ValueError(
+                "checkpoint structure does not match the template "
+                "(different config, app, or version)")
+
+        def rebuild(template, prefix):
+            leaves, treedef = jax.tree_util.tree_flatten(template)
+            vals = []
+            for i, leaf in enumerate(leaves):
+                v = z[f"{prefix}{i}"]
+                want = jax.numpy.asarray(leaf)
+                if v.shape != want.shape or v.dtype != want.dtype:
+                    raise ValueError(
+                        f"checkpoint leaf {prefix}{i} is {v.dtype}{v.shape}, "
+                        f"template wants {want.dtype}{want.shape}")
+                vals.append(jax.numpy.asarray(v))
+            return jax.tree_util.tree_unflatten(treedef, vals)
+
+        state = rebuild(template_state, "s")
+        params = rebuild(template_params, "p")
+    return state, params

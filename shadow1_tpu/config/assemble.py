@@ -98,10 +98,12 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
         bw_up[i], bw_dn[i] = up * _KIB, dn * _KIB
 
     # --- routing matrices -------------------------------------------------
-    lat_ns, rel = apsp.build_matrices(
+    lat_ns, rel, jit_ns = apsp.build_matrices(
         jnp.asarray(topo.lat_ms), jnp.asarray(topo.edge_rel),
         self_lat_ms=jnp.asarray(topo.self_lat_ms),
-        self_rel=jnp.asarray(topo.self_rel))
+        self_rel=jnp.asarray(topo.self_rel),
+        edge_jitter_ms=jnp.asarray(topo.jitter_ms),
+        self_jitter_ms=jnp.asarray(topo.self_jitter_ms))
 
     params = make_net_params(
         latency_ns=lat_ns, reliability=rel,
@@ -110,6 +112,7 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
         seed=seed,
         stop_time=cfg.stoptime_s * SEC,
         bootstrap_end=cfg.bootstrap_end_s * SEC,
+        jitter_ns=jit_ns,
     )
 
     # --- processes -> modeled apps ---------------------------------------

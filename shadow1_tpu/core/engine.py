@@ -67,6 +67,12 @@ def _seg_min(values, seg, num, mask):
 # ---------------------------------------------------------------------------
 
 
+def _uses_tcp(app) -> bool:
+    """Static app capability: apps that never open TCP sockets (pure-UDP
+    phold) let the whole TCP machine trace away from the compiled step."""
+    return getattr(app, "uses_tcp", True)
+
+
 def next_times(state: SimState, params, app):
     """Per-host earliest pending event time [H] and its global min."""
     pool, socks, hosts = state.pool, state.socks, state.hosts
@@ -75,16 +81,17 @@ def next_times(state: SimState, params, app):
     inflight = pool.stage == STAGE_IN_FLIGHT
     t_arr = _seg_min(pool.time, pool.dst, h, inflight)
 
-    t_tmr = jnp.minimum(
-        jnp.min(socks.t_rto, axis=1),
-        jnp.minimum(jnp.min(socks.t_delack, axis=1),
-                    jnp.min(socks.t_tw, axis=1)),
-    )
-
-    t_app = app.next_time(state) if app is not None else jnp.full((h,), INV, I64)
-
-    t_h = jnp.minimum(jnp.minimum(t_arr, t_tmr),
-                      jnp.minimum(t_app, hosts.t_resume))
+    t_h = jnp.minimum(t_arr, hosts.t_resume)
+    if _uses_tcp(app):
+        t_tmr = jnp.minimum(
+            jnp.minimum(jnp.min(socks.t_rto, axis=1),
+                        jnp.min(socks.t_persist, axis=1)),
+            jnp.minimum(jnp.min(socks.t_delack, axis=1),
+                        jnp.min(socks.t_tw, axis=1)),
+        )
+        t_h = jnp.minimum(t_h, t_tmr)
+    if app is not None:
+        t_h = jnp.minimum(t_h, app.next_time(state))
     return t_h, jnp.min(t_h)
 
 
@@ -98,6 +105,21 @@ def _wire_bytes(proto, length):
     reference packet_getTotalSize with CONFIG_HEADER_SIZE_*)."""
     return length + jnp.where(proto == PROTO_TCP, TCP_HEADER_SIZE,
                               UDP_HEADER_SIZE)
+
+
+def _packet_latency(params, vs, vd, src, ctr):
+    """Path latency with the per-packet jitter draw: uniform in
+    +/- jitter_ns, keyed by (src, per-src counter) so the same packet
+    draws the same perturbation wherever its departure is computed
+    (reference carries per-edge jitter, topology.c:81-105)."""
+    lat = params.latency_ns[vs, vd]
+    jit = params.jitter_ns[vs, vd]
+    key = rng.purpose_key(params.seed_key, rng.PURPOSE_JITTER)
+    u = rng.keyed_uniform(key, src, ctr.astype(jnp.uint32),
+                          (ctr >> 32).astype(jnp.uint32))
+    delta = ((2.0 * u - 1.0) * jit.astype(jnp.float32)).astype(I64)
+    return jnp.maximum(lat + jnp.where(jit > 0, delta, 0),
+                       simtime.SIMTIME_ONE_NANOSECOND)
 
 
 def _select_queued(pool, seg, stage, tick_t, active, h):
@@ -222,9 +244,10 @@ def _deliver(state: SimState, params, em, tick_t, pool_slot, chosen, app):
     state = state.replace(socks=socks)
 
     # TCP
-    tcp_mask = have & (proto == PROTO_TCP)
-    state, em = tcp_mod.process_arrivals(state, params, em, tick_t, slot,
-                                         tcp_mask)
+    if _uses_tcp(app):
+        tcp_mask = have & (proto == PROTO_TCP)
+        state, em = tcp_mod.process_arrivals(state, params, em, tick_t, slot,
+                                             tcp_mask)
 
     # Consume delivered packets & account (elementwise via the [P] mask --
     # no duplicate-index scatters).
@@ -273,10 +296,11 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     ctr2 = ctr[:, None] + rank
     pkt_id2 = (src2.astype(I64) << 40) | ctr2
 
-    # Routing: latency + reliability, loopback shortcut.
+    # Routing: latency (+ per-packet jitter) + reliability, loopback
+    # shortcut.
     vs = params.host_vertex[src2]
     vd = params.host_vertex[jnp.clip(em.dst, 0, params.host_vertex.shape[0] - 1)]
-    lat = params.latency_ns[vs, vd]
+    lat = _packet_latency(params, vs, vd, src2, ctr2)
     rel = params.reliability[vs, vd]
     loop = em.dst == src2
     lat = jnp.where(loop, simtime.SIMTIME_ONE_NANOSECOND, lat)
@@ -297,12 +321,14 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     k = p // h
     assert p == h * k, "pool capacity must be num_hosts * slab"
     free = (pool.stage == STAGE_FREE).reshape(h, k)
-    fcum = jnp.cumsum(free.astype(I32), axis=1)        # [H,K] 1-based rank
-    n_free = fcum[:, -1]                               # [H]
+    # Sort keys put free slots first in ascending index order, so entry r
+    # of `order` is the r-th free slot of the slab.
+    slab_ids = jnp.arange(k, dtype=I32)[None, :]
+    order = jnp.argsort(jnp.where(free, slab_ids, slab_ids + k), axis=1)
+    n_free = jnp.sum(free, axis=1)                     # [H]
     live_rank = jnp.cumsum(live, axis=1) - 1           # [H,E] 0-based
-    # within[h,j] = index in slab h of the live_rank[h,j]-th free slot.
-    sel = free[:, None, :] & (fcum[:, None, :] - 1 == live_rank[:, :, None])
-    within = jnp.sum(sel * jnp.arange(k, dtype=I32)[None, None, :], axis=2)
+    within = jnp.take_along_axis(order, jnp.clip(live_rank, 0, k - 1),
+                                 axis=1)               # [H,E]
     have_slot = live & (live_rank < n_free[:, None])
     # Sentinel for "no slot" is `p`, NOT -1: negative scatter indices wrap
     # in XLA even under mode='drop'; only >= size is dropped.
@@ -409,11 +435,15 @@ def _tx_drain(state: SimState, params, tick_t, active):
     tokens = tokens - jnp.where(funded & ~boot, size, 0)
 
     # Departure: arrival = now + path latency (drop draw already happened
-    # at staging, keyed by pkt_id, so loss is independent of queueing).
+    # at staging, keyed by pkt_id, so loss is independent of queueing; the
+    # jitter draw keys on the same (src, ctr) identity).
     nv = params.host_vertex.shape[0]
     vs = params.host_vertex[jnp.clip(pool.src[slot], 0, h - 1)]
     vd = params.host_vertex[jnp.clip(pool.dst[slot], 0, nv - 1)]
-    arr = tick_t + params.latency_ns[vs, vd]
+    pid = pool.pkt_id[slot]
+    arr = tick_t + _packet_latency(params, vs, vd,
+                                   (pid >> 40).astype(I32),
+                                   pid & ((jnp.int64(1) << 40) - 1))
     chosen_dep = chosen & funded[pool.src]
     pool = pool.replace(
         stage=jnp.where(chosen_dep, STAGE_IN_FLIGHT, pool.stage),
@@ -464,7 +494,8 @@ def microstep(state: SimState, params, app, t_h, window_end):
     state, em = _deliver(state, params, em, tick_t, pool_slot, chosen, app)
 
     # Phase B: transport timers.
-    state, em = tcp_mod.run_timers(state, params, em, tick_t, active)
+    if _uses_tcp(app):
+        state, em = tcp_mod.run_timers(state, params, em, tick_t, active)
 
     # Phase C: application tick.
     if app is not None:
@@ -472,7 +503,8 @@ def microstep(state: SimState, params, app, t_h, window_end):
 
     # Phase D: TCP transmission, flush staged emissions through the NIC tx
     # bucket (direct-admit or park), then drain parked packets.
-    state, em = tcp_mod.transmit(state, params, em, tick_t, active)
+    if _uses_tcp(app):
+        state, em = tcp_mod.transmit(state, params, em, tick_t, active)
     state = _stage_emissions(state, params, em, tick_t, active)
     state = _tx_drain(state, params, tick_t, active)
     return state

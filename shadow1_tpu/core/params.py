@@ -24,6 +24,9 @@ class NetParams:
 
     latency_ns: jnp.ndarray     # [V,V] i64 one-way latency along chosen path
     reliability: jnp.ndarray    # [V,V] f32 end-to-end delivery probability
+    jitter_ns: jnp.ndarray      # [V,V] i64 jitter amplitude: per-packet
+                                # latency is perturbed uniformly in +/- this
+                                # (reference edge attr, topology.c:81-105)
     host_vertex: jnp.ndarray    # [H] i32 topology vertex each host attached to
     bw_up_Bps: jnp.ndarray      # [H] i64 upstream bytes/sec
     bw_down_Bps: jnp.ndarray    # [H] i64 downstream bytes/sec
@@ -55,17 +58,23 @@ def make_net_params(
     stop_time: int = simtime.SIMTIME_ONE_SECOND,
     bootstrap_end: int = 0,
     min_latency_ns=None,
+    jitter_ns=None,
 ) -> NetParams:
     from . import rng
 
     latency_ns = jnp.asarray(latency_ns, I64)
+    if jitter_ns is None:
+        jitter_ns = jnp.zeros_like(latency_ns)
+    jitter_ns = jnp.asarray(jitter_ns, I64)
     if min_latency_ns is None:
         # Minimum positive off-diagonal latency bounds the lookahead window,
         # like the reference's min time jump with a 10ms default when the
-        # topology gives nothing (master.c:133-159).
+        # topology gives nothing (master.c:133-159).  Jitter can shorten a
+        # path, so the conservative bound subtracts it.
         v = latency_ns.shape[0]
-        off = jnp.where(jnp.eye(v, dtype=bool), jnp.asarray(simtime.SIMTIME_INVALID, I64), latency_ns)
-        off = jnp.where(off <= 0, jnp.asarray(simtime.SIMTIME_INVALID, I64), off)
+        eff = jnp.maximum(latency_ns - jitter_ns, 1)
+        off = jnp.where(jnp.eye(v, dtype=bool), jnp.asarray(simtime.SIMTIME_INVALID, I64), eff)
+        off = jnp.where(latency_ns <= 0, jnp.asarray(simtime.SIMTIME_INVALID, I64), off)
         m = jnp.min(off)
         min_latency_ns = jnp.where(
             m == simtime.SIMTIME_INVALID,
@@ -75,6 +84,7 @@ def make_net_params(
     return NetParams(
         latency_ns=latency_ns,
         reliability=jnp.asarray(reliability, F32),
+        jitter_ns=jitter_ns,
         host_vertex=jnp.asarray(host_vertex, I32),
         bw_up_Bps=jnp.asarray(bw_up_Bps, I64),
         bw_down_Bps=jnp.asarray(bw_down_Bps, I64),

@@ -61,6 +61,7 @@ PROTO_UDP = 17
 TCP_FLAG_FIN = 1
 TCP_FLAG_SYN = 2
 TCP_FLAG_RST = 4
+TCP_FLAG_PSH = 8   # used as the zero-window probe marker (forces an ACK)
 TCP_FLAG_ACK = 16
 
 # Socket slot types.
@@ -248,7 +249,11 @@ class SocketTable:
     t_rto: jnp.ndarray        # [H,S] i64 retransmit timer expiry, SIMTIME_INVALID = off
     t_delack: jnp.ndarray     # [H,S] i64 delayed-ACK timer
     t_tw: jnp.ndarray         # [H,S] i64 TIME_WAIT / misc timer
+    t_persist: jnp.ndarray    # [H,S] i64 zero-window probe timer
     delack_pending: jnp.ndarray  # [H,S] i32 segments since last ACK sent
+    # --- receive-buffer autotuning (reference tcp.c:535-561) ---
+    at_bytes: jnp.ndarray     # [H,S] i64 bytes delivered since last adjust
+    at_last: jnp.ndarray      # [H,S] i64 time of last adjustment
 
     # --- UDP datagram ring ---
     udp_head: jnp.ndarray     # [H,S] i32
@@ -310,7 +315,10 @@ def make_socket_table(num_hosts: int, slots: int) -> SocketTable:
         t_rto=_full(hs, I64, simtime.SIMTIME_INVALID),
         t_delack=_full(hs, I64, simtime.SIMTIME_INVALID),
         t_tw=_full(hs, I64, simtime.SIMTIME_INVALID),
+        t_persist=_full(hs, I64, simtime.SIMTIME_INVALID),
         delack_pending=_zeros(hs, I32),
+        at_bytes=_zeros(hs, I64),
+        at_last=_zeros(hs, I64),
         udp_head=_zeros(hs, I32),
         udp_count=_zeros(hs, I32),
         udp_src=_full(hs + (UDP_RING,), I32, -1),

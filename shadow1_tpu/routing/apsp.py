@@ -37,28 +37,35 @@ from ..core.simtime import SIMTIME_ONE_MILLISECOND, TIME_DTYPE
 INF_MS = 1e12
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def floyd_warshall(lat_ms: jnp.ndarray, rel: jnp.ndarray):
-    """Relax [V,V] f32 latency (ms) + reliability through every vertex.
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def floyd_warshall(lat_ms: jnp.ndarray, rel: jnp.ndarray,
+                   jit_ms: jnp.ndarray):
+    """Relax [V,V] f32 latency (ms) + reliability + jitter through every
+    vertex.
 
     Plain k-loop FW: V iterations of O(V^2) vectorized relaxations; one
-    compiled fori_loop, VPU-bound, run once at topology load.
+    compiled fori_loop, VPU-bound, run once at topology load.  Reliability
+    multiplies and jitter sums along the min-latency path (the carried
+    quantities update wherever the latency strictly improves).
     """
 
     def body(k, carry):
-        lat, rel = carry
+        lat, rel, jit = carry
         through = lat[:, k, None] + lat[None, k, :]
         rel_through = rel[:, k, None] * rel[None, k, :]
+        jit_through = jit[:, k, None] + jit[None, k, :]
         better = through < lat
         return (jnp.where(better, through, lat),
-                jnp.where(better, rel_through, rel))
+                jnp.where(better, rel_through, rel),
+                jnp.where(better, jit_through, jit))
 
     v = lat_ms.shape[0]
-    return jax.lax.fori_loop(0, v, body, (lat_ms, rel))
+    return jax.lax.fori_loop(0, v, body, (lat_ms, rel, jit_ms))
 
 
 def build_matrices(edge_lat_ms: jnp.ndarray, edge_rel: jnp.ndarray,
-                   self_lat_ms=None, self_rel=None):
+                   self_lat_ms=None, self_rel=None, edge_jitter_ms=None,
+                   self_jitter_ms=None):
     """From directed-adjacency inputs to the final routing matrices.
 
     edge_lat_ms: [V,V] f32, INF_MS where no edge, 0 on the diagonal.
@@ -68,29 +75,42 @@ def build_matrices(edge_lat_ms: jnp.ndarray, edge_rel: jnp.ndarray,
                  vertices without one fall back to the doubled
                  min-incident-edge rule.
     self_rel:    optional [V] f32 reliability paired with self_lat_ms.
+    edge_jitter_ms: optional [V,V] f32 per-edge jitter amplitude; per-packet
+                 latency is perturbed uniformly within +/- the path sum.
 
-    Returns (latency_ns i64 [V,V], reliability f32 [V,V]).
+    Returns (latency_ns i64 [V,V], reliability f32 [V,V],
+             jitter_ns i64 [V,V]).
     """
     v = edge_lat_ms.shape[0]
-    lat, rel = floyd_warshall(edge_lat_ms, edge_rel)
+    if edge_jitter_ms is None:
+        edge_jitter_ms = jnp.zeros_like(edge_lat_ms)
+    lat, rel, jit = floyd_warshall(edge_lat_ms, edge_rel, edge_jitter_ms)
 
     # Self-paths: explicit self-loop if the topology declares one, else out
     # to the nearest neighbor and back.
     eye = jnp.eye(v, dtype=bool)
     off_lat = jnp.where(eye, INF_MS, lat)
     nearest = jnp.argmin(off_lat, axis=1)
-    d_lat = 2.0 * off_lat[jnp.arange(v), nearest]
-    d_rel = rel[jnp.arange(v), nearest] ** 2
+    rng_v = jnp.arange(v)
+    d_lat = 2.0 * off_lat[rng_v, nearest]
+    d_rel = rel[rng_v, nearest] ** 2
+    d_jit = 2.0 * jit[rng_v, nearest]
     if self_lat_ms is not None:
         have = ~jnp.isnan(self_lat_ms)
         d_lat = jnp.where(have, self_lat_ms, d_lat)
         d_rel = jnp.where(have, jnp.ones_like(d_rel) if self_rel is None
                           else self_rel, d_rel)
+        if self_jitter_ms is not None:
+            d_jit = jnp.where(have, self_jitter_ms, d_jit)
     lat = jnp.where(eye, d_lat[:, None] * eye, lat)
     rel = jnp.where(eye, (d_rel[:, None] * eye) + (~eye), rel)
+    jit = jnp.where(eye, d_jit[:, None] * eye, jit)
 
     lat_ns = jnp.round(lat * SIMTIME_ONE_MILLISECOND).astype(TIME_DTYPE)
-    return lat_ns, rel.astype(jnp.float32)
+    jit_ns = jnp.round(jit * SIMTIME_ONE_MILLISECOND).astype(TIME_DTYPE)
+    # Jitter can never make a path non-causal: clamp to latency - 1ns.
+    jit_ns = jnp.minimum(jit_ns, jnp.maximum(lat_ns - 1, 0))
+    return lat_ns, rel.astype(jnp.float32), jit_ns
 
 
 def is_routable(lat_ns: jnp.ndarray) -> jnp.ndarray:

@@ -47,7 +47,8 @@ from ..core import emit, simtime
 from ..core import state as st
 from ..core.state import (ERR_SOCKET_OVERFLOW,
                           I32, I64, U32, SACK_RANGES, SOCK_FREE, SOCK_TCP,
-                          TCP_FLAG_ACK, TCP_FLAG_FIN, TCP_FLAG_RST,
+                          TCP_FLAG_ACK, TCP_FLAG_FIN, TCP_FLAG_PSH,
+                          TCP_FLAG_RST,
                           TCP_FLAG_SYN, TCP_MSS, TCPS_CLOSED, TCPS_CLOSEWAIT,
                           TCPS_CLOSING, TCPS_ESTABLISHED, TCPS_FINWAIT1,
                           TCPS_FINWAIT2, TCPS_LASTACK, TCPS_LISTEN,
@@ -62,9 +63,13 @@ RTO_MAX = 120 * simtime.SIMTIME_ONE_SECOND
 DELACK_DELAY = simtime.SIMTIME_ONE_SECOND // 25    # 40ms
 # Reference CONFIG_TCPCLOSETIMER_DELAY (definitions.h) = 60s.
 TIMEWAIT_DELAY = 60 * simtime.SIMTIME_ONE_SECOND
-# Reference CONFIG_SEND_BUFFER_SIZE / CONFIG_RECV_BUFFER_SIZE.
+# Reference CONFIG_SEND_BUFFER_SIZE / CONFIG_RECV_BUFFER_SIZE, with the
+# autotuning growth caps CONFIG_TCP_WMEM_MAX / CONFIG_TCP_RMEM_MAX
+# (definitions.h:101-164).
 SND_BUF_DEFAULT = 131072
 RCV_BUF_DEFAULT = 174760
+SND_BUF_MAX = 4194304
+RCV_BUF_MAX = 6291456
 INIT_CWND = 10 * TCP_MSS
 SSTHRESH_INIT = 1 << 30
 
@@ -107,8 +112,21 @@ def _in_state(tcp_state, states):
 
 
 class _Sock:
-    """Per-host gathered view of one socket slot; mutate fields freely, then
-    `scatter` writes changed fields back under a mask."""
+    """Per-host view of one socket slot; mutate fields freely, then
+    `scatter` writes changed fields back under a mask.
+
+    Lazy + dirty-tracking: a field is gathered from the table only when
+    first read, and `scatter` writes back only fields that were assigned.
+    A TCP phase touches a small subset of the ~40 socket fields, so this
+    cuts the per-micro-step gather/scatter kernel count by an order of
+    magnitude -- the dominant cost of the compiled step (each gather or
+    scatter is its own tiny TPU kernel; dispatch overhead dwarfs the
+    bytes moved at [H, S] scale).
+
+    Contract: `scatter` must receive the same table object the view was
+    constructed from (true at every call site), so the cached initial
+    gather doubles as the "old" value under the write mask.
+    """
 
     FIELDS = [
         "stype", "tcp_state", "local_port", "peer_host", "peer_port",
@@ -118,33 +136,53 @@ class _Sock:
         "retrans_nxt", "retrans_end", "app_closed",
         "rcv_nxt", "rcv_read", "rcv_buf_cap", "fin_seq",
         "ts_recent", "srtt", "rttvar", "rto",
-        "t_rto", "t_delack", "t_tw", "delack_pending",
+        "t_rto", "t_delack", "t_tw", "t_persist", "delack_pending",
+        "at_bytes", "at_last",
         "error", "bytes_sent", "bytes_recv",
     ]
 
     RANGE_FIELDS = ["sack_lo", "sack_hi"]
 
     def __init__(self, socks: st.SocketTable, slot):
-        self._rows = jnp.arange(socks.num_hosts)
-        self._slot = jnp.clip(slot, 0, socks.slots - 1)
-        for f in self.FIELDS:
-            setattr(self, f, getattr(socks, f)[self._rows, self._slot])
-        for f in self.RANGE_FIELDS:
-            setattr(self, f, getattr(socks, f)[self._rows, self._slot, :])
+        d = object.__setattr__
+        d(self, "_socks", socks)
+        d(self, "_rows", jnp.arange(socks.num_hosts))
+        d(self, "_slot", jnp.clip(slot, 0, socks.slots - 1))
+        d(self, "_orig", {})    # field -> value at first gather
+        d(self, "_dirty", set())
+
+    def __getattr__(self, name):
+        # Only called for attributes not yet materialized.
+        if name in self.FIELDS:
+            v = getattr(self._socks, name)[self._rows, self._slot]
+        elif name in self.RANGE_FIELDS:
+            v = getattr(self._socks, name)[self._rows, self._slot, :]
+        else:
+            raise AttributeError(name)
+        self._orig[name] = v
+        object.__setattr__(self, name, v)
+        return v
+
+    def __setattr__(self, name, value):
+        if name in self.FIELDS or name in self.RANGE_FIELDS:
+            if name not in self._orig:
+                getattr(self, name)  # materialize the old value first
+            self._dirty.add(name)
+        object.__setattr__(self, name, value)
 
     def scatter(self, socks: st.SocketTable, mask) -> st.SocketTable:
+        assert socks is self._socks, "scatter target must be the source table"
         upd = {}
-        for f in self.FIELDS:
+        for f in sorted(self._dirty):
             cur = getattr(socks, f)
-            old = cur[self._rows, self._slot]
-            new = jnp.where(mask, getattr(self, f), old)
-            upd[f] = cur.at[self._rows, self._slot].set(new)
-        for f in self.RANGE_FIELDS:
-            cur = getattr(socks, f)
-            old = cur[self._rows, self._slot, :]
-            new = jnp.where(mask[:, None], getattr(self, f), old)
-            upd[f] = cur.at[self._rows, self._slot, :].set(new)
-        return socks.replace(**upd)
+            old = self._orig[f]
+            if f in self.RANGE_FIELDS:
+                new = jnp.where(mask[:, None], getattr(self, f), old)
+                upd[f] = cur.at[self._rows, self._slot, :].set(new)
+            else:
+                new = jnp.where(mask, getattr(self, f), old)
+                upd[f] = cur.at[self._rows, self._slot].set(new)
+        return socks.replace(**upd) if upd else socks
 
     def setwhere(self, mask, **kv):
         for f, v in kv.items():
@@ -162,29 +200,22 @@ _DEFAULTS = dict(
     app_closed=False,
     rcv_nxt=0, rcv_read=0, rcv_buf_cap=RCV_BUF_DEFAULT, fin_seq=0,
     ts_recent=0, srtt=0, rttvar=0, rto=RTO_INIT,
-    t_rto=INV, t_delack=INV, t_tw=INV, delack_pending=0,
+    t_rto=INV, t_delack=INV, t_tw=INV, t_persist=INV, delack_pending=0,
+    at_bytes=0, at_last=0,
     error=0, bytes_sent=0, bytes_recv=0,
 )
 
 
-def _reset_slot(socks: st.SocketTable, slot, mask) -> st.SocketTable:
-    """Reset every field of socket `slot` (per-host [H] i32) to defaults
-    where mask; the vectorized analog of tcp_new (reference tcp.c)."""
-    rows = jnp.arange(socks.num_hosts)
-    sslot = jnp.clip(slot, 0, socks.slots - 1)
-    upd = {}
-    for f, dv in _DEFAULTS.items():
-        cur = getattr(socks, f)
-        old = cur[rows, sslot]
-        new = jnp.where(mask, jnp.asarray(dv).astype(cur.dtype), old)
-        upd[f] = cur.at[rows, sslot].set(new)
+def _apply_defaults(sv: _Sock, mask):
+    """Reset every field of the viewed slot to defaults where mask; the
+    vectorized analog of tcp_new (reference tcp.c).  Runs inside the
+    caller's _Sock round so the reset + specific setup cost one
+    gather/scatter pass, not two.  UDP ring fields stay; they are ignored
+    for TCP sockets."""
+    sv.setwhere(mask, **_DEFAULTS)
     for f in _Sock.RANGE_FIELDS:
-        cur = getattr(socks, f)
-        old = cur[rows, sslot, :]
-        upd[f] = cur.at[rows, sslot, :].set(
-            jnp.where(mask[:, None], jnp.zeros_like(old), old))
-    # udp ring fields stay; they are ignored for TCP sockets.
-    return socks.replace(**upd)
+        cur = getattr(sv, f)
+        setattr(sv, f, jnp.where(mask[:, None], jnp.zeros_like(cur), cur))
 
 
 # ---------------------------------------------------------------------------
@@ -204,8 +235,8 @@ def listen_v(socks: st.SocketTable, mask, slot, port,
              backlog: int = 64) -> st.SocketTable:
     """Vectorized listen: where mask, socket `slot` becomes a listener."""
     slot = jnp.broadcast_to(jnp.asarray(slot, I32), (socks.num_hosts,))
-    socks = _reset_slot(socks, slot, mask)
     sv = _Sock(socks, slot)
+    _apply_defaults(sv, mask)
     sv.setwhere(mask, stype=SOCK_TCP, tcp_state=TCPS_LISTEN, local_port=port,
                 backlog=backlog)
     return sv.scatter(socks, mask)
@@ -218,8 +249,8 @@ def connect_v(socks: st.SocketTable, mask, slot, dst_host, dst_port,
     path on the next micro-step at `now` (first fire = first transmission,
     reference tcp_connectToPeer tcp.c:1462)."""
     slot = jnp.broadcast_to(jnp.asarray(slot, I32), (socks.num_hosts,))
-    socks = _reset_slot(socks, slot, mask)
     sv = _Sock(socks, slot)
+    _apply_defaults(sv, mask)
     sv.setwhere(mask, stype=SOCK_TCP, tcp_state=TCPS_SYNSENT,
                 local_port=local_port, peer_host=dst_host,
                 peer_port=dst_port, snd_una=0, snd_nxt=0, rcv_nxt=0,
@@ -227,16 +258,27 @@ def connect_v(socks: st.SocketTable, mask, slot, dst_host, dst_port,
     return sv.scatter(socks, mask)
 
 
-def write_v(socks: st.SocketTable, mask, slot, target_end) -> st.SocketTable:
+def write_v(socks: st.SocketTable, mask, slot, target_end,
+            now=None) -> st.SocketTable:
     """App write: advance snd_end toward `target_end` (u32 seq, exclusive)
     bounded by the send buffer (snd_end - snd_una <= snd_buf_cap);
-    reference tcp_sendUserData (tcp.c:2126)."""
+    reference tcp_sendUserData (tcp.c:2126).
+
+    Pass `now` so a write landing while the peer advertises a zero window
+    arms the persist timer -- otherwise nothing would ever fire for the
+    socket again (the ACK that closed the window arrived before this data
+    existed, and the window reopen is silent)."""
     sv = _Sock(socks, slot)
     cap_end = (sv.snd_una + sv.snd_buf_cap.astype(U32)).astype(U32)
     tgt = jnp.asarray(target_end).astype(U32)
     new_end = jnp.where(_seq_lt(tgt, cap_end), tgt, cap_end)
     grow = mask & _seq_lt(sv.snd_end, new_end)
     sv.setwhere(grow, snd_end=new_end)
+    if now is not None:
+        blocked = grow & (sv.snd_wnd == 0) & (sv.t_persist == INV) & \
+            (sv.t_rto == INV) & \
+            _in_state(sv.tcp_state, _SENDABLE)
+        sv.setwhere(blocked, t_persist=now + sv.rto)
     return sv.scatter(socks, grow)
 
 
@@ -258,7 +300,13 @@ def consume_all(socks: st.SocketTable) -> st.SocketTable:
 
 def recv_window(sv: _Sock):
     used = _sdiff(sv.rcv_nxt, sv.rcv_read)
-    return jnp.maximum(sv.rcv_buf_cap - used, 0)
+    w = jnp.maximum(sv.rcv_buf_cap - used, 0)
+    # Receiver-side silly-window avoidance (RFC 1122 4.2.3.3): advertise 0
+    # until at least an MSS (or half the buffer) opens, so a closing
+    # window closes *cleanly* and the peer's zero-window persist machinery
+    # engages instead of dribbling sub-MSS grants.
+    thresh = jnp.minimum(TCP_MSS, jnp.maximum(sv.rcv_buf_cap // 2, 1))
+    return jnp.where(w < thresh, 0, w)
 
 
 # ---------------------------------------------------------------------------
@@ -409,8 +457,8 @@ def process_arrivals(state, params, em, tick_t, slot, mask):
     # is raised so the caller can resize the socket table.
     slot_overflow = jnp.any(want_child & ~have_free)
 
-    socks = _reset_slot(socks, child_slot, spawn)
     cv = _Sock(socks, child_slot)
+    _apply_defaults(cv, spawn)
     cv.setwhere(spawn, stype=SOCK_TCP, tcp_state=TCPS_SYNRECEIVED,
                 local_port=p_dport, peer_host=p_src, peer_port=p_sport,
                 parent=lsn_slot, child_order=p_id,
@@ -432,7 +480,7 @@ def process_arrivals(state, params, em, tick_t, slot, mask):
     rst_hit = m & f_rst
     sv.setwhere(rst_hit, tcp_state=TCPS_CLOSED, stype=SOCK_FREE,
                 error=104,  # ECONNRESET
-                t_rto=INV, t_delack=INV, t_tw=INV)
+                t_rto=INV, t_delack=INV, t_tw=INV, t_persist=INV)
     m_live = m & ~f_rst
 
     # SYN-ACK at SYNSENT: active open completes.
@@ -477,6 +525,24 @@ def process_arrivals(state, params, em, tick_t, slot, mask):
 
     # Window update on any acceptable ACK.
     sv.setwhere(ackp & _seq_leq(p_ack, sv.snd_nxt), snd_wnd=p_wnd)
+
+    # Zero-window persist (reference: probe machinery; RFC 9293 3.8.6.1):
+    # a window update to 0 with data pending arms the probe timer; any
+    # nonzero window disarms it.  The window-opening ACK can be lost, so
+    # without this the connection deadlocks.
+    wnd_upd = ackp & _seq_leq(p_ack, sv.snd_nxt)
+    data_pend = (_sdiff(sv.snd_end, sv.snd_nxt) > 0) | sv.app_closed
+    arm_p = wnd_upd & (p_wnd == 0) & data_pend & (sv.t_persist == INV)
+    sv.setwhere(arm_p, t_persist=tick_t + sv.rto)
+    sv.setwhere(wnd_upd & (p_wnd > 0), t_persist=INV)
+
+    # Sender-side buffer autotuning (reference tcp.c:520-533 via
+    # host_autotuneSendBuffer): keep the send buffer ahead of cwnd so the
+    # congestion window, not the buffer, limits the flight.
+    grow_snd = new_ack & (sv.snd_buf_cap < jnp.minimum(2 * sv.cwnd,
+                                                       SND_BUF_MAX))
+    sv.setwhere(grow_snd, snd_buf_cap=jnp.minimum(
+        jnp.maximum(2 * sv.cwnd, sv.snd_buf_cap), SND_BUF_MAX))
 
     # RTT sample (Karn via timestamp echo: only segments we stamped).
     _rtt_update(sv, new_ack & (p_tse > 0), tick_t - p_tse)
@@ -533,7 +599,7 @@ def process_arrivals(state, params, em, tick_t, slot, mask):
                 tcp_state=TCPS_TIMEWAIT, t_tw=tick_t + TIMEWAIT_DELAY)
     sv.setwhere(fin_acked & (sv.tcp_state == TCPS_LASTACK),
                 tcp_state=TCPS_CLOSED, stype=SOCK_FREE,
-                t_rto=INV, t_delack=INV, t_tw=INV)
+                t_rto=INV, t_delack=INV, t_tw=INV, t_persist=INV)
 
     # ---- data reception ----------------------------------------------------
     can_rcv = m_live & est_like & ~f_syn & (p_len > 0)
@@ -560,6 +626,17 @@ def process_arrivals(state, params, em, tick_t, slot, mask):
     sv.setwhere(in_adv, rcv_nxt=new_nxt,
                 bytes_recv=sv.bytes_recv + adv + drained)
 
+    # Receive-buffer autotuning (reference _tcp_autotuneReceiveBuffer,
+    # tcp.c:535-561): grow toward 2x the bytes delivered per RTT so the
+    # advertised window tracks the path BDP.
+    sv.setwhere(in_adv, at_bytes=sv.at_bytes + adv + drained,
+                at_last=jnp.where(sv.at_last == 0, tick_t, sv.at_last))
+    rtt_w = jnp.maximum(sv.srtt, simtime.SIMTIME_ONE_MILLISECOND)
+    adjust = in_adv & (sv.at_last > 0) & (tick_t - sv.at_last > rtt_w)
+    space = jnp.minimum(2 * sv.at_bytes, RCV_BUF_MAX).astype(I32)
+    sv.setwhere(adjust, rcv_buf_cap=jnp.maximum(sv.rcv_buf_cap, space),
+                at_bytes=0, at_last=tick_t)
+
     # ---- FIN reception -----------------------------------------------------
     fin_pos = (p_seq + p_len.astype(U32)).astype(U32)
     sv.setwhere(m_live & f_fin & est_like, fin_seq=fin_pos)
@@ -580,10 +657,14 @@ def process_arrivals(state, params, em, tick_t, slot, mask):
     # in-order segment (delack threshold, reference delayed-ACK handling)
     # or retransmitted FIN while in TIMEWAIT.
     tw_refin = m_live & f_fin & (sv.tcp_state == TCPS_TIMEWAIT)
+    # Zero-window probes (PSH marker, zero length) always elicit an
+    # immediate ACK carrying the current window.
+    probe = m_live & est_like & ((p_flags & TCP_FLAG_PSH) != 0) & \
+        (p_len == 0)
     pend = sv.delack_pending + jnp.where(in_adv, 1, 0)
     # An advance that drained scoreboard ranges filled a hole: ACK at once
     # (RFC 5681; keeps loss recovery at ~1 RTT instead of +delack).
-    ack_now = ooo_ok | old_data | (can_rcv & ~fits) | fin_now | \
+    ack_now = ooo_ok | old_data | (can_rcv & ~fits) | fin_now | probe | \
         tw_refin | (in_adv & (pend >= 2)) | (in_adv & (drained > 0))
     delay_ack = in_adv & ~ack_now
     sv.setwhere(delay_ack, delack_pending=pend,
@@ -624,25 +705,27 @@ def process_arrivals(state, params, em, tick_t, slot, mask):
 # Timers (reference RTO/delack/close timers via Timer descriptors)
 # ---------------------------------------------------------------------------
 
-_K_RTO, _K_DELACK, _K_TW = 0, 1, 2
+_K_RTO, _K_DELACK, _K_TW, _K_PERSIST = 0, 1, 2, 3
+_NKINDS = 4
 
 
 def run_timers(state, params, em, tick_t, active):
     socks = state.socks
     h, s = socks.num_hosts, socks.slots
 
-    cand = jnp.stack([socks.t_rto, socks.t_delack, socks.t_tw], axis=-1)
-    cand2 = cand.reshape(h, s * 3)
+    cand = jnp.stack([socks.t_rto, socks.t_delack, socks.t_tw,
+                      socks.t_persist], axis=-1)
+    cand2 = cand.reshape(h, s * _NKINDS)
     due = cand2 <= tick_t[:, None]
     due = due & active[:, None]
     tmin = jnp.min(jnp.where(due, cand2, INV), axis=1)
     at_min = due & (cand2 == tmin[:, None])
-    flat = jnp.arange(s * 3, dtype=I32)[None, :]
-    pick = jnp.min(jnp.where(at_min, flat, s * 3), axis=1)
-    have = pick < s * 3
-    pick = jnp.clip(pick, 0, s * 3 - 1)
-    slot = pick // 3
-    kind = pick % 3
+    flat = jnp.arange(s * _NKINDS, dtype=I32)[None, :]
+    pick = jnp.min(jnp.where(at_min, flat, s * _NKINDS), axis=1)
+    have = pick < s * _NKINDS
+    pick = jnp.clip(pick, 0, s * _NKINDS - 1)
+    slot = pick // _NKINDS
+    kind = pick % _NKINDS
 
     sv = _Sock(socks, slot)
     m = have
@@ -658,7 +741,7 @@ def run_timers(state, params, em, tick_t, active):
     timed_out = backoff & (sv.rto >= RTO_MAX)
     sv.setwhere(timed_out, tcp_state=TCPS_CLOSED, stype=SOCK_FREE,
                 error=110,  # ETIMEDOUT
-                t_rto=INV, t_delack=INV, t_tw=INV)
+                t_rto=INV, t_delack=INV, t_tw=INV, t_persist=INV)
     backoff = backoff & ~timed_out
     sv.setwhere(backoff, rto=jnp.minimum(sv.rto * 2, RTO_MAX))
     sv.setwhere(backoff, t_rto=tick_t + sv.rto)
@@ -688,18 +771,31 @@ def run_timers(state, params, em, tick_t, active):
     # --- TIME_WAIT fire -----------------------------------------------------
     tw_f = m & (kind == _K_TW) & (sv.tcp_state == TCPS_TIMEWAIT)
     sv.setwhere(tw_f, tcp_state=TCPS_CLOSED, stype=SOCK_FREE,
-                t_rto=INV, t_delack=INV, t_tw=INV)
+                t_rto=INV, t_delack=INV, t_tw=INV, t_persist=INV)
     sv.setwhere(m & (kind == _K_TW) & ~tw_f, t_tw=INV)
+
+    # --- zero-window persist fire -------------------------------------------
+    # Probe while the peer still advertises 0 and data waits; each probe is
+    # a zero-length PSH-marked segment that forces an ACK with the current
+    # window (process_arrivals `probe` path).  Re-arms at the RTO interval.
+    ps_f = m & (kind == _K_PERSIST)
+    est_like_p = _in_state(sv.tcp_state, _SENDABLE)
+    data_pend = (_sdiff(sv.snd_end, sv.snd_nxt) > 0) | sv.app_closed
+    send_probe = ps_f & est_like_p & (sv.snd_wnd == 0) & data_pend
+    sv.setwhere(send_probe, t_persist=tick_t + sv.rto)
+    sv.setwhere(ps_f & ~send_probe, t_persist=INV)
 
     socks = sv.scatter(socks, m)
 
     # --- timer emissions (SLOT_TIMER; one per host per tick) ----------------
     sv2 = _Sock(socks, slot)
     syn_emit = syn_first | syn_re
-    emit_any = syn_emit | synack_re | send_ack
+    emit_any = syn_emit | synack_re | send_ack | send_probe
     flags = jnp.where(syn_emit & ~synack_re, TCP_FLAG_SYN,
                       jnp.where(synack_re, TCP_FLAG_SYN | TCP_FLAG_ACK,
-                                TCP_FLAG_ACK))
+                                jnp.where(send_probe,
+                                          TCP_FLAG_ACK | TCP_FLAG_PSH,
+                                          TCP_FLAG_ACK)))
     em = emit.put(
         em, emit_any, emit.SLOT_TIMER,
         dst=sv2.peer_host, sport=sv2.local_port, dport=sv2.peer_port,
@@ -717,47 +813,75 @@ def run_timers(state, params, em, tick_t, active):
 # ---------------------------------------------------------------------------
 
 
-def _tx_eligibility(socks: st.SocketTable):
-    """[H,S] masks: (retransmit-pending, new-data-or-FIN sendable)."""
-    sendable = _in_state(socks.tcp_state, _SENDABLE)
-    inflight = _sdiff(socks.snd_nxt, socks.snd_una)
-    allowed = jnp.minimum(socks.cwnd, jnp.maximum(socks.snd_wnd, 0))
+def _eligibility(tcp_state, snd_una, snd_nxt, snd_end, snd_wnd, cwnd,
+                 retrans_nxt, retrans_end, app_closed):
+    """Elementwise send-eligibility: (retx, can_new, fin_ready) masks.
 
-    retx_bound = _seq_min(socks.retrans_end, socks.snd_nxt)
-    retx = sendable & _seq_lt(socks.retrans_nxt, retx_bound) & \
-        (_sdiff(socks.retrans_nxt, socks.snd_una) < allowed)
+    One definition serves both the [H,S] whole-table scan (socket pick +
+    re-tick check) and the per-round gathered registers inside `transmit`.
+
+    Full-MSS segments preferred; sub-MSS only for the currently-buffered
+    tail (avoids silly-window dribble); a window with < MSS room waits
+    for an ACK.  The receive side reassembles byte ranges, so alignment
+    is an efficiency choice, not a correctness invariant.
+    """
+    sendable = _in_state(tcp_state, _SENDABLE)
+    inflight = _sdiff(snd_nxt, snd_una)
+    allowed = jnp.minimum(cwnd, jnp.maximum(snd_wnd, 0))
+
+    retx_bound = _seq_min(retrans_end, snd_nxt)
+    retx = sendable & _seq_lt(retrans_nxt, retx_bound) & \
+        (_sdiff(retrans_nxt, snd_una) < allowed)
 
     room = allowed - inflight
-    data_left = _sdiff(socks.snd_end, socks.snd_nxt)
-    # Full-MSS segments preferred; sub-MSS only for the currently-buffered
-    # tail (avoids silly-window dribble); a window with < MSS room waits
-    # for an ACK.  The receive side reassembles byte ranges, so alignment
-    # is an efficiency choice, not a correctness invariant.
+    data_left = _sdiff(snd_end, snd_nxt)
     can_new = sendable & (
         ((data_left >= TCP_MSS) & (room >= TCP_MSS)) |
         ((data_left > 0) & (data_left < TCP_MSS) & (room >= data_left)))
 
-    fin_ready = sendable & socks.app_closed & (socks.snd_nxt == socks.snd_end) \
-        & _in_state(socks.tcp_state, (TCPS_ESTABLISHED, TCPS_CLOSEWAIT))
+    fin_ready = sendable & app_closed & (snd_nxt == snd_end) \
+        & _in_state(tcp_state, (TCPS_ESTABLISHED, TCPS_CLOSEWAIT))
     return retx, can_new, fin_ready
 
 
+def _tx_eligibility(socks: st.SocketTable):
+    """[H,S] masks: (retransmit-pending, new-data, FIN-ready)."""
+    return _eligibility(socks.tcp_state, socks.snd_una, socks.snd_nxt,
+                        socks.snd_end, socks.snd_wnd, socks.cwnd,
+                        socks.retrans_nxt, socks.retrans_end,
+                        socks.app_closed)
+
+
 def transmit(state, params, em, tick_t, active):
+    """Emit up to TX_SLOTS segments from ONE socket per host per tick.
+
+    The socket is picked once (first eligible by slot id) and all segment
+    rounds run on its gathered registers -- one gather/scatter round
+    instead of one per segment.  Hosts with further eligible sockets (or
+    more data than TX_SLOTS segments) re-tick at the same instant via
+    t_resume, so multi-socket fan-out drains in deterministic slot order
+    across micro-steps.
+    """
     socks = state.socks
     h = socks.num_hosts
     slot_ids = jnp.arange(socks.slots, dtype=I32)[None, :]
 
+    retx, can_new, fin_ready = _tx_eligibility(socks)
+    want = (retx | can_new | fin_ready) & active[:, None]
+    pick = jnp.min(jnp.where(want, slot_ids, socks.slots), axis=1)
+    have = pick < socks.slots
+    pick = jnp.clip(pick, 0, socks.slots - 1)
+    sv = _Sock(socks, pick)
+
     for k in range(emit.TX_SLOTS):
-        retx, can_new, fin_ready = _tx_eligibility(socks)
-        want = (retx | can_new | fin_ready) & active[:, None]
-        pick = jnp.min(jnp.where(want, slot_ids, socks.slots), axis=1)
-        have = pick < socks.slots
-        pick = jnp.clip(pick, 0, socks.slots - 1)
-        sv = _Sock(socks, pick)
-        rows = jnp.arange(h)
-        do_retx = have & retx[rows, pick]
-        do_new = have & ~do_retx & can_new[rows, pick]
-        do_fin_only = have & ~do_retx & ~do_new & fin_ready[rows, pick]
+        # Per-round eligibility from the (updated) registers -- the same
+        # rule as the table-wide pick above.
+        retx_k, can_new_k, fin_ready_k = _eligibility(
+            sv.tcp_state, sv.snd_una, sv.snd_nxt, sv.snd_end, sv.snd_wnd,
+            sv.cwnd, sv.retrans_nxt, sv.retrans_end, sv.app_closed)
+        do_retx = have & retx_k
+        do_new = have & ~do_retx & can_new_k
+        do_fin_only = have & ~do_retx & ~do_new & fin_ready_k
 
         # Segment geometry: min(MSS, remaining stream).  Eligibility already
         # guaranteed window room for a full segment (or the tail).
@@ -802,7 +926,7 @@ def transmit(state, params, em, tick_t, active):
         # Arm RTO if off.
         sv.setwhere(doing & (sv.t_rto == INV), t_rto=tick_t + sv.rto)
 
-        socks = sv.scatter(socks, doing)
+    socks = sv.scatter(socks, have)
 
     # More sendable work remains at this instant -> re-tick the host.
     retx, can_new, fin_ready = _tx_eligibility(socks)

@@ -37,7 +37,7 @@ def test_load_and_apsp_shortest_path():
     topo = graphml.load(SIMPLE)
     assert topo.num_vertices == 3
     assert topo.bw_up_KiBps.tolist() == [1000, 2000, 3000]
-    lat_ns, rel = apsp.build_matrices(
+    lat_ns, rel, _jit = apsp.build_matrices(
         jnp.asarray(topo.lat_ms), jnp.asarray(topo.edge_rel),
         jnp.asarray(topo.self_lat_ms), jnp.asarray(topo.self_rel))
     # a->c goes via b (10+20=30ms), beating the direct 100ms edge.
@@ -89,7 +89,7 @@ def test_unreachable_pair_not_routable():
     ).replace(
         '<edge source="a" target="c"><data key="d5">100.0</data><data key="d6">0.0</data></edge>', '')
     topo = graphml.load(xml)
-    lat_ns, rel = apsp.build_matrices(jnp.asarray(topo.lat_ms),
-                                      jnp.asarray(topo.edge_rel))
+    lat_ns, rel, _jit = apsp.build_matrices(jnp.asarray(topo.lat_ms),
+                                            jnp.asarray(topo.edge_rel))
     routable = apsp.is_routable(lat_ns)
     assert bool(routable[0, 1]) and not bool(routable[0, 2])

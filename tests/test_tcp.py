@@ -217,6 +217,108 @@ class TestMisalignedStream:
         assert dur_s < total / bw * 2.0, dur_s
 
 
+class TestFlowControl:
+    """Zero-window persist + receive-window enforcement
+    (reference: probe machinery; RFC 9293 3.8.6.1)."""
+
+    # Larger than the 174760-byte default receive window, so a frozen
+    # consumer closes the window mid-transfer.
+    TOTAL = 300_000
+
+    def _run_slow_consumer(self, resume_s, stop_s=30):
+        # A bulk transfer whose server does NOT consume until `resume_s`:
+        # the receive window fills and closes, the client arms the persist
+        # timer, and -- because the server's window reopen is silent (no
+        # ACK is pushed when the app drains the buffer) -- only probes can
+        # discover the reopened window.
+        import jax.numpy as jnp
+        from shadow1_tpu import sim
+        from shadow1_tpu.apps import bulk as bulk_app
+        from shadow1_tpu.core import engine
+
+        state, params, _ = sim.build_bulk(
+            num_hosts=2, server=0, bytes_per_client=self.TOTAL,
+            latency_ns=5 * MS, stop_time=stop_s * SEC)
+
+        class SlowServerBulk(bulk_app.Bulk):
+            """Server consumes nothing until resume_t."""
+
+            def __init__(self, resume_t):
+                super().__init__()
+                self.resume_t = int(resume_t)
+
+            def __hash__(self):
+                return hash(("slowbulk", self.resume_t))
+
+            def __eq__(self, other):
+                return isinstance(other, SlowServerBulk) and \
+                    other.resume_t == self.resume_t
+
+            def on_tick(self, state, params, em, tick_t, active):
+                socks = state.socks
+                # Freeze host 0's rcv_read until resume time by saving it,
+                # letting the base class consume, then restoring.
+                frozen = tick_t[0] < self.resume_t
+                saved = socks.rcv_read[0]
+                state, em = super().on_tick(state, params, em, tick_t,
+                                            active)
+                socks = state.socks
+                restored = jnp.where(frozen, saved, socks.rcv_read[0])
+                socks = socks.replace(
+                    rcv_read=socks.rcv_read.at[0].set(restored))
+                return state.replace(socks=socks), em
+
+        app = SlowServerBulk(resume_s * SEC)
+        st_ = state
+        for t in range(1, stop_s + 1):
+            st_ = engine.run_until(st_, params, app, t * SEC)
+            if int(st_.app.phase[1]) == 2:
+                break
+        return st_
+
+    def test_zero_window_persist_completes(self):
+        out = self._run_slow_consumer(resume_s=6)
+        # Transfer completed despite the silent window reopen -- only the
+        # persist probes can have discovered it.
+        assert int(out.app.phase[1]) == 2, "deadlocked on zero window"
+        assert int(out.app.finish_t[1]) >= 6 * SEC
+
+    def test_window_never_overrun(self):
+        # While frozen, the server can never hold more unread than its
+        # receive buffer: delivered bytes (rcv_nxt - rcv_read) <= cap.
+        out = self._run_slow_consumer(resume_s=25, stop_s=20)
+        from shadow1_tpu.transport.tcp import _sdiff
+        child = (out.socks.stype[0] == 2) & (out.socks.tcp_state[0] != 1)
+        used = _sdiff(out.socks.rcv_nxt[0], out.socks.rcv_read[0])
+        cap = out.socks.rcv_buf_cap[0]
+        assert bool(jnp.all(jnp.where(child, used <= cap + 1, True)))
+        assert int(out.app.phase[1]) != 2  # frozen whole run: not done
+        # The window actually closed (otherwise the test is vacuous).
+        assert bool(jnp.any(jnp.where(child, used >= cap - 1460, False)))
+
+
+class TestAutotuning:
+    def test_send_buffer_grows_with_cwnd(self):
+        # A fat, long pipe: BDP = 12.5 MB/s * 80ms = ~1 MB >> the 128 KiB
+        # default send buffer.  Autotuning must grow snd_buf_cap (and the
+        # receiver's advertised window) so throughput isn't buffer-bound.
+        total = 3_000_000
+        out, _, _ = _run_bulk(num_hosts=2, server=0, bytes_per_client=total,
+                              latency_ns=40 * MS, stop_time=60 * SEC,
+                              bw_down_Bps=12_500_000, bw_up_Bps=1 << 30)
+        assert int(out.app.phase[1]) == 2
+        from shadow1_tpu.transport.tcp import (RCV_BUF_DEFAULT,
+                                               SND_BUF_DEFAULT)
+        # Client's connection socket grew its send buffer...
+        assert int(out.socks.snd_buf_cap[1, 1]) > SND_BUF_DEFAULT
+        # ...the receiver's window grew past its default...
+        assert int(out.socks.rcv_buf_cap[0].max()) > RCV_BUF_DEFAULT
+        # ...and the transfer clearly beat the buffer-bound rate
+        # (131072 bytes per 80ms RTT = 1.64 MB/s -> 1.83s for 3 MB).
+        dur_s = (int(out.app.finish_t[1]) - MS) / SEC
+        assert dur_s < 0.75 * (total / (SND_BUF_DEFAULT / 0.080)), dur_s
+
+
 class TestThroughputShape:
     def test_rtt_bound(self):
         # Without bandwidth caps, transfer time is dominated by slow-start
