@@ -73,15 +73,52 @@ def _uses_tcp(app) -> bool:
     return getattr(app, "uses_tcp", True)
 
 
-def next_times(state: SimState, params, app):
-    """Per-host earliest pending event time [H] and its global min."""
-    pool, socks, hosts = state.pool, state.socks, state.hosts
+def _slot_bits(p: int) -> int:
+    """Bits needed to pack a pool slot index into the low end of a key."""
+    return max(1, (p - 1).bit_length())
+
+
+def rx_scan(state: SimState):
+    """ONE segment-min over the pool giving, per destination host, the
+    earliest inbound packet (IN_FLIGHT or RX_QUEUED) and its pool slot.
+
+    This single reduction serves both roles the engine needs each
+    micro-step -- "when is each host's next arrival" (the next-event scan)
+    and "which packet does the NIC drain next" (the rx selection) -- so
+    the expensive dst-keyed scatter-min runs once per micro-step instead
+    of three times.  The key packs (absolute time << slot_bits) | slot;
+    ties at equal time break by pool slot, which is mesh-invariant and
+    deterministic (slab slots are allocated in deterministic per-source
+    order).
+
+    Returns (t_arr [H] i64 arrival time or INV, rx_slot [H] i32 or -1).
+    """
+    pool, hosts = state.pool, state.hosts
     h = hosts.num_hosts
+    p = pool.capacity
+    bits = _slot_bits(p)
+    # time << bits must fit below the INV sentinel: sim time is bounded by
+    # 2^(62-bits) ns (19 hours at the default 64k pool).
+    live = (pool.stage == STAGE_IN_FLIGHT) | (pool.stage == STAGE_RX_QUEUED)
+    key = (pool.time << bits) | jnp.arange(p, dtype=I64)
+    kmin = _seg_min(key, pool.dst, h, live)
+    have = kmin != jnp.asarray(INV, I64)
+    t_arr = jnp.where(have, kmin >> bits, jnp.asarray(INV, I64))
+    rx_slot = jnp.where(have, (kmin & ((1 << bits) - 1)).astype(I32), -1)
+    # Only future (IN_FLIGHT) candidates drive the time scan: a backlogged
+    # RX_QUEUED head's arrival is in the past, and re-processing it is
+    # owned by the t_resume wake machinery (armed whenever backlog
+    # remains), so letting it set t_h would freeze virtual time.
+    stage_at = pool.stage[jnp.clip(rx_slot, 0, p - 1)]
+    t_drive = jnp.where(have & (stage_at == STAGE_IN_FLIGHT), t_arr,
+                        jnp.asarray(INV, I64))
+    return t_drive, rx_slot
 
-    inflight = pool.stage == STAGE_IN_FLIGHT
-    t_arr = _seg_min(pool.time, pool.dst, h, inflight)
 
-    t_h = jnp.minimum(t_arr, hosts.t_resume)
+def _aux_times(state: SimState, params, app):
+    """Per-host earliest non-packet event: timers, app, re-ticks."""
+    socks, hosts = state.socks, state.hosts
+    t_h = hosts.t_resume
     if _uses_tcp(app):
         t_tmr = jnp.minimum(
             jnp.minimum(jnp.min(socks.t_rto, axis=1),
@@ -92,6 +129,13 @@ def next_times(state: SimState, params, app):
         t_h = jnp.minimum(t_h, t_tmr)
     if app is not None:
         t_h = jnp.minimum(t_h, app.next_time(state))
+    return t_h
+
+
+def next_times(state: SimState, params, app):
+    """Per-host earliest pending event time [H] and its global min."""
+    t_arr, _ = rx_scan(state)
+    t_h = jnp.minimum(t_arr, _aux_times(state, params, app))
     return t_h, jnp.min(t_h)
 
 
@@ -122,24 +166,29 @@ def _packet_latency(params, vs, vd, src, ctr):
                        simtime.SIMTIME_ONE_NANOSECOND)
 
 
-def _select_queued(pool, seg, stage, tick_t, active, h):
-    """Pick per host the earliest due packet in `stage`, deterministic by
-    (time, pkt_id); `seg` is the owning-host axis (dst for RX, src for TX).
+def _select_tx_slab(pool, tick_t, active, h):
+    """Pick per SOURCE host the earliest due TX_QUEUED packet.
 
-    Returns ([H] pool index or -1, [P] chosen mask).  The mask is what pool
-    updates must use: indexing the pool by the clipped per-host slot would
-    produce duplicate-index scatters whose write order is undefined.
+    Packets live in their source's pool slab (slot // K == src), so this
+    is a reshape-min over [H, K] -- no dst-keyed scatter at all.  Ties at
+    equal time break by within-slab index (deterministic allocation
+    order).  Returns ([H] pool index or -1, [P] chosen mask).
     """
     p = pool.capacity
-    due = (pool.stage == stage) & (pool.time <= tick_t[seg]) & active[seg]
-    tmin = _seg_min(pool.time, seg, h, due)
-    at_min = due & (pool.time == tmin[seg])
-    idmin = _seg_min(pool.pkt_id, seg, h, at_min)
-    chosen = at_min & (pool.pkt_id == idmin[seg])
-    # Scatter pool index to the owning host (<=1 chosen per host;
-    # .max makes the -1 fillers harmless regardless of write order).
-    idx = jnp.where(chosen, jnp.arange(p, dtype=I32), -1)
-    slot_of_host = jnp.full((h,), -1, I32).at[seg].max(idx)
+    k = p // h
+    kb = _slot_bits(k)
+    stage2 = pool.stage.reshape(h, k)
+    time2 = pool.time.reshape(h, k)
+    due = (stage2 == STAGE_TX_QUEUED) & (time2 <= tick_t[:, None]) & \
+        active[:, None]
+    key = jnp.where(due, (time2 << kb) | jnp.arange(k, dtype=I64)[None, :],
+                    jnp.asarray(INV, I64))
+    kmin = jnp.min(key, axis=1)
+    have = kmin != jnp.asarray(INV, I64)
+    j = (kmin & ((1 << kb) - 1)).astype(I32)
+    slot_of_host = jnp.where(have, jnp.arange(h, dtype=I32) * k + j, -1)
+    chosen = ((jnp.arange(k, dtype=I32)[None, :] == j[:, None]) &
+              have[:, None]).reshape(-1)
     return slot_of_host, chosen
 
 
@@ -162,19 +211,26 @@ def _router_enqueue(state: SimState, tick_t, active):
     return state.replace(pool=pool, hosts=hosts)
 
 
-def _rx_drain(state: SimState, params, tick_t, active):
+def _rx_drain(state: SimState, params, tick_t, active, rx_slot):
     """NIC receive: drain one packet per host from the router queue,
     gated by the downstream token bucket and the CoDel drop law
     (reference networkinterface_receivePackets, network_interface.c:421-455
-    + router_queue_codel.c).  Returns (state, slot_of_host, chosen_deliver)
-    for the transport layer."""
+    + router_queue_codel.c).  `rx_slot` is the per-dst earliest inbound
+    packet from the previous micro-step's rx_scan (every packet staged
+    since then arrives beyond the conservative window, so the candidate
+    set cannot have changed).  Returns (state, slot_of_host,
+    chosen_deliver) for the transport layer."""
     pool, hosts = state.pool, state.hosts
     h = hosts.num_hosts
 
-    slot_of_host, chosen = _select_queued(pool, pool.dst, STAGE_RX_QUEUED,
-                                          tick_t, active, h)
-    have = slot_of_host >= 0
-    slot = jnp.clip(slot_of_host, 0, pool.capacity - 1)
+    slot = jnp.clip(rx_slot, 0, pool.capacity - 1)
+    have = (rx_slot >= 0) & active & (pool.time[slot] <= tick_t)
+    slot_of_host = jnp.where(have, rx_slot, -1)
+    # <=1 chosen per pool slot (a slot's dst is fixed) and only True is
+    # ever written (non-candidates target the dropped sentinel index), so
+    # the scatter is collision-free; update count is H, not P.
+    chosen = jnp.zeros((pool.capacity,), bool).at[
+        jnp.where(have, slot, pool.capacity)].set(True, mode="drop")
 
     tokens, last = nic.refill(hosts.tokens_rx, hosts.last_refill_rx,
                               params.bw_down_Bps, tick_t, active)
@@ -421,8 +477,7 @@ def _tx_drain(state: SimState, params, tick_t, active):
     pool, hosts = state.pool, state.hosts
     h = hosts.num_hosts
 
-    slot_of_host, chosen = _select_queued(pool, pool.src, STAGE_TX_QUEUED,
-                                          tick_t, active, h)
+    slot_of_host, chosen = _select_tx_slab(pool, tick_t, active, h)
     have = slot_of_host >= 0
     slot = jnp.clip(slot_of_host, 0, pool.capacity - 1)
 
@@ -471,7 +526,7 @@ def _tx_drain(state: SimState, params, tick_t, active):
 # ---------------------------------------------------------------------------
 
 
-def microstep(state: SimState, params, app, t_h, window_end):
+def _microstep_core(state: SimState, params, app, t_h, window_end, rx_slot):
     """Advance every host's earliest pending event (< window_end)."""
     from ..transport import tcp as tcp_mod
 
@@ -490,7 +545,8 @@ def microstep(state: SimState, params, app, t_h, window_end):
     # Phase A: wire arrivals -> router queue -> NIC rx (tokens + CoDel)
     # -> transport delivery.
     state = _router_enqueue(state, tick_t, active)
-    state, pool_slot, chosen = _rx_drain(state, params, tick_t, active)
+    state, pool_slot, chosen = _rx_drain(state, params, tick_t, active,
+                                         rx_slot)
     state, em = _deliver(state, params, em, tick_t, pool_slot, chosen, app)
 
     # Phase B: transport timers.
@@ -510,39 +566,54 @@ def microstep(state: SimState, params, app, t_h, window_end):
     return state
 
 
+def microstep(state: SimState, params, app, t_h, window_end):
+    """One micro-step (compatibility wrapper computing its own rx scan;
+    the jitted loop threads the scan through the carry instead)."""
+    _, rx_slot = rx_scan(state)
+    return _microstep_core(state, params, app, t_h, window_end, rx_slot)
+
+
 @functools.partial(jax.jit, static_argnames=("app",))
 def run_until(state: SimState, params, app, t_target):
     """Run windows until simulated time reaches t_target (jitted whole)."""
     t_target = jnp.asarray(t_target, I64)
 
-    # (t_h, gmin) ride in the loop carry so the next-event scan -- the most
-    # expensive reduction in the simulator -- runs exactly once per
-    # micro-step instead of once more per window cond/body.
+    # (t_h, gmin, rx_slot) ride in the loop carry: the combined next-event
+    # scan + rx selection -- the one expensive dst-keyed reduction in the
+    # simulator -- runs exactly once per micro-step, at the end, where it
+    # sees everything that step staged (all of which arrives beyond the
+    # conservative window, so the carried selection stays valid).
+    def scan_all(s):
+        t_arr, rx_slot = rx_scan(s)
+        t_h = jnp.minimum(t_arr, _aux_times(s, params, app))
+        return t_h, jnp.min(t_h), rx_slot
+
     def window_cond(carry):
-        st, _t_h, gmin = carry
+        st, _t_h, gmin, _rx = carry
         return (st.now < t_target) & (gmin < t_target)
 
     def window_body(carry):
-        st, t_h, gmin = carry
+        st, t_h, gmin, rx = carry
         ws = jnp.maximum(st.now, gmin)
         we = jnp.minimum(ws + params.min_latency_ns, t_target)
 
         def icond(icarry):
-            _s, _th, g = icarry
+            _s, _th, g, _rx = icarry
             return g < we
 
         def ibody(icarry):
-            s, th, _ = icarry
-            s = microstep(s, params, app, th, we)
-            th2, g2 = next_times(s, params, app)
-            return s, th2, g2
+            s, th, _, rxs = icarry
+            s = _microstep_core(s, params, app, th, we, rxs)
+            th2, g2, rxs2 = scan_all(s)
+            return s, th2, g2, rxs2
 
-        st, t_h, gmin = jax.lax.while_loop(icond, ibody, (st, t_h, gmin))
-        return st.replace(now=we), t_h, gmin
+        st, t_h, gmin, rx = jax.lax.while_loop(icond, ibody,
+                                               (st, t_h, gmin, rx))
+        return st.replace(now=we), t_h, gmin, rx
 
-    t_h0, gmin0 = next_times(state, params, app)
-    state, _, _ = jax.lax.while_loop(window_cond, window_body,
-                                     (state, t_h0, gmin0))
+    c0 = scan_all(state)
+    state, _, _, _ = jax.lax.while_loop(window_cond, window_body,
+                                        (state, *c0))
     return state.replace(now=t_target)
 
 

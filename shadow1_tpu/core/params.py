@@ -67,15 +67,23 @@ def make_net_params(
         jitter_ns = jnp.zeros_like(latency_ns)
     jitter_ns = jnp.asarray(jitter_ns, I64)
     if min_latency_ns is None:
-        # Minimum positive off-diagonal latency bounds the lookahead window,
-        # like the reference's min time jump with a 10ms default when the
-        # topology gives nothing (master.c:133-159).  Jitter can shorten a
-        # path, so the conservative bound subtracts it.
+        # Minimum latency over every pair that can carry CROSS-HOST
+        # traffic bounds the lookahead window, like the reference's min
+        # time jump with a 10ms default when the topology gives nothing
+        # (master.c:133-159).  Jitter can shorten a path, so the
+        # conservative bound subtracts it.  A vertex's self-path counts
+        # whenever two or more hosts share that vertex (same-host
+        # loopback bypasses the matrix and never constrains the window).
         v = latency_ns.shape[0]
+        hv = jnp.asarray(host_vertex, I32)
+        occupants = jnp.zeros((v,), I32).at[hv].add(1)
+        shared_self = occupants >= 2
+        eye = jnp.eye(v, dtype=bool)
+        eligible = (~eye) | (eye & shared_self[None, :])
         eff = jnp.maximum(latency_ns - jitter_ns, 1)
-        off = jnp.where(jnp.eye(v, dtype=bool), jnp.asarray(simtime.SIMTIME_INVALID, I64), eff)
-        off = jnp.where(latency_ns <= 0, jnp.asarray(simtime.SIMTIME_INVALID, I64), off)
-        m = jnp.min(off)
+        inv = jnp.asarray(simtime.SIMTIME_INVALID, I64)
+        cand = jnp.where(eligible & (latency_ns > 0), eff, inv)
+        m = jnp.min(cand)
         min_latency_ns = jnp.where(
             m == simtime.SIMTIME_INVALID,
             jnp.asarray(10 * simtime.SIMTIME_ONE_MILLISECOND, I64),

@@ -15,11 +15,18 @@ from ..core.simtime import TIME_DTYPE
 
 def uniform_full_mesh(n_vertices: int, latency_ns: int,
                       reliability: float = 1.0):
-    """Complete graph: every pair at `latency_ns`, self at 1ns.
+    """Complete graph: every pair at `latency_ns`, including the
+    self-path (which serves distinct hosts attached to the same vertex;
+    same-host loopback bypasses the matrix entirely).  A sub-lookahead
+    self-path would let same-vertex traffic arrive inside the current
+    conservative window and break causality, so it must not be smaller
+    than the uniform latency.
 
     Returns (latency_ns [V,V] i64, reliability [V,V] f32).
     """
-    eye = jnp.eye(n_vertices, dtype=bool)
-    lat = jnp.where(eye, 1, latency_ns).astype(TIME_DTYPE)
-    rel = jnp.where(eye, 1.0, reliability).astype(jnp.float32)
+    # Self-paths (distinct hosts on one vertex) get the same latency AND
+    # loss as every other pair; same-host loopback never consults the
+    # matrix (the engine forces 1ns / no-loss for dst == src).
+    lat = jnp.full((n_vertices, n_vertices), latency_ns, TIME_DTYPE)
+    rel = jnp.full((n_vertices, n_vertices), reliability, jnp.float32)
     return lat, rel
