@@ -40,6 +40,16 @@ def _parser():
                    help="per-host socket-table slots (default: auto)")
     r.add_argument("--pool-slab", type=int, default=128,
                    help="packet-pool slots per host")
+    r.add_argument("--interface-qdisc", choices=("fifo", "rr"),
+                   default="fifo",
+                   help="NIC socket-selection discipline "
+                        "(reference --interface-qdisc)")
+    r.add_argument("--cpu-threshold", type=int, default=-1,
+                   help="microseconds of CPU backlog after which a host "
+                        "blocks; -1 disables (reference --cpu-threshold)")
+    r.add_argument("--cpu-precision", type=int, default=200,
+                   help="CPU wake-time rounding in microseconds "
+                        "(reference --cpu-precision)")
     r.add_argument("--data-directory", default=None,
                    help="where to write heartbeat/summary files")
     r.add_argument("--heartbeat-frequency", type=int, default=1,
@@ -54,7 +64,10 @@ def run_config(args) -> int:
     t_wall = time.perf_counter()
     asm = assemble.load(args.config, seed=args.seed,
                         sock_slots=args.sock_slots,
-                        pool_slab=args.pool_slab)
+                        pool_slab=args.pool_slab,
+                        qdisc=args.interface_qdisc,
+                        cpu_threshold_us=args.cpu_threshold,
+                        cpu_precision_us=args.cpu_precision)
     stop = (args.stop_time * SEC) if args.stop_time else asm.stop_time
     if not args.quiet:
         print(f"[shadow1-tpu] {len(asm.hostnames)} hosts, "

@@ -21,7 +21,8 @@ import numpy as np
 
 from ..apps import tgen as tgen_app
 from ..core import simtime
-from ..core.params import NetParams, make_net_params
+from ..core.params import (NetParams, QDISC_FIFO, QDISC_RR,
+                           make_net_params)
 from ..core.state import make_sim_state
 from ..routing import apsp, graphml
 from ..routing.dns import DNS
@@ -33,6 +34,11 @@ SEC = simtime.SIMTIME_ONE_SECOND
 _KIB = 1024
 # Fallback when neither the host element nor its vertex specifies one.
 _DEFAULT_BW_KIBPS = 102400  # 100 MiB/s
+# Virtual CPU model base: a 3 GHz machine spends ~1us of CPU per
+# simulation event; a host configured with cpufrequency F KHz pays
+# 1us * (3e6 / F) per event (reference cpu.c frequencyRatio).
+_BASE_CPU_KHZ = 3_000_000
+_BASE_EVENT_NS = 1_000
 
 
 @dataclasses.dataclass
@@ -74,7 +80,9 @@ def _plugin_kind(cfg, plugin_id: str) -> str:
 
 
 def build(cfg, seed: int = 1, sock_slots: int | None = None,
-          pool_slab: int = 128) -> Assembled:
+          pool_slab: int = 128, qdisc: str = "fifo",
+          cpu_threshold_us: int = -1,
+          cpu_precision_us: int = 200) -> Assembled:
     """Assemble a parsed ShadowConfig into (state, params, app)."""
     names, specs = _expand_hosts(cfg)
     h = len(names)
@@ -91,11 +99,15 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
     # --- bandwidths (host override, else vertex, else default) -----------
     bw_up = np.empty(h, np.int64)
     bw_dn = np.empty(h, np.int64)
+    cpu_ns = np.zeros(h, np.int64)
     for i, s in enumerate(specs):
         v = host_vertex[i]
         up = s.bandwidthup_KiBps or int(topo.bw_up_KiBps[v]) or _DEFAULT_BW_KIBPS
         dn = s.bandwidthdown_KiBps or int(topo.bw_down_KiBps[v]) or _DEFAULT_BW_KIBPS
         bw_up[i], bw_dn[i] = up * _KIB, dn * _KIB
+        if s.cpufrequency:
+            cpu_ns[i] = max(1, (_BASE_EVENT_NS * _BASE_CPU_KHZ)
+                            // max(1, s.cpufrequency))
 
     # --- routing matrices -------------------------------------------------
     lat_ns, rel, jit_ns = apsp.build_matrices(
@@ -113,6 +125,11 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
         stop_time=cfg.stoptime_s * SEC,
         bootstrap_end=cfg.bootstrap_end_s * SEC,
         jitter_ns=jit_ns,
+        cpu_ns_per_event=cpu_ns,
+        cpu_threshold_ns=(cpu_threshold_us * 1000 if cpu_threshold_us >= 0
+                          else -1),
+        cpu_precision_ns=max(1, cpu_precision_us) * 1000,
+        qdisc={"fifo": QDISC_FIFO, "rr": QDISC_RR}[qdisc],
     )
 
     # --- processes -> modeled apps ---------------------------------------

@@ -132,10 +132,29 @@ def _aux_times(state: SimState, params, app):
     return t_h
 
 
+def _cpu_clamp(state: SimState, params, t_h):
+    """Virtual CPU gate (reference cpu_isBlocked + event deferral,
+    cpu.c:56-75, event.c:71-84): a host whose accumulated CPU backlog
+    exceeds the threshold cannot tick before the backlog drains back to
+    it, so its events execute late by exactly the built-up delay.
+
+    Like the reference's --cpu-threshold (options.c: default -1 =
+    disabled), a negative threshold turns blocking off entirely; wake
+    times are rounded to cpu_precision_ns."""
+    prec = jnp.maximum(params.cpu_precision_ns, 1)
+    ready = state.hosts.cpu_avail - params.cpu_threshold_ns
+    rem = ready % prec
+    ready = ready - rem + jnp.where(rem >= prec // 2, prec, 0)
+    clamp = (params.cpu_ns_per_event > 0) & (t_h != INV) & \
+        (params.cpu_threshold_ns >= 0)
+    return jnp.where(clamp, jnp.maximum(t_h, ready), t_h)
+
+
 def next_times(state: SimState, params, app):
     """Per-host earliest pending event time [H] and its global min."""
     t_arr, _ = rx_scan(state)
     t_h = jnp.minimum(t_arr, _aux_times(state, params, app))
+    t_h = _cpu_clamp(state, params, t_h)
     return t_h, jnp.min(t_h)
 
 
@@ -563,6 +582,20 @@ def _microstep_core(state: SimState, params, app, t_h, window_end, rx_slot):
         state, em = tcp_mod.transmit(state, params, em, tick_t, active)
     state = _stage_emissions(state, params, em, tick_t, active)
     state = _tx_drain(state, params, tick_t, active)
+
+    # Virtual CPU accounting (reference cpu_updateTime + cpu_addDelay,
+    # cpu.c:77-108): every delivered packet and staged emission costs
+    # cpu_ns_per_event.  Costs accumulate exactly; precision rounding
+    # happens where the backlog is consulted (_cpu_clamp), so per-step
+    # increments smaller than the precision are never lost.
+    cpu_on = params.cpu_ns_per_event > 0
+    events = jnp.where(pool_slot >= 0, 1, 0).astype(I64) + \
+        jnp.sum(em.valid, axis=1).astype(I64)
+    cost = params.cpu_ns_per_event * events
+    avail = jnp.maximum(state.hosts.cpu_avail, tick_t)
+    new_avail = jnp.where(cpu_on & active, avail + cost,
+                          state.hosts.cpu_avail)
+    state = state.replace(hosts=state.hosts.replace(cpu_avail=new_avail))
     return state
 
 
@@ -586,6 +619,7 @@ def run_until(state: SimState, params, app, t_target):
     def scan_all(s):
         t_arr, rx_slot = rx_scan(s)
         t_h = jnp.minimum(t_arr, _aux_times(s, params, app))
+        t_h = _cpu_clamp(s, params, t_h)
         return t_h, jnp.min(t_h), rx_slot
 
     def window_cond(carry):

@@ -16,6 +16,9 @@ import jax.numpy as jnp
 from . import simtime
 from .state import I32, I64, F32
 
+QDISC_FIFO = 0
+QDISC_RR = 1
+
 
 @struct.dataclass
 class NetParams:
@@ -35,6 +38,18 @@ class NetParams:
     stop_time: jnp.ndarray      # i64 scalar
     bootstrap_end: jnp.ndarray  # i64 scalar: before this, bandwidth unlimited
                                 # (reference master.c:261-268, worker.c:445-453)
+    # Virtual CPU model (reference cpu.c:15-108 + event deferral
+    # event.c:71-84): every delivered packet / staged emission costs
+    # cpu_ns_per_event of virtual CPU time; when the accumulated backlog
+    # exceeds the threshold the host stops executing events until the
+    # backlog drains.  0 = no CPU model for that host.
+    cpu_ns_per_event: jnp.ndarray  # [H] i64
+    cpu_threshold_ns: jnp.ndarray  # i64 scalar (reference --cpu-threshold)
+    cpu_precision_ns: jnp.ndarray  # i64 scalar (reference --cpu-precision)
+    # Interface qdisc (reference --interface-qdisc,
+    # network_interface.c:466-540): QDISC_FIFO serves the lowest eligible
+    # socket slot (creation order); QDISC_RR round-robins across them.
+    qdisc: jnp.ndarray             # i32 scalar QDISC_*
 
     def pair_latency(self, src_host, dst_host):
         """One-way latency between two hosts (ns)."""
@@ -59,6 +74,11 @@ def make_net_params(
     bootstrap_end: int = 0,
     min_latency_ns=None,
     jitter_ns=None,
+    cpu_ns_per_event=None,
+    cpu_threshold_ns: int = -1,  # reference --cpu-threshold default:
+                                 # negative = CPU never blocks
+    cpu_precision_ns: int = 200 * simtime.SIMTIME_ONE_MICROSECOND,
+    qdisc: int = QDISC_FIFO,
 ) -> NetParams:
     from . import rng
 
@@ -89,6 +109,9 @@ def make_net_params(
             jnp.asarray(10 * simtime.SIMTIME_ONE_MILLISECOND, I64),
             m,
         )
+    h = jnp.asarray(host_vertex).shape[0]
+    if cpu_ns_per_event is None:
+        cpu_ns_per_event = jnp.zeros((h,), I64)
     return NetParams(
         latency_ns=latency_ns,
         reliability=jnp.asarray(reliability, F32),
@@ -100,4 +123,8 @@ def make_net_params(
         seed_key=rng.root_key(seed),
         stop_time=jnp.asarray(stop_time, I64),
         bootstrap_end=jnp.asarray(bootstrap_end, I64),
+        cpu_ns_per_event=jnp.asarray(cpu_ns_per_event, I64),
+        cpu_threshold_ns=jnp.asarray(cpu_threshold_ns, I64),
+        cpu_precision_ns=jnp.asarray(cpu_precision_ns, I64),
+        qdisc=jnp.asarray(qdisc, I32),
     )

@@ -353,6 +353,10 @@ class HostTable:
 
     rng_ctr: jnp.ndarray       # [H] u32 per-host app draw counter
     send_ctr: jnp.ndarray      # [H] i64 per-host packet emission counter (pkt_id low bits)
+    cpu_avail: jnp.ndarray     # [H] i64 virtual-CPU available-at time
+                               # (reference cpu.c timeCPUAvailable)
+    rr_next: jnp.ndarray       # [H] i32 round-robin qdisc cursor
+                               # (reference network_interface.c:466-540)
     t_resume: jnp.ndarray      # [H] i64 host has more same-time work (e.g. open
                                # TCP window not fully transmitted); SIMTIME_INVALID = none
     tokens_tx: jnp.ndarray     # [H] i64 bytes available to transmit
@@ -387,6 +391,8 @@ def make_host_table(num_hosts: int) -> HostTable:
     return HostTable(
         rng_ctr=_zeros(h, U32),
         send_ctr=_zeros(h, I64),
+        cpu_avail=_zeros(h, I64),
+        rr_next=_zeros(h, I32),
         t_resume=_full(h, I64, simtime.SIMTIME_INVALID),
         tokens_tx=_zeros(h, I64),
         tokens_rx=_zeros(h, I64),

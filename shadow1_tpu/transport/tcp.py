@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import emit, simtime
+from ..core.params import QDISC_RR
 from ..core import state as st
 from ..core.state import (ERR_SOCKET_OVERFLOW,
                           I32, I64, U32, SACK_RANGES, SOCK_FREE, SOCK_TCP,
@@ -864,13 +865,23 @@ def transmit(state, params, em, tick_t, active):
     """
     socks = state.socks
     h = socks.num_hosts
-    slot_ids = jnp.arange(socks.slots, dtype=I32)[None, :]
+    s_num = socks.slots
+    slot_ids = jnp.arange(s_num, dtype=I32)[None, :]
 
     retx, can_new, fin_ready = _tx_eligibility(socks)
     want = (retx | can_new | fin_ready) & active[:, None]
-    pick = jnp.min(jnp.where(want, slot_ids, socks.slots), axis=1)
-    have = pick < socks.slots
-    pick = jnp.clip(pick, 0, socks.slots - 1)
+    # Socket selection qdisc (reference network_interface.c:466-540):
+    # FIFO serves the lowest eligible slot; RR rotates a per-host cursor
+    # so concurrent sockets share the interface fairly.
+    pick_fifo = jnp.min(jnp.where(want, slot_ids, s_num), axis=1)
+    rr = state.hosts.rr_next
+    eff = (slot_ids - rr[:, None]) % s_num
+    pick_eff = jnp.min(jnp.where(want, eff, s_num), axis=1)
+    pick_rr = (jnp.clip(pick_eff, 0, s_num - 1) + rr) % s_num
+    use_rr = params.qdisc == QDISC_RR
+    have = pick_fifo < s_num
+    pick = jnp.where(use_rr, pick_rr, pick_fifo)
+    pick = jnp.clip(pick, 0, s_num - 1)
     sv = _Sock(socks, pick)
 
     for k in range(emit.TX_SLOTS):
@@ -933,5 +944,7 @@ def transmit(state, params, em, tick_t, active):
     more = jnp.any((retx | can_new | fin_ready), axis=1) & active
     hosts = state.hosts
     hosts = hosts.replace(
-        t_resume=jnp.where(more, tick_t, hosts.t_resume))
+        t_resume=jnp.where(more, tick_t, hosts.t_resume),
+        rr_next=jnp.where(use_rr & have, (pick + 1) % s_num,
+                          hosts.rr_next))
     return state.replace(socks=socks, hosts=hosts), em
