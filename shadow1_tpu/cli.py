@@ -52,6 +52,11 @@ def _parser():
                         "(reference --cpu-precision)")
     r.add_argument("--data-directory", default=None,
                    help="where to write heartbeat/summary files")
+    r.add_argument("--pcap", action="store_true",
+                   help="capture sent packets and write capture.pcap to "
+                        "the data directory (reference logpcap)")
+    r.add_argument("--pcap-ring", type=int, default=1 << 16,
+                   help="capture ring capacity (older records overwritten)")
     r.add_argument("--heartbeat-frequency", type=int, default=1,
                    help="heartbeat interval in sim seconds (0 = off)")
     r.add_argument("--quiet", action="store_true")
@@ -82,6 +87,13 @@ def run_config(args) -> int:
                           interval_s=max(1, args.heartbeat_frequency))
 
     state, params, app = asm.state, asm.params, asm.app
+    if args.pcap:
+        if not args.data_directory:
+            print("error: --pcap requires --data-directory (where "
+                  "capture.pcap is written)", file=sys.stderr)
+            return 2
+        from .core.state import make_capture_ring
+        state = state.replace(cap=make_capture_ring(args.pcap_ring))
     t = int(state.now)
     hb_next = 0
     while t < stop:
@@ -114,6 +126,13 @@ def run_config(args) -> int:
         "drops_pool": int(jnp.sum(state.hosts.pkts_dropped_pool)),
         "err_flags": int(state.err),
     }
+    if args.pcap and args.data_directory:
+        import os as _os
+        from .observe import write_pcap
+        n = write_pcap(_os.path.join(args.data_directory, "capture.pcap"),
+                       state.cap,
+                       ip_of_host=lambda i: asm.dns.address_of(i).ip)
+        summary["pcap_records"] = n
     if tracker is not None:
         tracker.summary(summary, state)
     print(json.dumps(summary))

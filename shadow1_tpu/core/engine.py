@@ -150,12 +150,20 @@ def _cpu_clamp(state: SimState, params, t_h):
     return jnp.where(clamp, jnp.maximum(t_h, ready), t_h)
 
 
-def next_times(state: SimState, params, app):
-    """Per-host earliest pending event time [H] and its global min."""
-    t_arr, _ = rx_scan(state)
+def _scan_all(state: SimState, params, app):
+    """The combined per-micro-step scan: per-host next event time, its
+    global min, and the rx candidate slot.  Single source of truth for
+    both the jitted loop and the public next_times."""
+    t_arr, rx_slot = rx_scan(state)
     t_h = jnp.minimum(t_arr, _aux_times(state, params, app))
     t_h = _cpu_clamp(state, params, t_h)
-    return t_h, jnp.min(t_h)
+    return t_h, jnp.min(t_h), rx_slot
+
+
+def next_times(state: SimState, params, app):
+    """Per-host earliest pending event time [H] and its global min."""
+    t_h, gmin, _ = _scan_all(state, params, app)
+    return t_h, gmin
 
 
 # ---------------------------------------------------------------------------
@@ -485,7 +493,43 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
         jnp.sum(live & ~have_slot, axis=1),
     )
     err = state.err | jnp.where(overflow, ERR_POOL_OVERFLOW, 0).astype(jnp.int32)
-    return state.replace(pool=pool, hosts=hosts, err=err)
+    state = state.replace(pool=pool, hosts=hosts, err=err)
+
+    # Packet capture (PCAP analog; only traced when a CaptureRing is
+    # installed): record every placed emission at send time.
+    if state.cap is not None:
+        cap = state.cap
+        c = cap.capacity
+        placedf = placed.reshape(-1)
+        rank = jnp.cumsum(placedf) - 1
+        n_new = jnp.sum(placedf).astype(I64)
+        pos = ((cap.total + rank) % c).astype(I32)
+        # One batch larger than the ring would wrap onto itself and make
+        # the surviving record per slot scatter-order-dependent; keep the
+        # first `c` records of such a batch instead (deterministic) --
+        # size the ring above H*NUM_SLOTS to never hit this.
+        idx = jnp.where(placedf & (rank < c), pos, c)  # c = dropped write
+
+        def cw(a, val, dtype=None):
+            v = val.reshape(-1) if hasattr(val, "reshape") else val
+            if dtype is not None:
+                v = v.astype(dtype)
+            return a.at[idx].set(v, mode="drop")
+
+        state = state.replace(cap=cap.replace(
+            time=cw(cap.time, send_t),
+            src=cw(cap.src, src2),
+            dst=cw(cap.dst, em.dst),
+            sport=cw(cap.sport, em.sport),
+            dport=cw(cap.dport, em.dport),
+            proto=cw(cap.proto, em.proto),
+            flags=cw(cap.flags, em.flags),
+            length=cw(cap.length, em.length),
+            seq=cw(cap.seq, em.seq),
+            ack=cw(cap.ack, em.ack),
+            total=cap.total + n_new,
+        ))
+    return state
 
 
 def _tx_drain(state: SimState, params, tick_t, active):
@@ -617,10 +661,7 @@ def run_until(state: SimState, params, app, t_target):
     # sees everything that step staged (all of which arrives beyond the
     # conservative window, so the carried selection stays valid).
     def scan_all(s):
-        t_arr, rx_slot = rx_scan(s)
-        t_h = jnp.minimum(t_arr, _aux_times(s, params, app))
-        t_h = _cpu_clamp(s, params, t_h)
-        return t_h, jnp.min(t_h), rx_slot
+        return _scan_all(s, params, app)
 
     def window_cond(carry):
         st, _t_h, gmin, _rx = carry

@@ -415,6 +415,53 @@ def make_host_table(num_hosts: int) -> HostTable:
 
 
 # ---------------------------------------------------------------------------
+# Packet capture ring (PCAP analog)
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class CaptureRing:
+    """Fixed-capacity ring of sent-packet records, the device-side source
+    for PCAP export (reference per-host capture,
+    network_interface.c:337-373 + utility/pcap_writer.c).  Present in
+    SimState only when capture is enabled, so disabled runs trace without
+    any capture cost.  Older records are overwritten when the ring wraps;
+    `total` counts lifetime appends so the writer knows."""
+
+    time: jnp.ndarray    # [C] i64 send timestamp
+    src: jnp.ndarray     # [C] i32
+    dst: jnp.ndarray     # [C] i32
+    sport: jnp.ndarray   # [C] i32
+    dport: jnp.ndarray   # [C] i32
+    proto: jnp.ndarray   # [C] i32
+    flags: jnp.ndarray   # [C] i32
+    length: jnp.ndarray  # [C] i32 payload bytes
+    seq: jnp.ndarray     # [C] u32
+    ack: jnp.ndarray     # [C] u32
+    total: jnp.ndarray   # i64 scalar: lifetime records appended
+
+    @property
+    def capacity(self) -> int:
+        return self.time.shape[0]
+
+
+def make_capture_ring(capacity: int = 1 << 16) -> CaptureRing:
+    return CaptureRing(
+        time=_zeros((capacity,), I64),
+        src=_zeros((capacity,), I32),
+        dst=_zeros((capacity,), I32),
+        sport=_zeros((capacity,), I32),
+        dport=_zeros((capacity,), I32),
+        proto=_zeros((capacity,), I32),
+        flags=_zeros((capacity,), I32),
+        length=_zeros((capacity,), I32),
+        seq=_zeros((capacity,), U32),
+        ack=_zeros((capacity,), U32),
+        total=jnp.asarray(0, I64),
+    )
+
+
+# ---------------------------------------------------------------------------
 # Whole-simulation state
 # ---------------------------------------------------------------------------
 
@@ -429,6 +476,7 @@ class SimState:
     hosts: HostTable
     app: any = struct.field(pytree_node=True, default=None)  # application-model state
     err: jnp.ndarray = struct.field(default=None)  # i32 scalar ERR_* bitmask
+    cap: any = struct.field(pytree_node=True, default=None)  # CaptureRing | None
 
 
 def make_sim_state(num_hosts: int, sock_slots: int = 16,

@@ -73,6 +73,70 @@ class Tracker:
             json.dump(summary, f, indent=2)
 
 
+def write_pcap(path: str, cap, ip_of_host=None):
+    """Write a CaptureRing to a classic pcap file (LINKTYPE_RAW IPv4).
+
+    The ring stores packet *metadata*; each record is synthesized as an
+    IPv4 + TCP/UDP header whose total-length field reflects the real
+    payload size (a truncated capture: incl_len = header bytes,
+    orig_len = header + payload) -- the same information the reference's
+    per-interface capture exposes (utility/pcap_writer.c).
+
+    ip_of_host: optional callable host_index -> 32-bit IP (e.g. from the
+    DNS registry); defaults to 10.x.y.z derived from the index.
+    """
+    import struct as pystruct
+
+    if ip_of_host is None:
+        def ip_of_host(i):
+            return (10 << 24) | (int(i) & 0xFFFFFF)
+
+    t = np.asarray(cap.time)
+    total = int(cap.total)
+    c = t.shape[0]
+    n = min(total, c)
+    # Oldest-first order; ring wraps at `total % c`.
+    start = total % c if total > c else 0
+    order = (np.arange(n) + start) % c
+
+    src = np.asarray(cap.src)
+    dst = np.asarray(cap.dst)
+    sport = np.asarray(cap.sport)
+    dport = np.asarray(cap.dport)
+    proto = np.asarray(cap.proto)
+    flags = np.asarray(cap.flags)
+    length = np.asarray(cap.length)
+    seq = np.asarray(cap.seq)
+    ack = np.asarray(cap.ack)
+
+    with open(path, "wb") as f:
+        # pcap global header: magic, v2.4, tz 0, sigfigs 0, snaplen,
+        # linktype 101 (LINKTYPE_RAW: raw IPv4/IPv6).
+        f.write(pystruct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0, 65535, 101))
+        for k in order:
+            is_tcp = int(proto[k]) == 6
+            l4 = (pystruct.pack(">HHIIBBHHH", int(sport[k]) & 0xFFFF,
+                                int(dport[k]) & 0xFFFF, int(seq[k]),
+                                int(ack[k]), 5 << 4, int(flags[k]) & 0x3F,
+                                65535, 0, 0)
+                  if is_tcp else
+                  pystruct.pack(">HHHH", int(sport[k]) & 0xFFFF,
+                                int(dport[k]) & 0xFFFF,
+                                8 + int(length[k]), 0))
+            tot_len = 20 + len(l4) + int(length[k])
+            ip = pystruct.pack(">BBHHHBBHII", 0x45, 0, tot_len & 0xFFFF, 0,
+                               0, 64, int(proto[k]) & 0xFF, 0,
+                               ip_of_host(int(src[k])),
+                               ip_of_host(int(dst[k])))
+            rec = ip + l4
+            ts_ns = int(t[k])
+            f.write(pystruct.pack("<IIII", ts_ns // 1_000_000_000,
+                                  (ts_ns % 1_000_000_000) // 1000,
+                                  len(rec), tot_len))
+            f.write(rec)
+    return n
+
+
 def census(state) -> dict:
     """Live-object census from the dense tables (ObjectCounter analog)."""
     stage = np.asarray(state.pool.stage)
