@@ -39,7 +39,11 @@ def purpose_key(key: jax.Array, purpose: int) -> jax.Array:
     return jax.random.fold_in(key, purpose)
 
 
-_GOLDEN = jnp.uint32(0x9E3779B9)   # odd constants decorrelate id positions
+# Plain Python int, wrapped per-trace: a module-level jnp constant would run
+# an eager device op at import time and initialize whatever backend is
+# ambient -- `import shadow1_tpu` must never touch a backend (the multichip
+# dryrun forces CPU in a child process *after* deciding via env only).
+_GOLDEN = 0x9E3779B9   # odd constants decorrelate id positions
 
 
 def _mix32(x):
@@ -72,7 +76,7 @@ def keyed_bits(key: jax.Array, *ids) -> jax.Array:
     k0, k1 = _key_words(key)
     h = _mix32(k0 ^ jnp.uint32(0x85EBCA6B))
     for n, idv in enumerate(ids):
-        h = _mix32(h ^ (idv + _GOLDEN * jnp.uint32(2 * n + 1)))
+        h = _mix32(h ^ (idv + jnp.uint32((_GOLDEN * (2 * n + 1)) & 0xFFFFFFFF)))
     return _mix32(h ^ k1)
 
 
