@@ -507,8 +507,11 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
         # One batch larger than the ring would wrap onto itself and make
         # the surviving record per slot scatter-order-dependent; keep the
         # first `c` records of such a batch instead (deterministic) --
-        # size the ring above H*NUM_SLOTS to never hit this.
+        # size the ring above H*NUM_SLOTS to never hit this.  `total` must
+        # then also advance by what was *written*, not what was staged, or
+        # the writer would treat never-written slots as valid records.
         idx = jnp.where(placedf & (rank < c), pos, c)  # c = dropped write
+        n_new = jnp.minimum(n_new, c)
 
         def cw(a, val, dtype=None):
             v = val.reshape(-1) if hasattr(val, "reshape") else val

@@ -540,10 +540,12 @@ def process_arrivals(state, params, em, tick_t, slot, mask):
     # Sender-side buffer autotuning (reference tcp.c:520-533 via
     # host_autotuneSendBuffer): keep the send buffer ahead of cwnd so the
     # congestion window, not the buffer, limits the flight.
-    grow_snd = new_ack & (sv.snd_buf_cap < jnp.minimum(2 * sv.cwnd,
-                                                       SND_BUF_MAX))
-    sv.setwhere(grow_snd, snd_buf_cap=jnp.minimum(
-        jnp.maximum(2 * sv.cwnd, sv.snd_buf_cap), SND_BUF_MAX))
+    # cwnd can exceed 2^30 on long lossless runs (ssthresh init 1<<30), so
+    # the doubling is computed in i64 to keep 2*cwnd from wrapping negative.
+    snd_tgt = jnp.minimum(2 * sv.cwnd.astype(I64),
+                          SND_BUF_MAX).astype(I32)
+    grow_snd = new_ack & (sv.snd_buf_cap < snd_tgt)
+    sv.setwhere(grow_snd, snd_buf_cap=jnp.maximum(snd_tgt, sv.snd_buf_cap))
 
     # RTT sample (Karn via timestamp echo: only segments we stamped).
     _rtt_update(sv, new_ack & (p_tse > 0), tick_t - p_tse)

@@ -1,0 +1,332 @@
+"""In-loop op cost micro-benchmark for the engine redesign.
+
+Measures the per-iteration cost of candidate primitives *inside a jitted
+while_loop* (the only economics that matter for the engine hot path; a
+standalone op is ~300x cheaper than the same op in a compiled loop on this
+backend -- see PERF.md).  Run on the real TPU chip:
+
+    python tools/opbench.py [H] [K]
+
+Each case carries its operands through the loop (perturbed each iteration)
+so nothing hoists out as loop-invariant.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import shadow1_tpu  # noqa: F401  (x64)
+import jax
+import jax.numpy as jnp
+
+I32, I64 = jnp.int32, jnp.int64
+INV = (1 << 62) - 1
+
+H = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+K = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+P = H * K
+ITERS = 50
+
+
+def bench(name, carry, body):
+    def run(c):
+        def cond(s):
+            return s[0] < ITERS
+
+        def b(s):
+            i = s[0]
+            out = body(s[1:], i)
+            return (i + 1,) + tuple(out)
+
+        return jax.lax.while_loop(cond, b, (jnp.asarray(0, I32),) + tuple(c))
+
+    jf = jax.jit(run)
+    out = jf(carry)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = jf(carry)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / ITERS * 1e3
+    print(f"{name:55s} {dt:8.3f} ms/iter")
+    return dt
+
+
+def main():
+    print(f"H={H} K={K} P={P} iters={ITERS} dev={jax.devices()}")
+    key = jax.random.PRNGKey(0)
+    times = jax.random.randint(key, (P,), 0, 1 << 40, dtype=I64)
+    dst = jax.random.randint(key, (P,), 0, H, dtype=I32)
+    live = jax.random.uniform(key, (P,)) < 0.2
+    acc0 = jnp.asarray(0, I64)
+
+    def perturb(t, i):
+        return t + i.astype(I64)  # elementwise, fuses
+
+    # 0a. empty loop: counter only
+    def b0a(c, i):
+        return (c[0] + 1,)
+    bench("empty loop (i32 counter)", (jnp.asarray(0, I32),), b0a)
+
+    # 0b. elementwise [P] + full reduction
+    def b0b(c, i):
+        t, a = c
+        t = perturb(t, i)
+        return t, a + jnp.min(t)
+    bench("elementwise [P] + global min", (times, acc0), b0b)
+
+    # 0. baseline: elementwise only
+    def b0(c, i):
+        t, a = c
+        t = perturb(t, i)
+        return t, a + t[0]
+    base = bench("baseline (elementwise only)", (times, acc0), b0)
+
+    # 1. segment_min i64 keyed by dst (current rx_scan core)
+    def b1(c, i):
+        t, a = c
+        t = perturb(t, i)
+        data = jnp.where(live, t, INV)
+        m = jax.ops.segment_min(data, dst, num_segments=H)
+        return t, a + m.min()
+    bench("segment_min i64 by dst [P]->[H]", (times, acc0), b1)
+
+    # 2. segment_sum (current router backlog count)
+    def b2(c, i):
+        t, a = c
+        t = perturb(t, i)
+        s = jax.ops.segment_sum(jnp.where(live, 1, 0), dst, num_segments=H)
+        return t, a + s.max().astype(I64) + t[0]
+    bench("segment_sum i32 by dst [P]->[H]", (times, acc0), b2)
+
+    # 3. reshape-min [H,K] i64
+    def b3(c, i):
+        t, a = c
+        t = perturb(t, i)
+        m = jnp.min(jnp.where(live, t, INV).reshape(H, K), axis=1)
+        return t, a + m.min()
+    bench("reshape-min [H,K] i64", (times, acc0), b3)
+
+    # 4. two-phase row-min (time, then index among ties)
+    def b4(c, i):
+        t, a = c
+        t = perturb(t, i)
+        t2 = jnp.where(live, t, INV).reshape(H, K)
+        tmin = jnp.min(t2, axis=1)
+        ids = jnp.arange(K, dtype=I32)[None, :]
+        j = jnp.min(jnp.where(t2 == tmin[:, None], ids, K), axis=1)
+        return t, a + tmin.min() + j.max().astype(I64)
+    bench("two-phase row-min (time+tiebreak) [H,K]", (times, acc0), b4)
+
+    # 5. gather 12 fields at [H] shared indices from [P] arrays
+    fields = [times + n for n in range(12)]
+
+    def b5(c, i):
+        t = perturb(c[0], i)
+        fs = [t + n for n in range(12)]
+        idx = (jnp.arange(H, dtype=I32) * K + (i % K)).astype(I32)
+        g = sum(f[idx] for f in fs)
+        return t, c[1] + g.sum()
+    bench("gather 12 x [P] fields at [H] shared idx", (times, acc0), b5)
+
+    # 6. scatter 12 fields at [H] indices into [P] arrays
+    def b6(c, i):
+        t = perturb(c[0], i)
+        idx = (jnp.arange(H, dtype=I32) * K + (i % K)).astype(I32)
+        vals = jnp.arange(H, dtype=I64)
+        fs = [(t + n).at[idx].set(vals, mode="drop") for n in range(12)]
+        out = fs[0]
+        for f in fs[1:]:
+            out = out + f
+        return t, c[1] + out[0]
+    bench("scatter 12 x [P] fields at [H] idx", (times, acc0), b6)
+
+    # 7. row-local one-hot merge [H,E]->[H,K] (staging without scatter)
+    E = 7
+
+    def b7(c, i):
+        t = perturb(c[0], i)
+        em_t = (t.reshape(H, K)[:, :E] + 1)      # [H,E] fake emissions
+        alloc = jnp.broadcast_to((jnp.arange(E, dtype=I32)[None, :] + i) % K,
+                                 (H, E))         # [H,E] target cols
+        onehot = alloc[:, :, None] == jnp.arange(K, dtype=I32)[None, None, :]
+        # [H,K] <- for each k, sum over e of em where alloc==k
+        upd = jnp.sum(jnp.where(onehot, em_t[:, :, None], 0), axis=1)
+        t2 = t.reshape(H, K) + upd
+        return t2.reshape(-1), c[1] + t2[0, 0]
+    bench(f"row one-hot merge [H,{E}]->[H,K] x4 fields", (times, acc0), b7)
+
+    # 7b. one-hot merge for 12 fields at once
+    def b7b(c, i):
+        t = perturb(c[0], i)
+        alloc = jnp.broadcast_to((jnp.arange(E, dtype=I32)[None, :] + i) % K,
+                                 (H, E))
+        onehot = alloc[:, :, None] == jnp.arange(K, dtype=I32)[None, None, :]
+        out = t.reshape(H, K)
+        for n in range(12):
+            em_t = (t.reshape(H, K)[:, :E] + n)
+            upd = jnp.sum(jnp.where(onehot, em_t[:, :, None], 0), axis=1)
+            out = out + upd
+        return out.reshape(-1), c[1] + out[0, 0]
+    bench(f"row one-hot merge [H,{E}]->[H,K], 12 fields", (times, acc0), b7b)
+
+    # 8. scatter-add P updates into [B,H] + cumsum over B (redistribution L1)
+    G = 64                      # rows per superblock
+    B = max(1, (P // K) // G)   # = H/G superblocks
+
+    def b8(c, i):
+        t = perturb(c[0], i)
+        blk = (jnp.arange(P, dtype=I32) // (G * K))
+        cnt = jnp.zeros((B, H), I32).at[blk, dst].add(
+            jnp.where(live, 1, 0), mode="drop")
+        off = jnp.cumsum(cnt, axis=0) - cnt
+        return t, c[1] + off.max().astype(I64) + t[0]
+    bench(f"scatter-add [P]->[B={B},H] + cumsum", (times, acc0), b8)
+
+    # 9. within-superblock pairwise rank (redistribution L2)
+    M = G * K  # items per superblock
+
+    def b9(c, i):
+        t = perturb(c[0], i)
+        d3 = dst.reshape(B, M)
+        l3 = live.reshape(B, M)
+        eq = (d3[:, :, None] == d3[:, None, :]) & l3[:, None, :]
+        lower = jnp.tril(jnp.ones((M, M), bool), -1)[None]
+        rank = jnp.sum(eq & lower, axis=2)
+        return t, c[1] + rank.max().astype(I64) + t[0]
+    bench(f"pairwise rank [B,{M},{M}]", (times, acc0), b9)
+
+    # 10. full redistribution move: gather 12 fields at [P] idx + scatter 12
+    def b10(c, i):
+        t = perturb(c[0], i)
+        idx = jnp.argsort(dst + (i % 2))  # stand-in permutation [P]
+        fs = [(t + n)[idx] for n in range(12)]
+        out = [(t + n).at[idx].set(f, mode="drop") for n, f in enumerate(fs)]
+        s = out[0]
+        for f in out[1:]:
+            s = s + f
+        return t, c[1] + s[0]
+    bench("argsort[P] + gather+scatter 12 fields [P]->[P]", (times, acc0), b10)
+
+    # 11. row sort [H,K] by i64 key
+    def b11(c, i):
+        t = perturb(c[0], i)
+        s = jax.lax.sort(t.reshape(H, K), dimension=1)
+        return t, c[1] + s[0, 0]
+    bench("lax.sort rows [H,K] i64", (times, acc0), b11)
+
+    # 12. sort [B, M] rows by i32 (redistribution L2 alternative)
+    def b12(c, i):
+        t = perturb(c[0], i)
+        k32 = (dst + (i % 2)).reshape(B, M)
+        s = jax.lax.sort(k32, dimension=1)
+        return t, c[1] + s.max().astype(I64) + t[0]
+    bench(f"lax.sort rows [B,{M}] i32", (times, acc0), b12)
+
+    # 13. gather [H,D] contiguous block per row (D-batch head gather)
+    D = 4
+
+    def b13(c, i):
+        t = perturb(c[0], i)
+        t2 = t.reshape(H, K)
+        cur = jnp.broadcast_to((i % (K - D)).astype(I32), (H,))
+        cols = cur[:, None] + jnp.arange(D, dtype=I32)[None, :]
+        g = jnp.take_along_axis(t2, cols, axis=1)
+        return t, c[1] + g.sum()
+    bench(f"take_along_axis [H,{D}] block", (times, acc0), b13)
+
+    # 15. scatter update-count scaling: [N] i64 into [P]
+    for N in (16384, 131072):
+        def b15(c, i, N=N):
+            t = perturb(c[0], i)
+            idx = ((jnp.arange(N, dtype=I32) * 7 + i) % P).astype(I32)
+            out = t.at[idx].set(jnp.arange(N, dtype=I64), mode="drop")
+            return t, c[1] + out[0]
+        bench(f"scatter [N={N}] i64 into [P]", (times, acc0), b15)
+
+    # 16. packed-block scatter: [N, C] rows into [P, C]
+    for (C, dt_) in ((4, I64), (10, I32)):
+        blkP = jnp.zeros((P, C), dt_)
+
+        def b16(c, i, C=C, dt_=dt_):
+            t, blk, a = c
+            t = perturb(t, i)
+            idx = ((jnp.arange(P, dtype=I32) * 7 + i) % P).astype(I32)
+            vals = jnp.broadcast_to(t[:, None], (P, C)).astype(dt_)
+            blk = blk.at[idx].set(vals, mode="drop")
+            return t, blk, a + blk[0, 0].astype(I64)
+        bench(f"packed scatter [P,{C}] {dt_.__name__} rows", (times, blkP, acc0), b16)
+
+    # 17. packed-block gather: [H, C] rows from [P, C]
+    blkP10 = jnp.zeros((P, 10), I32)
+
+    def b17(c, i):
+        t, blk, a = c
+        t = perturb(t, i)
+        idx = ((jnp.arange(H, dtype=I32) * K + i) % P).astype(I32)
+        g = blk[idx]  # [H, 10]
+        return t, blk + 1, a + g.sum().astype(I64) + t[0]
+    bench("packed gather [H,10] rows from [P,10]", (times, blkP10, acc0), b17)
+
+    # 18. one-hot row gather [H,S]->[H], 12 fields (TCP _Sock replacement)
+    S = 16
+    tabs = jnp.zeros((H, S), I32)
+
+    def b18(c, i):
+        t, tab, a = c
+        t = perturb(t, i)
+        tab = tab + 1
+        slot = (jnp.arange(H, dtype=I32) + i) % S
+        onehot = slot[:, None] == jnp.arange(S, dtype=I32)[None, :]
+        s = a
+        for n in range(12):
+            g = jnp.sum(jnp.where(onehot, tab + n, 0), axis=1)
+            s = s + g.sum().astype(I64)
+        return t, tab, s
+    bench("one-hot row gather [H,16]->[H], 12 fields", (times, tabs, acc0), b18)
+
+    # 19. one-hot row scatter [H]->[H,S], 12 fields
+    def b19(c, i):
+        t, tab, a = c
+        t = perturb(t, i)
+        slot = (jnp.arange(H, dtype=I32) + i) % S
+        onehot = slot[:, None] == jnp.arange(S, dtype=I32)[None, :]
+        val = jnp.arange(H, dtype=I32)
+        out = tab
+        for n in range(12):
+            out = jnp.where(onehot, (val + n)[:, None], out)
+        return t, out, a + out[0, 0].astype(I64)
+    bench("one-hot row scatter [H]->[H,16], 12 fields", (times, tabs, acc0), b19)
+
+    # 20. indexed row gather/scatter [H,S] tab[rows, slot] (current _Sock)
+    def b20(c, i):
+        t, tab, a = c
+        t = perturb(t, i)
+        rows = jnp.arange(H)
+        slot = (rows.astype(I32) + i) % S
+        s = a
+        out = tab
+        for n in range(12):
+            g = (tab + n)[rows, slot]
+            out = out.at[rows, slot].set(g + 1)
+            s = s + g.sum().astype(I64)
+        return t, out, s
+    bench("indexed gather+scatter [H,16] rows, 12 fields", (times, tabs, acc0), b20)
+
+    # 14. the current-engine combo: segment_min + segment_sum + 12 gathers +
+    # 12 H-scatters (approximate current micro-step reduction load)
+    def b14(c, i):
+        t, a = c
+        t = perturb(t, i)
+        data = jnp.where(live, t, INV)
+        m = jax.ops.segment_min(data, dst, num_segments=H)
+        s = jax.ops.segment_sum(jnp.where(live, 1, 0), dst, num_segments=H)
+        idx = (jnp.arange(H, dtype=I32) * K + (i % K)).astype(I32)
+        g = sum((t + n)[idx] for n in range(12))
+        out = (t + 1).at[idx].set(g, mode="drop")
+        return t, a + m.min() + s.max().astype(I64) + out[0]
+    bench("combo: segmin+segsum+12gathers+1scatter", (times, acc0), b14)
+
+
+if __name__ == "__main__":
+    main()
