@@ -54,17 +54,24 @@ def main():
     t0 = time.perf_counter()
     out = engine.run_chunked(warm, params, app,
                              SIM_SECONDS * simtime.SIMTIME_ONE_SECOND)
-    jax.block_until_ready(out)
+    # Sync point: a scalar data fetch (block_until_ready alone can return
+    # before the tunnel backend finishes executing).
+    n_steps = int(out.n_steps)
     wall = time.perf_counter() - t0
 
     events = int(out.app.recv.sum() - warm.app.recv.sum()) \
         + int(out.app.sent.sum() - warm.app.sent.sum())
     rate = events / wall
+    steps = max(n_steps - int(warm.n_steps), 1)
     print(json.dumps({
         "metric": "phold_events_per_sec",
         "value": round(rate, 2),
         "unit": "events/sec",
         "vs_baseline": round(rate / REFERENCE_EVENTS_PER_SEC, 4),
+        "events_per_microstep": round(events / steps, 2),
+        "microsteps": steps,
+        "windows": int(out.n_windows) - int(warm.n_windows),
+        "wall_sec": round(wall, 2),
     }))
 
 

@@ -20,8 +20,44 @@ src/main/core/support/definitions.h:28-64), which requires 64-bit mode;
 importing this package enables jax_enable_x64.
 """
 
+import os as _os
+
 import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: the engine's compiled step is large
+# (~40-60s to compile a TCP world) but identical across CLI invocations
+# with the same shapes, so warm runs skip straight to execution.
+try:
+    _cache_dir = _os.environ.get(
+        "SHADOW1_TPU_CACHE",
+        _os.path.join(_os.path.expanduser("~"), ".cache", "shadow1_tpu_xla"))
+    if _cache_dir:
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+except Exception:  # noqa: BLE001 - cache is best-effort
+    pass
+
+
+def build_on_host(fn, *args, **kwargs):
+    """Run a state-construction function with the local CPU as the default
+    device, then move the result to the default backend in one transfer.
+
+    Assembly creates hundreds of small arrays (socket tables, pool fields,
+    app state); on a tunneled TPU backend each creation is a full round
+    trip, turning a 2-host config load into minutes.  Building on the
+    in-process CPU backend and shipping the finished pytree once makes
+    assembly time independent of backend latency."""
+    cpu = _jax.devices("cpu")[0]
+    with _jax.default_device(cpu):
+        out = fn(*args, **kwargs)
+    default = _jax.devices()[0]
+    if default == cpu:
+        return out
+    return _jax.tree_util.tree_map(
+        lambda x: _jax.device_put(x, default) if hasattr(x, "ndim") else x,
+        out)
+
 
 __version__ = "0.1.0"

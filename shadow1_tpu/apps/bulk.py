@@ -89,21 +89,20 @@ class Bulk:
         running = active & a.is_client & (a.phase == 1)
         target_end = (jnp.uint32(1) + a.total.astype(U32))
         socks = tcp.write_v(socks, running, slot, target_end, now=tick_t)
-        rows = jnp.arange(h)
-        sslot = jnp.clip(slot, 0, socks.slots - 1)
-        all_written = socks.snd_end[rows, sslot] == target_end
+        cs = self.client_slot  # static -> column slices, not gathers
+        all_written = socks.snd_end[:, cs] == target_end
         socks = tcp.close_v(socks, running & all_written, slot)
 
         # 3. Completion: the client's FIN has been ACKed, which requires
         # every byte to be delivered first (snd_una == stream end + FIN).
         # A socket torn down by RST/timeout has error != 0 and moves to
         # phase 3 (failed) instead -- never counted as success.
-        cstate = socks.tcp_state[rows, sslot]
+        cstate = socks.tcp_state[:, cs]
         closed = (cstate == TCPS_FINWAIT2) | (cstate == TCPS_TIMEWAIT) | \
             (cstate == TCPS_CLOSED)
-        all_acked = socks.snd_una[rows, sslot] == \
+        all_acked = socks.snd_una[:, cs] == \
             (target_end + jnp.uint32(1))
-        failed = running & (socks.error[rows, sslot] != 0)
+        failed = running & (socks.error[:, cs] != 0)
         done = running & closed & all_acked & ~failed
         a = a.replace(
             phase=jnp.where(done, 2, jnp.where(failed, 3, a.phase)),

@@ -38,8 +38,10 @@ class Phold:
     """Static app config; hashable so jitted engine calls cache per config."""
 
     # Pure-UDP workload: the engine traces the TCP machine out of the
-    # compiled step entirely (engine._uses_tcp).
+    # compiled step entirely (engine._uses_tcp).  _pick_dst never picks
+    # self, so the loopback insert path traces away too.
     uses_tcp = False
+    may_loopback = False
 
     def __init__(self, mean_delay_ns: int, sock_slot: int = 0):
         self.mean_delay_ns = int(mean_delay_ns)

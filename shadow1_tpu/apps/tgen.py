@@ -279,17 +279,17 @@ class Tgen:
         streaming = at_stream & a.stream_active
         target = (jnp.uint32(1) + a.cur_send.astype(U32))
         socks = tcp.write_v(socks, streaming, slot, target, now=tick_t)
-        sslot = jnp.clip(slot, 0, socks.slots - 1)
-        written = socks.snd_end[rows, sslot] == target
+        cs = self.client_slot  # static -> column slices, not gathers
+        written = socks.snd_end[:, cs] == target
         socks = tcp.close_v(socks, streaming & written, slot)
 
         # completion / failure.
-        cstate = socks.tcp_state[rows, sslot]
-        got = socks.bytes_recv[rows, sslot]
+        cstate = socks.tcp_state[:, cs]
+        got = socks.bytes_recv[:, cs]
         torn = (cstate == TCPS_TIMEWAIT) | (cstate == TCPS_CLOSED)
         ok = streaming & torn & (got >= a.cur_recv)
         bad = streaming & ~ok & (
-            (socks.error[rows, sslot] != 0) |
+            (socks.error[:, cs] != 0) |
             (torn & (got < a.cur_recv)))
         fin_stream = ok | bad
         a = a.replace(

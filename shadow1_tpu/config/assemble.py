@@ -19,6 +19,8 @@ import os
 import jax.numpy as jnp
 import numpy as np
 
+import shadow1_tpu as _pkg
+
 from ..apps import tgen as tgen_app
 from ..core import simtime
 from ..core.params import (NetParams, QDISC_FIFO, QDISC_RR,
@@ -110,27 +112,36 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
                             // max(1, s.cpufrequency))
 
     # --- routing matrices -------------------------------------------------
-    lat_ns, rel, jit_ns = apsp.build_matrices(
-        jnp.asarray(topo.lat_ms), jnp.asarray(topo.edge_rel),
-        self_lat_ms=jnp.asarray(topo.self_lat_ms),
-        self_rel=jnp.asarray(topo.self_rel),
-        edge_jitter_ms=jnp.asarray(topo.jitter_ms),
-        self_jitter_ms=jnp.asarray(topo.self_jitter_ms))
+    # Small graphs resolve APSP + parameter packing on the local CPU
+    # backend in one shot (eager ops on a tunneled TPU each cost a round
+    # trip); big graphs run the Floyd-Warshall on the device, where the
+    # O(V^3) relaxation belongs.
+    def _routing_and_params():
+        lat_ns, rel, jit_ns = apsp.build_matrices(
+            jnp.asarray(topo.lat_ms), jnp.asarray(topo.edge_rel),
+            self_lat_ms=jnp.asarray(topo.self_lat_ms),
+            self_rel=jnp.asarray(topo.self_rel),
+            edge_jitter_ms=jnp.asarray(topo.jitter_ms),
+            self_jitter_ms=jnp.asarray(topo.self_jitter_ms))
+        return make_net_params(
+            latency_ns=lat_ns, reliability=rel,
+            host_vertex=host_vertex,
+            bw_up_Bps=bw_up, bw_down_Bps=bw_dn,
+            seed=seed,
+            stop_time=cfg.stoptime_s * SEC,
+            bootstrap_end=cfg.bootstrap_end_s * SEC,
+            jitter_ns=jit_ns,
+            cpu_ns_per_event=cpu_ns,
+            cpu_threshold_ns=(cpu_threshold_us * 1000
+                              if cpu_threshold_us >= 0 else -1),
+            cpu_precision_ns=max(1, cpu_precision_us) * 1000,
+            qdisc={"fifo": QDISC_FIFO, "rr": QDISC_RR}[qdisc],
+        )
 
-    params = make_net_params(
-        latency_ns=lat_ns, reliability=rel,
-        host_vertex=host_vertex,
-        bw_up_Bps=bw_up, bw_down_Bps=bw_dn,
-        seed=seed,
-        stop_time=cfg.stoptime_s * SEC,
-        bootstrap_end=cfg.bootstrap_end_s * SEC,
-        jitter_ns=jit_ns,
-        cpu_ns_per_event=cpu_ns,
-        cpu_threshold_ns=(cpu_threshold_us * 1000 if cpu_threshold_us >= 0
-                          else -1),
-        cpu_precision_ns=max(1, cpu_precision_us) * 1000,
-        qdisc={"fifo": QDISC_FIFO, "rr": QDISC_RR}[qdisc],
-    )
+    if topo.num_vertices <= 1024:
+        params = _pkg.build_on_host(_routing_and_params)
+    else:
+        params = _routing_and_params()
 
     # --- processes -> modeled apps ---------------------------------------
     # Each distinct tgen arguments file is one parsed action graph; a
@@ -181,21 +192,27 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
     # client count; exhaustion degrades to counted drops + the
     # ERR_POOL_OVERFLOW escape hatch rather than corruption.
     slab = int(max(pool_slab, min(4096, 32 * (1 + fan_in.max()))))
-    state = make_sim_state(h, sock_slots=sock_slots,
-                           pool_capacity=h * slab)
 
-    # --- install listeners + interpreter state ---------------------------
-    socks = state.socks
-    for gi, g in enumerate(graphs):
-        if g.serverport > 0:
-            mask = jnp.asarray(host_graph == gi)
-            socks = tcp.listen_v(socks, mask, 0, g.serverport,
-                                 backlog=int(fan_in.max()) + 1)
-    state = state.replace(socks=socks)
+    # State construction is hundreds of small array ops; build it on the
+    # local CPU backend and ship the finished pytree to the device once
+    # (shadow1_tpu.build_on_host) -- on a tunneled TPU backend each tiny
+    # op is a full round trip.
+    def _build_state():
+        state = make_sim_state(h, sock_slots=sock_slots,
+                               pool_capacity=h * slab)
+        socks = state.socks
+        for gi, g in enumerate(graphs):
+            if g.serverport > 0:
+                mask = jnp.asarray(host_graph == gi)
+                socks = tcp.listen_v(socks, mask, 0, g.serverport,
+                                     backlog=int(fan_in.max()) + 1)
+        state = state.replace(socks=socks)
+        return state.replace(app=tgen_app.build_state(
+            h, graphs, host_graph, start_t, stop_t,
+            resolve_peer=resolve_peer))
 
+    state = _pkg.build_on_host(_build_state)
     app = tgen_app.Tgen()
-    state = state.replace(app=tgen_app.build_state(
-        h, graphs, host_graph, start_t, stop_t, resolve_peer=resolve_peer))
 
     return Assembled(state=state, params=params, app=app, hostnames=names,
                      dns=dns, topology=topo, config=cfg,

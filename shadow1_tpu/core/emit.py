@@ -49,8 +49,12 @@ class Emissions:
     priority: jnp.ndarray    # [H,E] f32
 
 
-def empty(num_hosts: int) -> Emissions:
-    he = (num_hosts, NUM_SLOTS)
+def empty(num_hosts: int, num_slots: int = NUM_SLOTS) -> Emissions:
+    """`num_slots` trims the staging buffer to the lanes an app can
+    actually use (pure-UDP apps never emit from the RX-reply path or the
+    TCP transmitter, so 3 lanes suffice) -- the [H, E] routing gather in
+    the staging path scales with E."""
+    he = (num_hosts, num_slots)
     return Emissions(
         valid=jnp.zeros(he, jnp.bool_),
         dst=jnp.zeros(he, I32),

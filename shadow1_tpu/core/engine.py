@@ -16,23 +16,37 @@ Semantics preserved from the reference:
   reference's total order (time, dstHostID, srcHostID, srcHostEventID)
   (core/work/event.c:110-153).  Between hosts no order is needed --
   windows make them independent -- so the result is bitwise identical for
-  any device mesh.
+  any device mesh, any pool capacity, and any chunking of run_until calls.
 
-Structure: `run_until` runs an outer while_loop over windows; each window
-runs an inner while_loop of *micro-steps*.  One micro-step advances every
-host's earliest pending work simultaneously:
+Data layout (the whole performance story; numbers in tools/opbench*.py):
 
-  phase A  packet arrivals -> transport/socket processing (1/host/tick)
-  phase B  socket timer expirations (RTO, delayed ACK, TIME_WAIT)
-  phase C  application model tick (consume delivered data, timed sends)
-  phase D  TCP transmit + flush staged emissions into the packet pool
+* OUTBOX (state.pool): per-SOURCE slabs.  Emissions are staged into the
+  emitting host's own slab by row-local one-hot merges -- no scatter ops
+  in the hot loop (an XLA scatter costs ~1us/update inside a compiled
+  loop; a one-hot masked merge fuses for free).
 
-The per-phase work is bounded per tick (one arrival per host, a few
-emission slots), so each micro-step is a fixed-shape dataflow graph; hosts
-with nothing due are masked off.  "Find the next event" is a segment-min
-over the packet pool plus element-wise mins over timer tables -- the
-replacement for the reference's binary-heap pops (scheduler_pop,
-core/scheduler/scheduler.c:359).
+* INBOX (state.inbox): per-DESTINATION slabs, packed into one [P1, C]
+  i32 block.  Every per-micro-step reduction the engine needs -- next
+  arrival per host, NIC drain candidate, CoDel backlog -- is a row-local
+  reshape-min/sum over [H, slab] (~0ms) instead of the dst-keyed
+  segment-min over the whole pool that dominated the previous design
+  (12.7 ms per micro-step at 16k hosts).
+
+* WINDOW-BOUNDARY EXCHANGE (`_exchange`): packets that left their source
+  (stage IN_FLIGHT) move outbox -> inbox in bulk, once per window.  The
+  conservative invariant guarantees anything sent during window w arrives
+  at >= window_end(w), so arrivals for a window are fully known at its
+  start.  The move is one packed i32 row-scatter plus a hierarchical
+  rank-by-destination (scatter-add counts over superblocks + an exclusive
+  cumsum + in-superblock pairwise ranks): ~5ms per window, amortized over
+  the window's micro-steps.  This replaces the reference's per-packet
+  push onto locked destination-host queues (worker.c:293-300) with the
+  PDES equivalent of an all-to-all collective -- under a sharded mesh the
+  scatter IS the ICI all-to-all.
+
+Same-host loopback bypasses the exchange (reference's local path,
+network_interface.c:548-555): those packets are inserted straight into
+the sender's own inbox slab at staging time, which is row-local.
 """
 
 from __future__ import annotations
@@ -46,25 +60,21 @@ from . import emit, nic, rng, simtime
 # Reliability-dropped packets are never materialized in the pool (they are
 # counted in HostTable.pkts_dropped_inet instead), so PDS_INET_DROPPED is
 # deliberately absent here.
-from .state import (ERR_POOL_OVERFLOW, I32, I64, PROTO_TCP, PROTO_UDP,
+from .state import (ERR_POOL_OVERFLOW, I32, I64, U32, PROTO_TCP, PROTO_UDP,
                     STAGE_FREE, STAGE_IN_FLIGHT, STAGE_RX_QUEUED,
                     STAGE_TX_QUEUED, TCP_HEADER_SIZE, UDP_HEADER_SIZE,
                     PDS_INET_SENT, PDS_RCV_SOCKET_PROCESSED,
                     PDS_ROUTER_DROPPED, PDS_ROUTER_ENQUEUED,
-                    PDS_SND_CREATED, PDS_SND_INTERFACE_SENT, SimState)
+                    PDS_SND_CREATED, PDS_SND_INTERFACE_SENT,
+                    ICOL_SRC, ICOL_SPORT, ICOL_DPORT, ICOL_PROTO, ICOL_FLAGS,
+                    ICOL_SEQ, ICOL_ACK, ICOL_WND, ICOL_LEN, ICOL_PAYLOAD,
+                    ICOL_TIME_LO, ICOL_TIME_HI, ICOL_CTR_LO, ICOL_CTR_HI,
+                    ICOL_TS_LO, ICOL_TS_HI, ICOL_TSE_LO, ICOL_TSE_HI, ICOLS,
+                    enc_lo, enc_hi, dec_i64, SimState)
 
 INV = simtime.SIMTIME_INVALID
 
-
-def _seg_min(values, seg, num, mask):
-    big = jnp.asarray(INV, values.dtype)
-    data = jnp.where(mask, values, big)
-    return jax.ops.segment_min(data, seg, num_segments=num)
-
-
-# ---------------------------------------------------------------------------
-# Next-event scan (replaces priority-queue peeks)
-# ---------------------------------------------------------------------------
+_MASK40 = (jnp.int64(1) << 40) - 1
 
 
 def _uses_tcp(app) -> bool:
@@ -73,46 +83,49 @@ def _uses_tcp(app) -> bool:
     return getattr(app, "uses_tcp", True)
 
 
-def _slot_bits(p: int) -> int:
-    """Bits needed to pack a pool slot index into the low end of a key."""
-    return max(1, (p - 1).bit_length())
+def _may_loopback(app) -> bool:
+    """Static app capability: apps that never send to their own host let
+    the loopback insert path (an [H*E]-row scatter per micro-step) trace
+    away entirely."""
+    return getattr(app, "may_loopback", True)
 
 
-def rx_scan(state: SimState):
-    """ONE segment-min over the pool giving, per destination host, the
-    earliest inbound packet (IN_FLIGHT or RX_QUEUED) and its pool slot.
+def _bitcast_u32_i32(x):
+    return jax.lax.bitcast_convert_type(x.astype(U32), I32)
 
-    This single reduction serves both roles the engine needs each
-    micro-step -- "when is each host's next arrival" (the next-event scan)
-    and "which packet does the NIC drain next" (the rx selection) -- so
-    the expensive dst-keyed scatter-min runs once per micro-step instead
-    of three times.  The key packs (absolute time << slot_bits) | slot;
-    ties at equal time break by pool slot, which is mesh-invariant and
-    deterministic (slab slots are allocated in deterministic per-source
-    order).
 
-    Returns (t_arr [H] i64 arrival time or INV, rx_slot [H] i32 or -1).
-    """
-    pool, hosts = state.pool, state.hosts
-    h = hosts.num_hosts
-    p = pool.capacity
-    bits = _slot_bits(p)
-    # time << bits must fit below the INV sentinel: sim time is bounded by
-    # 2^(62-bits) ns (19 hours at the default 64k pool).
-    live = (pool.stage == STAGE_IN_FLIGHT) | (pool.stage == STAGE_RX_QUEUED)
-    key = (pool.time << bits) | jnp.arange(p, dtype=I64)
-    kmin = _seg_min(key, pool.dst, h, live)
-    have = kmin != jnp.asarray(INV, I64)
-    t_arr = jnp.where(have, kmin >> bits, jnp.asarray(INV, I64))
-    rx_slot = jnp.where(have, (kmin & ((1 << bits) - 1)).astype(I32), -1)
-    # Only future (IN_FLIGHT) candidates drive the time scan: a backlogged
-    # RX_QUEUED head's arrival is in the past, and re-processing it is
-    # owned by the t_resume wake machinery (armed whenever backlog
-    # remains), so letting it set t_h would freeze virtual time.
-    stage_at = pool.stage[jnp.clip(rx_slot, 0, p - 1)]
-    t_drive = jnp.where(have & (stage_at == STAGE_IN_FLIGHT), t_arr,
-                        jnp.asarray(INV, I64))
-    return t_drive, rx_slot
+def _bitcast_i32_u32(x):
+    return jax.lax.bitcast_convert_type(x.astype(I32), U32)
+
+
+class RxPkt:
+    """Field registers of the (at most one) packet delivered to each host
+    this micro-step -- [H] vectors decoded from the inbox block."""
+
+    __slots__ = ("src", "sport", "dport", "proto", "flags", "seq", "ack",
+                 "wnd", "length", "payload_id", "time", "ts", "ts_echo",
+                 "pkt_id")
+
+    def __init__(self, row, keys_row, time_row):
+        self.src = row[:, ICOL_SRC]
+        self.sport = row[:, ICOL_SPORT]
+        self.dport = row[:, ICOL_DPORT]
+        self.proto = row[:, ICOL_PROTO]
+        self.flags = row[:, ICOL_FLAGS]
+        self.seq = _bitcast_i32_u32(row[:, ICOL_SEQ])
+        self.ack = _bitcast_i32_u32(row[:, ICOL_ACK])
+        self.wnd = row[:, ICOL_WND]
+        self.length = row[:, ICOL_LEN]
+        self.payload_id = row[:, ICOL_PAYLOAD]
+        self.time = time_row
+        self.ts = dec_i64(row[:, ICOL_TS_LO], row[:, ICOL_TS_HI])
+        self.ts_echo = dec_i64(row[:, ICOL_TSE_LO], row[:, ICOL_TSE_HI])
+        self.pkt_id = keys_row
+
+
+# ---------------------------------------------------------------------------
+# Next-event scan (replaces priority-queue peeks)
+# ---------------------------------------------------------------------------
 
 
 def _aux_times(state: SimState, params, app):
@@ -151,23 +164,148 @@ def _cpu_clamp(state: SimState, params, t_h):
 
 
 def _scan_all(state: SimState, params, app):
-    """The combined per-micro-step scan: per-host next event time, its
-    global min, and the rx candidate slot.  Single source of truth for
-    both the jitted loop and the public next_times."""
-    t_arr, rx_slot = rx_scan(state)
-    t_h = jnp.minimum(t_arr, _aux_times(state, params, app))
+    """Per-host next event time [H] + its global min.
+
+    Arrival candidates come from the inbox only: IN_FLIGHT entries drive
+    the clock (their arrival instant); RX_QUEUED backlog (arrival in the
+    past, waiting on rx tokens) is owned by the t_resume wake machinery,
+    so it never drags virtual time backward.  Packets still in the outbox
+    are invisible here by design -- the conservative window invariant
+    puts their arrivals beyond the current window, and the boundary
+    exchange makes them visible before the next window's scan."""
+    ib = state.inbox
+    h = state.hosts.num_hosts
+    ki = ib.capacity // h
+    t2 = ib.times().reshape(h, ki)
+    drive = (ib.stage == STAGE_IN_FLIGHT).reshape(h, ki)
+    t_in = jnp.min(jnp.where(drive, t2, jnp.asarray(INV, I64)), axis=1)
+    t_h = jnp.minimum(t_in, _aux_times(state, params, app))
     t_h = _cpu_clamp(state, params, t_h)
-    return t_h, jnp.min(t_h), rx_slot
+    return t_h, jnp.min(t_h)
 
 
 def next_times(state: SimState, params, app):
     """Per-host earliest pending event time [H] and its global min."""
-    t_h, gmin, _ = _scan_all(state, params, app)
-    return t_h, gmin
+    return _scan_all(state, params, app)
+
+
+def _outbox_pending(state: SimState):
+    """Global earliest arrival among packets still awaiting the boundary
+    exchange (scalar i64; INV if none).  Keeps the outer window loop from
+    terminating while traffic is still in flight toward the inbox."""
+    pool = state.pool
+    t = jnp.where(pool.stage == STAGE_IN_FLIGHT, pool.time,
+                  jnp.asarray(INV, I64))
+    return jnp.min(t)
 
 
 # ---------------------------------------------------------------------------
-# Phase A: router enqueue -> NIC receive (token bucket + CoDel) -> delivery
+# Window-boundary exchange: outbox IN_FLIGHT -> inbox slabs
+# ---------------------------------------------------------------------------
+
+
+def _superblock(n: int) -> int:
+    """Items per rank superblock: large enough that the [B, M, M] pairwise
+    rank is a handful of MB, small enough that B*H count cells stay small."""
+    return min(512, n)
+
+
+def _exchange_body(state: SimState, params) -> SimState:
+    pool, ib, hosts = state.pool, state.inbox, state.hosts
+    h = hosts.num_hosts
+    p0 = pool.capacity
+    p1 = ib.capacity
+    ki = p1 // h
+
+    moving = pool.stage == STAGE_IN_FLIGHT             # [P0], src-major order
+    dst = jnp.clip(pool.dst, 0, h - 1)
+
+    # --- per-item rank among same-destination movers, in flat (src-major)
+    # order.  Flat order == (src, emission counter) order within a window
+    # because outbox slots free only at boundaries, so allocation indices
+    # are monotone across the window's micro-steps -- this reproduces the
+    # reference's (srcHostID, srcHostEventID) tiebreak (event.c:110-153).
+    m = _superblock(p0)
+    npad = -(-p0 // m) * m
+    pad = npad - p0
+    dstp = jnp.pad(dst, (0, pad))
+    mvp = jnp.pad(moving, (0, pad))
+    blkid = jnp.arange(npad, dtype=I32) // m
+    b = npad // m
+    ones = jnp.where(mvp, 1, 0).astype(I32)
+    cnt = jnp.zeros((b, h), I32).at[blkid, dstp].add(ones, mode="drop")
+    csum = jnp.cumsum(cnt, axis=0)
+    off = csum - cnt                                   # exclusive over blocks
+    total = csum[-1]                                   # [H] movers per dst
+    d3 = dstp.reshape(b, m)
+    l3 = mvp.reshape(b, m)
+    eq = (d3[:, :, None] == d3[:, None, :]) & l3[:, None, :]
+    lower = jnp.tril(jnp.ones((m, m), bool), -1)[None]
+    rank_in = jnp.sum(eq & lower, axis=2, dtype=I32).reshape(-1)
+    rank = off.reshape(-1)[blkid * h + dstp] + rank_in  # [npad]
+
+    # --- destination slab free-slot assignment (ascending slot order, so
+    # same-time ties keep rank order).
+    free2 = (ib.stage == STAGE_FREE).reshape(h, ki)
+    ids = jnp.arange(ki, dtype=I32)[None, :]
+    order2 = jnp.argsort(jnp.where(free2, ids, ids + ki), axis=1).astype(I32)
+    n_free = jnp.sum(free2, axis=1, dtype=I32)          # [H]
+    within = order2.reshape(-1)[dstp * ki + jnp.clip(rank, 0, ki - 1)]
+    ok = mvp & (rank < n_free[dstp])
+    islot = jnp.where(ok, dstp * ki + within, p1)       # p1 = drop sentinel
+
+    # --- packed block rows (all i32; i64 fields split lo/hi, u32 bitcast).
+    def pad0(x):
+        return jnp.pad(x, (0, pad))
+
+    ctr = pool.pkt_id & _MASK40
+    cols = [None] * ICOLS
+    cols[ICOL_SRC] = pool.src
+    cols[ICOL_SPORT] = pool.sport
+    cols[ICOL_DPORT] = pool.dport
+    cols[ICOL_PROTO] = pool.proto
+    cols[ICOL_FLAGS] = pool.flags
+    cols[ICOL_SEQ] = _bitcast_u32_i32(pool.seq)
+    cols[ICOL_ACK] = _bitcast_u32_i32(pool.ack)
+    cols[ICOL_WND] = pool.wnd
+    cols[ICOL_LEN] = pool.length
+    cols[ICOL_PAYLOAD] = pool.payload_id
+    cols[ICOL_TIME_LO] = enc_lo(pool.time)
+    cols[ICOL_TIME_HI] = enc_hi(pool.time)
+    cols[ICOL_CTR_LO] = enc_lo(ctr)
+    cols[ICOL_CTR_HI] = enc_hi(ctr)
+    cols[ICOL_TS_LO] = enc_lo(pool.ts)
+    cols[ICOL_TS_HI] = enc_hi(pool.ts)
+    cols[ICOL_TSE_LO] = enc_lo(pool.ts_echo)
+    cols[ICOL_TSE_HI] = enc_hi(pool.ts_echo)
+    vals = jnp.stack([pad0(c.astype(I32)) for c in cols], axis=1)  # [npad, C]
+
+    blk = ib.blk.at[islot].set(vals, mode="drop")
+    stage = ib.stage.at[islot].set(STAGE_IN_FLIGHT, mode="drop")
+    status = ib.status.at[islot].set(pad0(pool.status), mode="drop")
+    ib = ib.replace(blk=blk, stage=stage, status=status)
+
+    # Movers leave the outbox whether they fit or overflowed (an
+    # overflowed packet is a counted drop -- the fixed-capacity escape
+    # hatch, surfaced via ERR_POOL_OVERFLOW like slab exhaustion).
+    pool = pool.replace(stage=jnp.where(moving, STAGE_FREE, pool.stage))
+    drops = jnp.maximum(total - n_free, 0).astype(I64)
+    hosts = hosts.replace(
+        pkts_dropped_pool=hosts.pkts_dropped_pool + drops)
+    err = state.err | jnp.where(jnp.any(drops > 0), ERR_POOL_OVERFLOW,
+                                0).astype(state.err.dtype)
+    return state.replace(pool=pool, inbox=ib, hosts=hosts, err=err)
+
+
+def _exchange(state: SimState, params) -> SimState:
+    """Run the boundary exchange iff anything moved this window."""
+    moving = jnp.any(state.pool.stage == STAGE_IN_FLIGHT)
+    return jax.lax.cond(moving, lambda s: _exchange_body(s, params),
+                        lambda s: s, state)
+
+
+# ---------------------------------------------------------------------------
+# Phase A: inbox enqueue -> NIC receive (token bucket + CoDel) -> delivery
 # ---------------------------------------------------------------------------
 
 
@@ -178,116 +316,87 @@ def _wire_bytes(proto, length):
                               UDP_HEADER_SIZE)
 
 
-def _packet_latency(params, vs, vd, src, ctr):
-    """Path latency with the per-packet jitter draw: uniform in
-    +/- jitter_ns, keyed by (src, per-src counter) so the same packet
-    draws the same perturbation wherever its departure is computed
-    (reference carries per-edge jitter, topology.c:81-105)."""
-    lat = params.latency_ns[vs, vd]
-    jit = params.jitter_ns[vs, vd]
-    key = rng.purpose_key(params.seed_key, rng.PURPOSE_JITTER)
-    u = rng.keyed_uniform(key, src, ctr.astype(jnp.uint32),
-                          (ctr >> 32).astype(jnp.uint32))
-    delta = ((2.0 * u - 1.0) * jit.astype(jnp.float32)).astype(I64)
-    return jnp.maximum(lat + jnp.where(jit > 0, delta, 0),
-                       simtime.SIMTIME_ONE_NANOSECOND)
+def _rx_phase(state: SimState, params, em, tick_t, active, app):
+    """Arrivals: router enqueue (stage flip), NIC token/CoDel drain of one
+    packet per host, transport delivery, inbox slot free.
 
+    Merges the reference's _worker_runDeliverPacketTask -> router_enqueue
+    -> networkinterface_receivePackets -> socket_pushInPacket chain
+    (worker.c:236-241, router.c:104-123, network_interface.c:421-455)
+    into row-local ops over the destination slabs."""
+    from ..transport import tcp as tcp_mod
+    from ..transport import udp as udp_mod
 
-def _select_tx_slab(pool, tick_t, active, h):
-    """Pick per SOURCE host the earliest due TX_QUEUED packet.
+    ib, hosts = state.inbox, state.hosts
+    h = hosts.num_hosts
+    p1 = ib.capacity
+    ki = p1 // h
 
-    Packets live in their source's pool slab (slot // K == src), so this
-    is a reshape-min over [H, K] -- no dst-keyed scatter at all.  Ties at
-    equal time break by within-slab index (deterministic allocation
-    order).  Returns ([H] pool index or -1, [P] chosen mask).
-    """
-    p = pool.capacity
-    k = p // h
-    kb = _slot_bits(k)
-    stage2 = pool.stage.reshape(h, k)
-    time2 = pool.time.reshape(h, k)
-    due = (stage2 == STAGE_TX_QUEUED) & (time2 <= tick_t[:, None]) & \
+    t_arr = ib.times()
+    t2 = t_arr.reshape(h, ki)
+    st2 = ib.stage.reshape(h, ki)
+
+    # Router enqueue: wire arrivals whose time has come join the upstream
+    # router queue (a stage tag flip; `time` keeps the arrival instant so
+    # CoDel can compute sojourn).
+    due = (st2 == STAGE_IN_FLIGHT) & (t2 <= tick_t[:, None]) & \
         active[:, None]
-    key = jnp.where(due, (time2 << kb) | jnp.arange(k, dtype=I64)[None, :],
-                    jnp.asarray(INV, I64))
-    kmin = jnp.min(key, axis=1)
-    have = kmin != jnp.asarray(INV, I64)
-    j = (kmin & ((1 << kb) - 1)).astype(I32)
-    slot_of_host = jnp.where(have, jnp.arange(h, dtype=I32) * k + j, -1)
-    chosen = ((jnp.arange(k, dtype=I32)[None, :] == j[:, None]) &
-              have[:, None]).reshape(-1)
-    return slot_of_host, chosen
+    st2 = jnp.where(due, STAGE_RX_QUEUED, st2)
+    status = jnp.where(due.reshape(-1),
+                       ib.status | PDS_ROUTER_ENQUEUED, ib.status)
+    rx_q = hosts.rx_queued + jnp.sum(due, axis=1, dtype=I32)
 
+    # Head selection: earliest (time, pkt_id) among the queued backlog --
+    # the deterministic FIFO order of the reference's router queue plus
+    # the event total order for ties (event.c:110-153).
+    qm = st2 == STAGE_RX_QUEUED
+    tq = jnp.where(qm, t2, jnp.asarray(INV, I64))
+    tmin = jnp.min(tq, axis=1)
+    k2 = ib.order_keys().reshape(h, ki)
+    at_t = qm & (tq == tmin[:, None])
+    kq = jnp.where(at_t, k2, jnp.asarray(INV, I64))
+    kmin = jnp.min(kq, axis=1)
+    at = at_t & (kq == kmin[:, None])
+    ids = jnp.arange(ki, dtype=I32)[None, :]
+    col = jnp.min(jnp.where(at, ids, ki), axis=1)
+    have = active & (col < ki)
+    col = jnp.clip(col, 0, ki - 1)
+    flat = jnp.arange(h, dtype=I32) * ki + col
 
-def _router_enqueue(state: SimState, tick_t, active):
-    """Move due in-flight packets into the destination's upstream-router
-    queue (reference _worker_runDeliverPacketTask -> router_enqueue,
-    worker.c:236-241, router.c:104-123).  Purely a stage tag flip; `time`
-    keeps the wire-arrival instant so CoDel can compute sojourn."""
-    pool, hosts = state.pool, state.hosts
-    h = hosts.num_hosts
-    due = (pool.stage == STAGE_IN_FLIGHT) & (pool.time <= tick_t[pool.dst]) \
-        & active[pool.dst]
-    pool = pool.replace(
-        stage=jnp.where(due, STAGE_RX_QUEUED, pool.stage),
-        status=jnp.where(due, pool.status | PDS_ROUTER_ENQUEUED, pool.status),
-    )
-    counts = jax.ops.segment_sum(jnp.where(due, 1, 0), pool.dst,
-                                 num_segments=h)
-    hosts = hosts.replace(rx_queued=hosts.rx_queued + counts.astype(I32))
-    return state.replace(pool=pool, hosts=hosts)
+    # One packed gather for every field of the chosen packet.
+    row = ib.blk[flat]                                  # [H, ICOLS]
+    time_row = jnp.where(have, tmin, 0)
+    pkt = RxPkt(row, jnp.where(have, kmin, 0), time_row)
 
-
-def _rx_drain(state: SimState, params, tick_t, active, rx_slot):
-    """NIC receive: drain one packet per host from the router queue,
-    gated by the downstream token bucket and the CoDel drop law
-    (reference networkinterface_receivePackets, network_interface.c:421-455
-    + router_queue_codel.c).  `rx_slot` is the per-dst earliest inbound
-    packet from the previous micro-step's rx_scan (every packet staged
-    since then arrives beyond the conservative window, so the candidate
-    set cannot have changed).  Returns (state, slot_of_host,
-    chosen_deliver) for the transport layer."""
-    pool, hosts = state.pool, state.hosts
-    h = hosts.num_hosts
-
-    slot = jnp.clip(rx_slot, 0, pool.capacity - 1)
-    have = (rx_slot >= 0) & active & (pool.time[slot] <= tick_t)
-    slot_of_host = jnp.where(have, rx_slot, -1)
-    # <=1 chosen per pool slot (a slot's dst is fixed) and only True is
-    # ever written (non-candidates target the dropped sentinel index), so
-    # the scatter is collision-free; update count is H, not P.
-    chosen = jnp.zeros((pool.capacity,), bool).at[
-        jnp.where(have, slot, pool.capacity)].set(True, mode="drop")
-
+    # NIC rx: token bucket + CoDel.
     tokens, last = nic.refill(hosts.tokens_rx, hosts.last_refill_rx,
                               params.bw_down_Bps, tick_t, active)
-    size = _wire_bytes(pool.proto[slot], pool.length[slot]).astype(I64) \
-        * nic.SCALE
-    loop = pool.src[slot] == pool.dst[slot]
+    size = _wire_bytes(pkt.proto, pkt.length).astype(I64) * nic.SCALE
+    loop = pkt.src == jnp.arange(h, dtype=I32)
     boot = tick_t < params.bootstrap_end
     free_pass = loop | boot
     funded = have & (free_pass | (tokens >= size))
 
-    # CoDel decision for funded, non-loopback dequeues.
-    sojourn = tick_t - pool.time[slot]
-    backlog_after = hosts.rx_queued - 1
-    hosts, drop = nic.codel_dequeue(hosts, funded & ~loop, tick_t, sojourn,
-                                    backlog_after)
+    sojourn = tick_t - time_row
+    backlog_after = rx_q - 1
+    hosts2, drop = nic.codel_dequeue(hosts, funded & ~loop, tick_t, sojourn,
+                                     backlog_after)
+    hosts = hosts2
     deliver = funded & ~drop
 
-    # Charge the bucket for everything dequeued (delivered or dropped).
     tokens = tokens - jnp.where(funded & ~free_pass, size, 0)
     hosts = hosts.replace(tokens_rx=tokens, last_refill_rx=last)
 
-    # Dropped packets leave the pool.
-    chosen_drop = chosen & drop[pool.dst]
-    pool = pool.replace(
-        stage=jnp.where(chosen_drop, STAGE_FREE, pool.stage),
-        status=jnp.where(chosen_drop, pool.status | PDS_ROUTER_DROPPED,
-                         pool.status),
-    )
+    # Inbox slot release + status trail for everything dequeued.
+    oh = (ids == col[:, None])
+    st2 = jnp.where(oh & funded[:, None], STAGE_FREE, st2)
+    fm = (oh & (funded & drop)[:, None]).reshape(-1)
+    status = jnp.where(fm, status | PDS_ROUTER_DROPPED, status)
+    dm = (oh & deliver[:, None]).reshape(-1)
+    status = jnp.where(dm, status | PDS_RCV_SOCKET_PROCESSED, status)
+
     hosts = hosts.replace(
-        rx_queued=hosts.rx_queued - jnp.where(funded, 1, 0).astype(I32),
+        rx_queued=rx_q - jnp.where(funded, 1, 0).astype(I32),
         pkts_dropped_router=hosts.pkts_dropped_router +
         jnp.where(drop, 1, 0),
     )
@@ -301,50 +410,27 @@ def _rx_drain(state: SimState, params, tick_t, active, rx_slot):
                   jnp.asarray(INV, I64)))
     hosts = hosts.replace(t_resume=jnp.minimum(hosts.t_resume, t_res))
 
-    state = state.replace(pool=pool, hosts=hosts)
-    slot_deliver = jnp.where(deliver, slot_of_host, -1)
-    return state, slot_deliver, chosen & deliver[pool.dst]
+    state = state.replace(
+        inbox=ib.replace(stage=st2.reshape(-1), status=status),
+        hosts=hosts)
 
-
-def _deliver(state: SimState, params, em, tick_t, pool_slot, chosen, app):
-    """Deliver the selected packets to their sockets (UDP now; TCP hooks in
-    transport/tcp.py once present)."""
-    from ..transport import tcp as tcp_mod
-    from ..transport import udp as udp_mod
-
-    pool = state.pool
-    have = pool_slot >= 0
-    slot = jnp.clip(pool_slot, 0, pool.capacity - 1)
-
-    g = lambda a: a[slot]
-    src, sport, dport = g(pool.src), g(pool.sport), g(pool.dport)
-    proto, length, payload = g(pool.proto), g(pool.length), g(pool.payload_id)
-
-    # UDP
-    udp_mask = have & (proto == PROTO_UDP)
-    socks, _accepted = udp_mod.deliver(state.socks, udp_mask, src, sport,
-                                       dport, length, payload)
+    # Transport delivery.
+    udp_mask = deliver & (pkt.proto == PROTO_UDP)
+    socks, _accepted = udp_mod.deliver(state.socks, udp_mask, pkt.src,
+                                       pkt.sport, pkt.dport, pkt.length,
+                                       pkt.payload_id)
     state = state.replace(socks=socks)
-
-    # TCP
     if _uses_tcp(app):
-        tcp_mask = have & (proto == PROTO_TCP)
-        state, em = tcp_mod.process_arrivals(state, params, em, tick_t, slot,
-                                             tcp_mask)
+        tcp_mask = deliver & (pkt.proto == PROTO_TCP)
+        state, em = tcp_mod.process_arrivals(state, params, em, tick_t,
+                                             pkt, tcp_mask)
 
-    # Consume delivered packets & account (elementwise via the [P] mask --
-    # no duplicate-index scatters).
-    pool = pool.replace(
-        stage=jnp.where(chosen, STAGE_FREE, pool.stage),
-        status=jnp.where(chosen, pool.status | PDS_RCV_SOCKET_PROCESSED,
-                         pool.status),
-    )
     hosts = state.hosts
     hosts = hosts.replace(
-        pkts_recv=hosts.pkts_recv + jnp.where(have, 1, 0),
-        bytes_recv=hosts.bytes_recv + jnp.where(have, length, 0),
+        pkts_recv=hosts.pkts_recv + jnp.where(deliver, 1, 0),
+        bytes_recv=hosts.bytes_recv + jnp.where(deliver, pkt.length, 0),
     )
-    return state.replace(pool=pool, hosts=hosts), em
+    return state.replace(hosts=hosts), em, deliver
 
 
 # ---------------------------------------------------------------------------
@@ -352,23 +438,66 @@ def _deliver(state: SimState, params, em, tick_t, pool_slot, chosen, app):
 # ---------------------------------------------------------------------------
 
 
+def _route(params, vs, vd, src, ctr):
+    """Packed routing lookup + per-packet jitter draw: ONE row gather for
+    (latency, jitter, reliability).  Jitter perturbs latency uniformly in
+    +/- the pair's amplitude, keyed by (src, per-src counter) so the same
+    packet draws the same perturbation wherever its departure is computed
+    (reference carries per-edge jitter, topology.c:81-105).
+
+    Returns (latency_ns i64, reliability f32)."""
+    lat, jit, rel = params.route(vs, vd)
+    key = rng.purpose_key(params.seed_key, rng.PURPOSE_JITTER)
+    u = rng.keyed_uniform(key, src, ctr.astype(jnp.uint32),
+                          (ctr >> 32).astype(jnp.uint32))
+    delta = ((2.0 * u - 1.0) * jit.astype(jnp.float32)).astype(I64)
+    lat = jnp.maximum(lat + jnp.where(jit > 0, delta, 0),
+                      simtime.SIMTIME_ONE_NANOSECOND)
+    return lat, rel
+
+
+def _free_slot_pick(free2, rank2):
+    """Scatter/sort-free slab allocation: `free2` [H,K] marks free slots,
+    `rank2` [H,E] is each emission's 0-based ordinal among its host's
+    allocations this tick.  Returns [H,E] slot columns such that the r-th
+    allocation takes the r-th free slot in ascending index order (callers
+    must mask by rank2 < n_free).  Pure cumsum + one-hot -- an argsort
+    here costs milliseconds in host-major layout."""
+    h, k = free2.shape
+    pos = jnp.cumsum(free2, axis=1) - 1           # rank of each free slot
+    ids = jnp.arange(k, dtype=I32)[None, None, :]
+    onehot = free2[:, None, :] & (pos[:, None, :] == rank2[:, :, None]) & \
+        (rank2 >= 0)[:, :, None]
+    return jnp.sum(jnp.where(onehot, ids, 0), axis=2, dtype=I32)
+
+
+def _merge_rows(cur, val2, oh, hit, shape):
+    """One-hot merge of [H,E] emission values into [H,K] slab rows (the
+    scatter-free staging primitive): entry (h,k) takes the value of the
+    emission lane mapped to it, else keeps its current value."""
+    v = jnp.sum(jnp.where(oh, val2[:, :, None], 0), axis=1, dtype=cur.dtype)
+    return jnp.where(hit, v, cur.reshape(shape)).reshape(-1)
+
+
 def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
-                     active):
+                     active, app):
     """Assign pkt_ids, apply routing latency + reliability drops, and
-    scatter staged emissions into free pool slots -- direct to IN_FLIGHT
-    when the tx token bucket covers them, else parked in TX_QUEUED.
+    merge staged emissions into free OUTBOX slots of the emitting host's
+    own slab -- direct to IN_FLIGHT when the tx token bucket covers them,
+    else parked in TX_QUEUED.  Same-host loopback packets go straight
+    into the sender's inbox slab with a 1ns delay (reference local path,
+    network_interface.c:548-555).
 
     The reference equivalent is the interface send path + worker_sendPacket
     (/root/reference/src/main/host/network_interface.c:466-540,
     src/main/core/worker.c:243-304): qdisc select under token budget,
     reliability draw, latency lookup, push event to the destination host
-    queue.  Loopback bypasses the NIC with a 1ns delay like the
-    reference's local path (network_interface.c:548-555); the bootstrap
-    period bypasses bandwidth (network_interface.c:432-434,522).
-    """
+    queue.  The bootstrap period bypasses bandwidth
+    (network_interface.c:432-434,522)."""
     pool, hosts = state.pool, state.hosts
     h, e = em.valid.shape
-    p = pool.capacity
+    p0 = pool.capacity
+    ko = p0 // h
 
     valid = em.valid
     rank = jnp.cumsum(valid, axis=1) - 1              # [H,E] within-host order
@@ -380,11 +509,11 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     pkt_id2 = (src2.astype(I64) << 40) | ctr2
 
     # Routing: latency (+ per-packet jitter) + reliability, loopback
-    # shortcut.
-    vs = params.host_vertex[src2]
+    # shortcut.  vs is the emitting host's own vertex -- a broadcast, not
+    # a gather.
+    vs = jnp.broadcast_to(params.host_vertex[:, None], (h, e))
     vd = params.host_vertex[jnp.clip(em.dst, 0, params.host_vertex.shape[0] - 1)]
-    lat = _packet_latency(params, vs, vd, src2, ctr2)
-    rel = params.reliability[vs, vd]
+    lat, rel = _route(params, vs, vd, src2, ctr2)
     loop = em.dst == src2
     lat = jnp.where(loop, simtime.SIMTIME_ONE_NANOSECOND, lat)
     rel = jnp.where(loop, 1.0, rel)
@@ -394,39 +523,23 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
                           (ctr2 >> 32).astype(jnp.uint32))
     dropped = valid & (u >= rel)
     live = valid & ~dropped
+    lb = live & loop if _may_loopback(app) else jnp.zeros_like(live)
+    nl = live & ~lb
 
-    # Allocate free pool slots to live emissions from the emitting host's
-    # own slab: the pool is partitioned into H contiguous slabs of K slots
-    # (see make_sim_state), so allocation is a per-slab scan of K elements
-    # -- no full-pool nonzero/cumsum per micro-step (which blew the TPU
-    # scoped-VMEM budget as a [P]-length u32 reduce-window at P=64k) and
-    # no cross-host allocation order to keep deterministic.
-    k = p // h
-    assert p == h * k, "pool capacity must be num_hosts * slab"
-    free = (pool.stage == STAGE_FREE).reshape(h, k)
-    # Sort keys put free slots first in ascending index order, so entry r
-    # of `order` is the r-th free slot of the slab.
-    slab_ids = jnp.arange(k, dtype=I32)[None, :]
-    order = jnp.argsort(jnp.where(free, slab_ids, slab_ids + k), axis=1)
-    n_free = jnp.sum(free, axis=1)                     # [H]
-    live_rank = jnp.cumsum(live, axis=1) - 1           # [H,E] 0-based
-    within = jnp.take_along_axis(order, jnp.clip(live_rank, 0, k - 1),
-                                 axis=1)               # [H,E]
-    have_slot = live & (live_rank < n_free[:, None])
-    # Sentinel for "no slot" is `p`, NOT -1: negative scatter indices wrap
-    # in XLA even under mode='drop'; only >= size is dropped.
-    slot = jnp.where(have_slot,
-                     jnp.arange(h, dtype=I32)[:, None] * k + within,
-                     p).reshape(-1)
-    overflow = jnp.any(live & ~have_slot)
+    # --- outbox slab allocation for non-loopback emissions: free slots in
+    # ascending index order; the r-th live emission takes the r-th free
+    # slot.  (Allocation order is monotone across a window's micro-steps
+    # because outbox slots free only at boundaries -- see _exchange.)
+    free = (pool.stage == STAGE_FREE).reshape(h, ko)
+    ids = jnp.arange(ko, dtype=I32)[None, :]
+    n_free = jnp.sum(free, axis=1)
+    nl_rank = jnp.where(nl, jnp.cumsum(nl, axis=1) - 1, -1)  # [H,E] 0-based
+    within = _free_slot_pick(free, nl_rank)
+    have_slot = nl & (nl_rank >= 0) & (nl_rank < n_free[:, None])
+    placed = have_slot                                  # outbox-placed
 
-    send_t = jnp.broadcast_to(tick_t[:, None], (h, e)).reshape(-1)
-    arr_t = send_t + lat.reshape(-1)
-
-    # Only emissions that actually got a pool slot exist from here on:
-    # slab-exhausted ones are counted drops (pkts_dropped_pool below) and
-    # must not charge tokens, park, or count as sent.
-    placed = live & have_slot
+    send_t = jnp.broadcast_to(tick_t[:, None], (h, e))
+    arr_t = send_t + lat
 
     # --- NIC tx admission: direct-admit under the token budget, else park
     # in TX_QUEUED for _tx_drain (FIFO is preserved because any backlog
@@ -434,83 +547,96 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     tokens, last = nic.refill(hosts.tokens_tx, hosts.last_refill_tx,
                               params.bw_up_Bps, tick_t, active)
     sizes = _wire_bytes(em.proto, em.length).astype(I64) * nic.SCALE
-    nonloop = placed & ~loop
-    sizes_nl = jnp.where(nonloop, sizes, 0)
+    sizes_nl = jnp.where(placed, sizes, 0)
     prefix = jnp.cumsum(sizes_nl, axis=1)
     boot2 = (tick_t < params.bootstrap_end)[:, None]
     ok_budget = (hosts.tx_queued == 0)[:, None] & (prefix <= tokens[:, None])
-    admit = placed & (loop | boot2 | ok_budget)
-    spent = jnp.sum(jnp.where(admit & ~loop & ~boot2, sizes, 0), axis=1)
+    admit = placed & (boot2 | ok_budget)
+    spent = jnp.sum(jnp.where(admit & ~boot2, sizes, 0), axis=1)
     tokens = tokens - spent
-    admitf = admit.reshape(-1)
     parked = placed & ~admit
     hosts = hosts.replace(
         tokens_tx=tokens, last_refill_tx=last,
-        tx_queued=hosts.tx_queued +
-        jnp.sum(parked, axis=1).astype(I32))
+        tx_queued=hosts.tx_queued + jnp.sum(parked, axis=1).astype(I32))
 
-    stage_v = jnp.where(admitf, STAGE_IN_FLIGHT, STAGE_TX_QUEUED)
-    time_v = jnp.where(admitf, arr_t, send_t)
+    stage_v = jnp.where(admit, STAGE_IN_FLIGHT, STAGE_TX_QUEUED)
+    time_v = jnp.where(admit, arr_t, send_t)
     status_v = jnp.where(
-        admitf,
+        admit,
         PDS_SND_CREATED | PDS_SND_INTERFACE_SENT | PDS_INET_SENT,
         PDS_SND_CREATED)
 
-    def sc(a, val, dtype=None):
-        v = val.reshape(-1) if hasattr(val, "reshape") else val
-        if dtype is not None:
-            v = v.astype(dtype)
-        return a.at[slot].set(v, mode="drop")
+    # --- scatter-free merge into the outbox slab rows.
+    oh = (within[:, :, None] == ids[:, None, :]) & have_slot[:, :, None]
+    hit = jnp.any(oh, axis=1)
+
+    def mg(cur, val2):
+        return _merge_rows(cur, val2, oh, hit, (h, ko))
 
     pool = pool.replace(
-        stage=sc(pool.stage, stage_v),
-        src=sc(pool.src, src2),
-        dst=sc(pool.dst, em.dst),
-        sport=sc(pool.sport, em.sport),
-        dport=sc(pool.dport, em.dport),
-        proto=sc(pool.proto, em.proto),
-        flags=sc(pool.flags, em.flags),
-        seq=sc(pool.seq, em.seq),
-        ack=sc(pool.ack, em.ack),
-        wnd=sc(pool.wnd, em.wnd),
-        length=sc(pool.length, em.length),
-        time=sc(pool.time, time_v),
-        pkt_id=sc(pool.pkt_id, pkt_id2),
-        ts=sc(pool.ts, send_t),
-        ts_echo=sc(pool.ts_echo, em.ts_echo),
-        payload_id=sc(pool.payload_id, em.payload_id),
-        priority=sc(pool.priority, em.priority),
-        status=sc(pool.status, status_v),
+        stage=mg(pool.stage, stage_v),
+        src=mg(pool.src, src2),
+        dst=mg(pool.dst, em.dst),
+        sport=mg(pool.sport, em.sport),
+        dport=mg(pool.dport, em.dport),
+        proto=mg(pool.proto, em.proto),
+        flags=mg(pool.flags, em.flags),
+        seq=mg(pool.seq, em.seq),
+        ack=mg(pool.ack, em.ack),
+        wnd=mg(pool.wnd, em.wnd),
+        length=mg(pool.length, em.length),
+        time=mg(pool.time, time_v),
+        lat_ns=mg(pool.lat_ns, lat),
+        pkt_id=mg(pool.pkt_id, pkt_id2),
+        ts=mg(pool.ts, send_t),
+        ts_echo=mg(pool.ts_echo, em.ts_echo),
+        payload_id=mg(pool.payload_id, em.payload_id),
+        priority=mg(pool.priority, em.priority),
+        status=mg(pool.status, status_v),
     )
+    state = state.replace(pool=pool, hosts=hosts)
 
-    sent_bytes = jnp.sum(jnp.where(placed, em.length, 0), axis=1).astype(I64)
+    # --- loopback: straight into the sender's own inbox slab (row-local
+    # allocation; the block write is an [H*E]-row scatter, traced away
+    # when the app never loops back).
+    lb_placed = jnp.zeros_like(lb)
+    if _may_loopback(app):
+        state, lb_placed = _loopback_insert(state, em, lb, src2, ctr2,
+                                            send_t)
+
+    all_placed = placed | lb_placed
+    overflow = jnp.any(live & ~all_placed & ~lb) | jnp.any(lb & ~lb_placed)
+    sent_bytes = jnp.sum(jnp.where(all_placed, em.length, 0),
+                         axis=1).astype(I64)
+    hosts = state.hosts
     hosts = hosts.replace(
         send_ctr=ctr + counts,
-        pkts_sent=hosts.pkts_sent + jnp.sum(placed, axis=1),
+        pkts_sent=hosts.pkts_sent + jnp.sum(all_placed, axis=1),
         bytes_sent=hosts.bytes_sent + sent_bytes,
         pkts_dropped_inet=hosts.pkts_dropped_inet + jnp.sum(dropped, axis=1),
         pkts_dropped_pool=hosts.pkts_dropped_pool +
-        jnp.sum(live & ~have_slot, axis=1),
+        jnp.sum(live & ~all_placed, axis=1),
     )
-    err = state.err | jnp.where(overflow, ERR_POOL_OVERFLOW, 0).astype(jnp.int32)
-    state = state.replace(pool=pool, hosts=hosts, err=err)
+    err = state.err | jnp.where(overflow, ERR_POOL_OVERFLOW,
+                                0).astype(jnp.int32)
+    state = state.replace(hosts=hosts, err=err)
 
     # Packet capture (PCAP analog; only traced when a CaptureRing is
     # installed): record every placed emission at send time.
     if state.cap is not None:
         cap = state.cap
         c = cap.capacity
-        placedf = placed.reshape(-1)
-        rank = jnp.cumsum(placedf) - 1
+        placedf = all_placed.reshape(-1)
+        crank = jnp.cumsum(placedf) - 1
         n_new = jnp.sum(placedf).astype(I64)
-        pos = ((cap.total + rank) % c).astype(I32)
+        pos = ((cap.total + crank) % c).astype(I32)
         # One batch larger than the ring would wrap onto itself and make
         # the surviving record per slot scatter-order-dependent; keep the
         # first `c` records of such a batch instead (deterministic) --
         # size the ring above H*NUM_SLOTS to never hit this.  `total` must
         # then also advance by what was *written*, not what was staged, or
         # the writer would treat never-written slots as valid records.
-        idx = jnp.where(placedf & (rank < c), pos, c)  # c = dropped write
+        idx = jnp.where(placedf & (crank < c), pos, c)  # c = dropped write
         n_new = jnp.minimum(n_new, c)
 
         def cw(a, val, dtype=None):
@@ -532,7 +658,77 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
             ack=cw(cap.ack, em.ack),
             total=cap.total + n_new,
         ))
-    return state
+    return state, all_placed
+
+
+def _loopback_insert(state: SimState, em, lb, src2, ctr2, send_t):
+    """Insert loopback emissions into the sender's own inbox slab.
+    Arrival = send + 1ns (reference network_interface.c:548-555)."""
+    ib = state.inbox
+    h, e = lb.shape
+    p1 = ib.capacity
+    ki = p1 // h
+
+    free2 = (ib.stage == STAGE_FREE).reshape(h, ki)
+    n_free = jnp.sum(free2, axis=1)
+    lb_rank = jnp.where(lb, jnp.cumsum(lb, axis=1) - 1, -1)
+    within = _free_slot_pick(free2, lb_rank)
+    ok = lb & (lb_rank >= 0) & (lb_rank < n_free[:, None])
+    islot = jnp.where(ok, src2 * ki + within, p1).reshape(-1)
+
+    arr = send_t + simtime.SIMTIME_ONE_NANOSECOND
+    cols = [None] * ICOLS
+    cols[ICOL_SRC] = src2
+    cols[ICOL_SPORT] = em.sport
+    cols[ICOL_DPORT] = em.dport
+    cols[ICOL_PROTO] = em.proto
+    cols[ICOL_FLAGS] = em.flags
+    cols[ICOL_SEQ] = _bitcast_u32_i32(em.seq)
+    cols[ICOL_ACK] = _bitcast_u32_i32(em.ack)
+    cols[ICOL_WND] = em.wnd
+    cols[ICOL_LEN] = em.length
+    cols[ICOL_PAYLOAD] = em.payload_id
+    cols[ICOL_TIME_LO] = enc_lo(arr)
+    cols[ICOL_TIME_HI] = enc_hi(arr)
+    cols[ICOL_CTR_LO] = enc_lo(ctr2)
+    cols[ICOL_CTR_HI] = enc_hi(ctr2)
+    cols[ICOL_TS_LO] = enc_lo(send_t)
+    cols[ICOL_TS_HI] = enc_hi(send_t)
+    cols[ICOL_TSE_LO] = enc_lo(em.ts_echo)
+    cols[ICOL_TSE_HI] = enc_hi(em.ts_echo)
+    vals = jnp.stack([c.astype(I32).reshape(-1) for c in cols], axis=1)
+
+    pds = PDS_SND_CREATED | PDS_SND_INTERFACE_SENT | PDS_INET_SENT
+    ib = ib.replace(
+        blk=ib.blk.at[islot].set(vals, mode="drop"),
+        stage=ib.stage.at[islot].set(STAGE_IN_FLIGHT, mode="drop"),
+        status=ib.status.at[islot].set(pds, mode="drop"),
+    )
+    return state.replace(inbox=ib), ok
+
+
+def _select_tx_slab(pool, tick_t, active, h):
+    """Pick per SOURCE host the earliest due TX_QUEUED packet.
+
+    Two-phase row-min (time, then within-slab index) over the source's
+    own slab -- deterministic and free of any packed-key time bound.
+    Returns ([H] pool index or -1, [P] chosen mask)."""
+    p = pool.capacity
+    k = p // h
+    stage2 = pool.stage.reshape(h, k)
+    time2 = pool.time.reshape(h, k)
+    due = (stage2 == STAGE_TX_QUEUED) & (time2 <= tick_t[:, None]) & \
+        active[:, None]
+    td = jnp.where(due, time2, jnp.asarray(INV, I64))
+    tmin = jnp.min(td, axis=1)
+    ids = jnp.arange(k, dtype=I32)[None, :]
+    at = due & (td == tmin[:, None])
+    j = jnp.min(jnp.where(at, ids, k), axis=1)
+    have = j < k
+    j = jnp.clip(j, 0, k - 1)
+    slot_of_host = jnp.where(have, jnp.arange(h, dtype=I32) * k + j, -1)
+    chosen = ((ids == j[:, None]) & have[:, None]).reshape(-1)
+    return slot_of_host, chosen
 
 
 def _tx_drain(state: SimState, params, tick_t, active):
@@ -555,20 +751,18 @@ def _tx_drain(state: SimState, params, tick_t, active):
     funded = have & (boot | (tokens >= size))
     tokens = tokens - jnp.where(funded & ~boot, size, 0)
 
-    # Departure: arrival = now + path latency (drop draw already happened
-    # at staging, keyed by pkt_id, so loss is independent of queueing; the
-    # jitter draw keys on the same (src, ctr) identity).
-    nv = params.host_vertex.shape[0]
-    vs = params.host_vertex[jnp.clip(pool.src[slot], 0, h - 1)]
-    vd = params.host_vertex[jnp.clip(pool.dst[slot], 0, nv - 1)]
-    pid = pool.pkt_id[slot]
-    arr = tick_t + _packet_latency(params, vs, vd,
-                                   (pid >> 40).astype(I32),
-                                   pid & ((jnp.int64(1) << 40) - 1))
-    chosen_dep = chosen & funded[pool.src]
+    # Departure: arrival = now + the latency fixed at staging (which
+    # already includes this packet's keyed jitter draw, so departure needs
+    # no routing lookup; the reliability draw also happened at staging, so
+    # loss is independent of queueing).
+    arr = tick_t + pool.lat_ns[slot]
+    ko = pool.capacity // h
+    funded_b = jnp.broadcast_to(funded[:, None], (h, ko)).reshape(-1)
+    arr_b = jnp.broadcast_to(arr[:, None], (h, ko)).reshape(-1)
+    chosen_dep = chosen & funded_b
     pool = pool.replace(
         stage=jnp.where(chosen_dep, STAGE_IN_FLIGHT, pool.stage),
-        time=jnp.where(chosen_dep, arr[pool.src], pool.time),
+        time=jnp.where(chosen_dep, arr_b, pool.time),
         status=jnp.where(chosen_dep,
                          pool.status | PDS_SND_INTERFACE_SENT | PDS_INET_SENT,
                          pool.status),
@@ -592,7 +786,7 @@ def _tx_drain(state: SimState, params, tick_t, active):
 # ---------------------------------------------------------------------------
 
 
-def _microstep_core(state: SimState, params, app, t_h, window_end, rx_slot):
+def _microstep_core(state: SimState, params, app, t_h, window_end):
     """Advance every host's earliest pending event (< window_end)."""
     from ..transport import tcp as tcp_mod
 
@@ -606,14 +800,12 @@ def _microstep_core(state: SimState, params, app, t_h, window_end, rx_slot):
         hosts=state.hosts.replace(t_resume=jnp.where(
             active, jnp.asarray(INV, I64), state.hosts.t_resume)))
 
-    em = emit.empty(h)
+    n_lanes = emit.NUM_SLOTS if _uses_tcp(app) else emit.SLOT_APP + 1
+    em = emit.empty(h, n_lanes)
 
-    # Phase A: wire arrivals -> router queue -> NIC rx (tokens + CoDel)
-    # -> transport delivery.
-    state = _router_enqueue(state, tick_t, active)
-    state, pool_slot, chosen = _rx_drain(state, params, tick_t, active,
-                                         rx_slot)
-    state, em = _deliver(state, params, em, tick_t, pool_slot, chosen, app)
+    # Phase A: arrivals through the destination slab (router queue, NIC rx
+    # tokens + CoDel, transport delivery).
+    state, em, delivered = _rx_phase(state, params, em, tick_t, active, app)
 
     # Phase B: transport timers.
     if _uses_tcp(app):
@@ -623,11 +815,12 @@ def _microstep_core(state: SimState, params, app, t_h, window_end, rx_slot):
     if app is not None:
         state, em = app.on_tick(state, params, em, tick_t, active)
 
-    # Phase D: TCP transmission, flush staged emissions through the NIC tx
-    # bucket (direct-admit or park), then drain parked packets.
+    # Phase D: TCP transmission, merge staged emissions into the outbox
+    # (direct-admit or park) or own inbox (loopback), then drain parked
+    # packets through the tx bucket.
     if _uses_tcp(app):
         state, em = tcp_mod.transmit(state, params, em, tick_t, active)
-    state = _stage_emissions(state, params, em, tick_t, active)
+    state, placed = _stage_emissions(state, params, em, tick_t, active, app)
     state = _tx_drain(state, params, tick_t, active)
 
     # Virtual CPU accounting (reference cpu_updateTime + cpu_addDelay,
@@ -636,21 +829,23 @@ def _microstep_core(state: SimState, params, app, t_h, window_end, rx_slot):
     # happens where the backlog is consulted (_cpu_clamp), so per-step
     # increments smaller than the precision are never lost.
     cpu_on = params.cpu_ns_per_event > 0
-    events = jnp.where(pool_slot >= 0, 1, 0).astype(I64) + \
+    events = jnp.where(delivered, 1, 0).astype(I64) + \
         jnp.sum(em.valid, axis=1).astype(I64)
     cost = params.cpu_ns_per_event * events
     avail = jnp.maximum(state.hosts.cpu_avail, tick_t)
     new_avail = jnp.where(cpu_on & active, avail + cost,
                           state.hosts.cpu_avail)
-    state = state.replace(hosts=state.hosts.replace(cpu_avail=new_avail))
+    state = state.replace(
+        hosts=state.hosts.replace(cpu_avail=new_avail),
+        n_steps=state.n_steps + 1,
+        n_events=state.n_events + jnp.sum(events),
+    )
     return state
 
 
 def microstep(state: SimState, params, app, t_h, window_end):
-    """One micro-step (compatibility wrapper computing its own rx scan;
-    the jitted loop threads the scan through the carry instead)."""
-    _, rx_slot = rx_scan(state)
-    return _microstep_core(state, params, app, t_h, window_end, rx_slot)
+    """One micro-step (public wrapper)."""
+    return _microstep_core(state, params, app, t_h, window_end)
 
 
 @functools.partial(jax.jit, static_argnames=("app",))
@@ -658,47 +853,49 @@ def run_until(state: SimState, params, app, t_target):
     """Run windows until simulated time reaches t_target (jitted whole)."""
     t_target = jnp.asarray(t_target, I64)
 
-    # (t_h, gmin, rx_slot) ride in the loop carry: the combined next-event
-    # scan + rx selection -- the one expensive dst-keyed reduction in the
-    # simulator -- runs exactly once per micro-step, at the end, where it
-    # sees everything that step staged (all of which arrives beyond the
-    # conservative window, so the carried selection stays valid).
-    def scan_all(s):
+    def scan(s):
         return _scan_all(s, params, app)
 
     def window_cond(carry):
-        st, _t_h, gmin, _rx = carry
-        return (st.now < t_target) & (gmin < t_target)
+        st, _t_h, gmin, gout = carry
+        g = jnp.minimum(gmin, gout)
+        return (st.now < t_target) & (g < t_target)
 
     def window_body(carry):
-        st, t_h, gmin, rx = carry
+        st, _, _, _ = carry
+        # Boundary exchange first: everything in flight becomes visible
+        # in the destination slabs before the window's scan.
+        st = _exchange(st, params)
+        t_h, gmin = scan(st)
         ws = jnp.maximum(st.now, gmin)
         we = jnp.minimum(ws + params.min_latency_ns, t_target)
 
         def icond(icarry):
-            _s, _th, g, _rx = icarry
+            _s, _th, g = icarry
             return g < we
 
         def ibody(icarry):
-            s, th, _, rxs = icarry
-            s = _microstep_core(s, params, app, th, we, rxs)
-            th2, g2, rxs2 = scan_all(s)
-            return s, th2, g2, rxs2
+            s, th, _ = icarry
+            s = _microstep_core(s, params, app, th, we)
+            th2, g2 = scan(s)
+            return s, th2, g2
 
-        st, t_h, gmin, rx = jax.lax.while_loop(icond, ibody,
-                                               (st, t_h, gmin, rx))
-        return st.replace(now=we), t_h, gmin, rx
+        st, t_h, gmin = jax.lax.while_loop(icond, ibody, (st, t_h, gmin))
+        st = st.replace(now=we, n_windows=st.n_windows + 1)
+        return st, t_h, gmin, _outbox_pending(st)
 
-    c0 = scan_all(state)
-    state, _, _, _ = jax.lax.while_loop(window_cond, window_body,
-                                        (state, *c0))
+    t_h0, gmin0 = scan(state)
+    state, _, _, _ = jax.lax.while_loop(
+        window_cond, window_body,
+        (state, t_h0, gmin0, _outbox_pending(state)))
     return state.replace(now=t_target)
 
 
-# One device launch covers this much simulated time: short enough that no
-# single launch trips device/tunnel watchdogs, long enough to amortize
-# dispatch (the compiled executable is reused -- t_target is traced).
-CHUNK_NS = 250 * simtime.SIMTIME_ONE_MILLISECOND
+# One device launch covers this much simulated time: long enough to
+# amortize the ~100ms per-call dispatch cost of the TPU tunnel (the
+# compiled executable is reused -- t_target is traced), short enough that
+# no single launch trips device/tunnel watchdogs.
+CHUNK_NS = 2 * simtime.SIMTIME_ONE_SECOND
 
 
 def run_chunked(state: SimState, params, app, t_target: int,

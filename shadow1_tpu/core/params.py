@@ -20,16 +20,27 @@ QDISC_FIFO = 0
 QDISC_RR = 1
 
 
+# Columns of the packed routing block (all i32; i64 split lo/hi, f32
+# bitcast).  One [V*V, 5] block means per-packet routing is ONE row
+# gather instead of three separate [V,V] gathers -- gathers are among the
+# few ops with real per-index cost inside a compiled loop
+# (tools/opbench*.py), and the hot path issues them at [H, E] volume.
+(RCOL_LAT_LO, RCOL_LAT_HI, RCOL_JIT_LO, RCOL_JIT_HI, RCOL_REL) = range(5)
+RCOLS = 5
+
+
 @struct.dataclass
 class NetParams:
     """Constant under jit for a whole run (still a pytree of arrays so it
     can be donated/sharded)."""
 
-    latency_ns: jnp.ndarray     # [V,V] i64 one-way latency along chosen path
-    reliability: jnp.ndarray    # [V,V] f32 end-to-end delivery probability
-    jitter_ns: jnp.ndarray      # [V,V] i64 jitter amplitude: per-packet
-                                # latency is perturbed uniformly in +/- this
-                                # (reference edge attr, topology.c:81-105)
+    route_blk: jnp.ndarray      # [V*V, RCOLS] i32 packed per-pair routing:
+                                # one-way latency ns (i64 as lo/hi),
+                                # jitter amplitude ns (i64 as lo/hi;
+                                # per-packet latency perturbed uniformly in
+                                # +/- this, reference edge attr
+                                # topology.c:81-105), delivery probability
+                                # (f32 bitcast)
     host_vertex: jnp.ndarray    # [H] i32 topology vertex each host attached to
     bw_up_Bps: jnp.ndarray      # [H] i64 upstream bytes/sec
     bw_down_Bps: jnp.ndarray    # [H] i64 downstream bytes/sec
@@ -51,16 +62,54 @@ class NetParams:
     # socket slot (creation order); QDISC_RR round-robins across them.
     qdisc: jnp.ndarray             # i32 scalar QDISC_*
 
+    @property
+    def n_vertices(self) -> int:
+        v = int(round(self.route_blk.shape[0] ** 0.5))
+        assert v * v == self.route_blk.shape[0]
+        return v
+
+    def route(self, vs, vd):
+        """Packed routing lookup: one row gather.  Returns
+        (latency_ns i64, jitter_ns i64, reliability f32) for any
+        broadcastable integer index shapes."""
+        from .state import dec_i64
+        rows = self.route_blk[vs * self.n_vertices + vd]
+        lat = dec_i64(rows[..., RCOL_LAT_LO], rows[..., RCOL_LAT_HI])
+        jit = dec_i64(rows[..., RCOL_JIT_LO], rows[..., RCOL_JIT_HI])
+        rel = jax.lax.bitcast_convert_type(rows[..., RCOL_REL], F32)
+        return lat, jit, rel
+
+    @property
+    def latency_ns(self):
+        """[V,V] i64 latency matrix (decoded view, for host-side use)."""
+        v = self.n_vertices
+        from .state import dec_i64
+        return dec_i64(self.route_blk[:, RCOL_LAT_LO],
+                       self.route_blk[:, RCOL_LAT_HI]).reshape(v, v)
+
+    @property
+    def jitter_ns(self):
+        v = self.n_vertices
+        from .state import dec_i64
+        return dec_i64(self.route_blk[:, RCOL_JIT_LO],
+                       self.route_blk[:, RCOL_JIT_HI]).reshape(v, v)
+
+    @property
+    def reliability(self):
+        v = self.n_vertices
+        return jax.lax.bitcast_convert_type(
+            self.route_blk[:, RCOL_REL], F32).reshape(v, v)
+
     def pair_latency(self, src_host, dst_host):
         """One-way latency between two hosts (ns)."""
         vs = self.host_vertex[src_host]
         vd = self.host_vertex[dst_host]
-        return self.latency_ns[vs, vd]
+        return self.route(vs, vd)[0]
 
     def pair_reliability(self, src_host, dst_host):
         vs = self.host_vertex[src_host]
         vd = self.host_vertex[dst_host]
-        return self.reliability[vs, vd]
+        return self.route(vs, vd)[2]
 
 
 def make_net_params(
@@ -112,10 +161,17 @@ def make_net_params(
     h = jnp.asarray(host_vertex).shape[0]
     if cpu_ns_per_event is None:
         cpu_ns_per_event = jnp.zeros((h,), I64)
+    from .state import enc_lo, enc_hi
+    rel_m = jnp.asarray(reliability, F32)
+    route_blk = jnp.stack([
+        enc_lo(latency_ns.reshape(-1)),
+        enc_hi(latency_ns.reshape(-1)),
+        enc_lo(jitter_ns.reshape(-1)),
+        enc_hi(jitter_ns.reshape(-1)),
+        jax.lax.bitcast_convert_type(rel_m.reshape(-1), I32),
+    ], axis=1)
     return NetParams(
-        latency_ns=latency_ns,
-        reliability=jnp.asarray(reliability, F32),
-        jitter_ns=jitter_ns,
+        route_blk=route_blk,
         host_vertex=jnp.asarray(host_vertex, I32),
         bw_up_Bps=jnp.asarray(bw_up_Bps, I64),
         bw_down_Bps=jnp.asarray(bw_down_Bps, I64),

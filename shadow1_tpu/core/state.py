@@ -148,6 +148,9 @@ class PacketPool:
     wnd: jnp.ndarray          # [P] i32 advertised window (bytes)
     length: jnp.ndarray       # [P] i32 payload bytes (headers excluded)
     time: jnp.ndarray         # [P] i64 stage-dependent: ready/deliver/arrive time
+    lat_ns: jnp.ndarray       # [P] i64 path latency (incl. the packet's
+                              # jitter draw), fixed at staging so a parked
+                              # packet's departure needs no routing lookup
     pkt_id: jnp.ndarray       # [P] i64 (src << 40) | per-src counter
     ts: jnp.ndarray           # [P] i64 TCP timestamp (send time)
     ts_echo: jnp.ndarray      # [P] i64 TCP timestamp echo
@@ -174,12 +177,92 @@ def make_packet_pool(capacity: int) -> PacketPool:
         wnd=_zeros((capacity,), I32),
         length=_zeros((capacity,), I32),
         time=_full((capacity,), I64, simtime.SIMTIME_INVALID),
+        lat_ns=_zeros((capacity,), I64),
         pkt_id=_zeros((capacity,), I64),
         ts=_zeros((capacity,), I64),
         ts_echo=_zeros((capacity,), I64),
         payload_id=_full((capacity,), I32, -1),
         priority=_zeros((capacity,), F32),
         status=_zeros((capacity,), I32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Inbox: per-DESTINATION slabs of arrived/arriving packets
+# ---------------------------------------------------------------------------
+
+# Column indices of the packed inbox block.  Everything is i32: packed
+# row scatters of i32 are ~10x cheaper than i64 on this backend
+# (tools/opbench.py), so i64 fields are split into (lo31, hi) pairs and
+# u32 fields are bitcast.  All values are non-negative, so the 31-bit
+# split round-trips exactly.
+(ICOL_SRC, ICOL_SPORT, ICOL_DPORT, ICOL_PROTO, ICOL_FLAGS, ICOL_SEQ,
+ ICOL_ACK, ICOL_WND, ICOL_LEN, ICOL_PAYLOAD,
+ ICOL_TIME_LO, ICOL_TIME_HI, ICOL_CTR_LO, ICOL_CTR_HI,
+ ICOL_TS_LO, ICOL_TS_HI, ICOL_TSE_LO, ICOL_TSE_HI) = range(18)
+ICOLS = 18
+
+_LO_MASK = (1 << 31) - 1
+
+
+def enc_lo(x):
+    """Low 31 bits of a non-negative i64 as i32."""
+    return (x & _LO_MASK).astype(I32)
+
+
+def enc_hi(x):
+    """High bits (>> 31) of a non-negative i64 as i32."""
+    return (x >> 31).astype(I32)
+
+
+def dec_i64(lo, hi):
+    return (hi.astype(I64) << 31) | lo.astype(I64)
+
+
+@struct.dataclass
+class Inbox:
+    """Packets at (or heading to) their destination, in per-destination
+    slabs: slot `d * slab + k` belongs to destination host `d`.
+
+    This is the receive half of the packet world (the reference's
+    in-flight event queue + per-host upstream-router queue,
+    src/main/core/worker.c:243-304 + router_queue_codel.c) laid out so
+    every per-micro-step question -- "when is each host's next arrival",
+    "which packet does the NIC drain next", "how deep is the router
+    backlog" -- is a row-local reshape op over [H, slab] instead of a
+    dst-keyed segment reduction over the whole pool (12.7ms vs ~0ms per
+    micro-step at 16k hosts; tools/opbench*.py).  Packets enter in bulk
+    at window boundaries (engine._exchange) or directly for same-host
+    loopback; `stage`/`status` are the only fields mutated in the hot
+    loop, elementwise.
+    """
+
+    blk: jnp.ndarray      # [P1, ICOLS] i32 packed fields (immutable per stay)
+    stage: jnp.ndarray    # [P1] i32 STAGE_FREE / IN_FLIGHT / RX_QUEUED
+    status: jnp.ndarray   # [P1] i32 PDS_* trail
+
+    @property
+    def capacity(self) -> int:
+        return self.stage.shape[0]
+
+    def times(self):
+        """[P1] i64 arrival times (decode of the packed columns)."""
+        return dec_i64(self.blk[:, ICOL_TIME_LO], self.blk[:, ICOL_TIME_HI])
+
+    def order_keys(self):
+        """[P1] i64 deterministic total-order tiebreak (src << 40) | ctr,
+        identical to the outbox pkt_id (reference event.c:110-153)."""
+        src = self.blk[:, ICOL_SRC].astype(I64)
+        ctr = dec_i64(self.blk[:, ICOL_CTR_LO], self.blk[:, ICOL_CTR_HI])
+        return (src << 40) | ctr
+
+
+def make_inbox(num_hosts: int, slab: int) -> Inbox:
+    p1 = num_hosts * slab
+    return Inbox(
+        blk=_zeros((p1, ICOLS), I32),
+        stage=_zeros((p1,), I32),
+        status=_zeros((p1,), I32),
     )
 
 
@@ -468,29 +551,50 @@ def make_capture_ring(capacity: int = 1 << 16) -> CaptureRing:
 
 @struct.dataclass
 class SimState:
-    """Everything that evolves during a run; one pytree, checkpointable."""
+    """Everything that evolves during a run; one pytree, checkpointable.
+
+    `pool` is the OUTBOX: per-source slabs holding packets from emission
+    until they leave their source (parked TX_QUEUED under the token
+    bucket, or IN_FLIGHT awaiting the next window-boundary exchange into
+    the destination's inbox).  `inbox` is the per-destination receive
+    half (see Inbox)."""
 
     now: jnp.ndarray          # i64 scalar: current window start
-    pool: PacketPool
+    pool: PacketPool          # outbox, per-SOURCE slabs
+    inbox: Inbox              # per-DESTINATION slabs
     socks: SocketTable
     hosts: HostTable
     app: any = struct.field(pytree_node=True, default=None)  # application-model state
     err: jnp.ndarray = struct.field(default=None)  # i32 scalar ERR_* bitmask
     cap: any = struct.field(pytree_node=True, default=None)  # CaptureRing | None
+    # Telemetry (reference scheduler built-in timers, scheduler.c:266-268):
+    n_steps: jnp.ndarray = struct.field(default=None)    # i64 micro-steps
+    n_windows: jnp.ndarray = struct.field(default=None)  # i64 windows run
+    n_events: jnp.ndarray = struct.field(default=None)   # i64 deliveries+emissions
 
 
 def make_sim_state(num_hosts: int, sock_slots: int = 16,
-                   pool_capacity: int = 1 << 15, app=None) -> SimState:
-    # The pool is partitioned into per-host slabs (engine._stage_emissions
-    # allocates from the emitting host's slab): round capacity up to a
-    # multiple of num_hosts, with at least 8 slots per host.
+                   pool_capacity: int = 1 << 15, app=None,
+                   inbox_capacity: int | None = None) -> SimState:
+    # Both pools are partitioned into per-host slabs: the outbox by SOURCE
+    # (engine._stage_emissions allocates from the emitting host's slab),
+    # the inbox by DESTINATION (engine._exchange fills it at window
+    # boundaries).  Capacities round up to a multiple of num_hosts with at
+    # least 8 slots per host.  The inbox defaults to the outbox size; size
+    # it by expected fan-IN (a popular server needs a deeper inbox slab).
     slab = max(8, -(-pool_capacity // num_hosts))
-    pool_capacity = num_hosts * slab
+    if inbox_capacity is None:
+        inbox_capacity = pool_capacity
+    islab = max(8, -(-inbox_capacity // num_hosts))
     return SimState(
         now=jnp.asarray(0, I64),
-        pool=make_packet_pool(pool_capacity),
+        pool=make_packet_pool(num_hosts * slab),
+        inbox=make_inbox(num_hosts, islab),
         socks=make_socket_table(num_hosts, sock_slots),
         hosts=make_host_table(num_hosts),
         app=app,
         err=jnp.asarray(0, I32),
+        n_steps=jnp.asarray(0, I64),
+        n_windows=jnp.asarray(0, I64),
+        n_events=jnp.asarray(0, I64),
     )

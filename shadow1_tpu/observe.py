@@ -30,6 +30,28 @@ _FIELDS = ("bytes_sent", "bytes_recv", "pkts_sent", "pkts_recv",
            "pkts_dropped_inet", "pkts_dropped_router")
 
 
+_pack_heartbeat_jit = None
+
+
+def _pack_heartbeat(hosts):
+    # Jitted once at first use (a fresh jax.jit wrapper per call would
+    # retrace and recompile every heartbeat).
+    global _pack_heartbeat_jit
+    if _pack_heartbeat_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def pack(hosts):
+            rows = [getattr(hosts, f).astype(jnp.int64) for f in _FIELDS]
+            rows.append(hosts.tx_queued.astype(jnp.int64))
+            rows.append(hosts.rx_queued.astype(jnp.int64))
+            return jnp.stack(rows)
+
+        _pack_heartbeat_jit = pack
+    return _pack_heartbeat_jit(hosts)
+
+
 class Tracker:
     """Appends per-host heartbeat rows; one instance per run."""
 
@@ -50,10 +72,14 @@ class Tracker:
         self._last_t = 0
 
     def heartbeat(self, state, now_ns: int):
-        cur = {f: np.asarray(getattr(state.hosts, f)) for f in _FIELDS}
+        # ONE device buffer, ONE transfer: per-buffer fetches each cost a
+        # full round trip on a tunneled backend (~0.1-1s), and heartbeats
+        # fire once per simulated second.
+        packed = np.asarray(_pack_heartbeat(state.hosts))
+        n = len(_FIELDS)
+        cur = {f: packed[i] for i, f in enumerate(_FIELDS)}
+        txq, rxq = packed[n], packed[n + 1]
         dt_s = max((now_ns - self._last_t) / SEC, 1e-9)
-        txq = np.asarray(state.hosts.tx_queued)
-        rxq = np.asarray(state.hosts.rx_queued)
         with open(self.path, "a") as f:
             for i, name in enumerate(self.hostnames):
                 d = {k: int(cur[k][i] - self._last[k][i]) for k in _FIELDS}
@@ -138,14 +164,20 @@ def write_pcap(path: str, cap, ip_of_host=None):
 
 
 def census(state) -> dict:
-    """Live-object census from the dense tables (ObjectCounter analog)."""
+    """Live-object census from the dense tables (ObjectCounter analog).
+
+    Packets live in the source-side outbox (state.pool) until the window
+    exchange, then in the destination-side inbox; both are counted."""
     stage = np.asarray(state.pool.stage)
+    istage = np.asarray(state.inbox.stage)
     stype = np.asarray(state.socks.stype)
     return {
-        "packets_free": int((stage == STAGE_FREE).sum()),
+        "packets_free": int((stage == STAGE_FREE).sum())
+        + int((istage == STAGE_FREE).sum()),
         "packets_tx_queued": int((stage == STAGE_TX_QUEUED).sum()),
-        "packets_in_flight": int((stage == STAGE_IN_FLIGHT).sum()),
-        "packets_rx_queued": int((stage == STAGE_RX_QUEUED).sum()),
+        "packets_in_flight": int((stage == STAGE_IN_FLIGHT).sum())
+        + int((istage == STAGE_IN_FLIGHT).sum()),
+        "packets_rx_queued": int((istage == STAGE_RX_QUEUED).sum()),
         "sockets_free": int((stype == SOCK_FREE).sum()),
         "sockets_udp": int((stype == SOCK_UDP).sum()),
         "sockets_tcp": int((stype == SOCK_TCP).sum()),

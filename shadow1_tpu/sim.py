@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+import shadow1_tpu as _pkg
+
 from .apps import bulk as bulk_app
 from .apps import phold as phold_app
 from .core import engine, simtime
@@ -37,28 +39,41 @@ def build_phold(num_hosts: int,
     (all pair latencies are identical anyway), so the [V,V] routing
     matrices stay small however many hosts the benchmark scales to."""
     v = min(num_hosts, 256)
-    lat, rel = uniform_full_mesh(v, latency_ns, reliability)
-    params = make_net_params(
-        latency_ns=lat,
-        reliability=rel,
-        host_vertex=jnp.arange(num_hosts) % v,
-        bw_up_Bps=jnp.full(num_hosts, bw_up_Bps),
-        bw_down_Bps=jnp.full(num_hosts, bw_down_Bps),
-        seed=seed,
-        stop_time=stop_time,
-        bootstrap_end=bootstrap_end,
-    )
-    state = make_sim_state(num_hosts, sock_slots=sock_slots,
-                           pool_capacity=pool_capacity)
-    state = state.replace(
-        socks=udp.open_bind_all(state.socks, slot=0, port=phold_app.PHOLD_PORT),
-        # rng_ctr starts at 1: counter value 0 is reserved for the initial
-        # send-time draws in phold_app.init_state.
-        hosts=state.hosts.replace(rng_ctr=state.hosts.rng_ctr + 1),
-    )
-    app = phold_app.Phold(mean_delay_ns=mean_delay_ns, sock_slot=0)
+
+    def _build_params():
+        lat, rel = uniform_full_mesh(v, latency_ns, reliability)
+        return make_net_params(
+            latency_ns=lat,
+            reliability=rel,
+            host_vertex=jnp.arange(num_hosts) % v,
+            bw_up_Bps=jnp.full(num_hosts, bw_up_Bps),
+            bw_down_Bps=jnp.full(num_hosts, bw_down_Bps),
+            seed=seed,
+            stop_time=stop_time,
+            bootstrap_end=bootstrap_end,
+        )
+
+    params = _pkg.build_on_host(_build_params)
+    def _build_state():
+        state = make_sim_state(num_hosts, sock_slots=sock_slots,
+                               pool_capacity=pool_capacity)
+        return state.replace(
+            socks=udp.open_bind_all(state.socks, slot=0,
+                                    port=phold_app.PHOLD_PORT),
+            # rng_ctr starts at 1: counter value 0 is reserved for the
+            # initial send-time draws in phold_app.init_state.
+            hosts=state.hosts.replace(rng_ctr=state.hosts.rng_ctr + 1),
+        )
+
+    if num_hosts < 2:
+        raise ValueError("phold needs at least 2 hosts (every message is "
+                         "forwarded to a different host)")
+    state = _pkg.build_on_host(_build_state)
+    # App init keys off params.seed_key (already on the default backend),
+    # so it runs there -- it is only a handful of ops.
     state = state.replace(app=phold_app.init_state(
         num_hosts, params, msgs_per_host, mean_delay_ns))
+    app = phold_app.Phold(mean_delay_ns=mean_delay_ns, sock_slot=0)
     return state, params, app
 
 
@@ -78,30 +93,35 @@ def build_bulk(num_hosts: int,
     """Bulk TCP transfers: every host but `server` sends
     `bytes_per_client` to the server (the reference's tgen file-transfer
     bring-up config, resource/examples/shadow.config.xml)."""
-    lat, rel = uniform_full_mesh(num_hosts, latency_ns, reliability)
-    params = make_net_params(
-        latency_ns=lat,
-        reliability=rel,
-        host_vertex=jnp.arange(num_hosts),
-        bw_up_Bps=jnp.full(num_hosts, bw_up_Bps),
-        bw_down_Bps=jnp.full(num_hosts, bw_down_Bps),
-        seed=seed,
-        stop_time=stop_time,
-        bootstrap_end=bootstrap_end,
-    )
-    state = make_sim_state(num_hosts, sock_slots=sock_slots,
-                           pool_capacity=pool_capacity)
-    ids = jnp.arange(num_hosts)
-    is_server = ids == server
-    state = state.replace(socks=bulk_app.setup_servers(state.socks, is_server))
+    def _build_all():
+        lat, rel = uniform_full_mesh(num_hosts, latency_ns, reliability)
+        params = make_net_params(
+            latency_ns=lat,
+            reliability=rel,
+            host_vertex=jnp.arange(num_hosts),
+            bw_up_Bps=jnp.full(num_hosts, bw_up_Bps),
+            bw_down_Bps=jnp.full(num_hosts, bw_down_Bps),
+            seed=seed,
+            stop_time=stop_time,
+            bootstrap_end=bootstrap_end,
+        )
+        state = make_sim_state(num_hosts, sock_slots=sock_slots,
+                               pool_capacity=pool_capacity)
+        ids = jnp.arange(num_hosts)
+        is_server = ids == server
+        state = state.replace(socks=bulk_app.setup_servers(state.socks,
+                                                           is_server))
+        state = state.replace(app=bulk_app.init_state(
+            num_hosts,
+            is_client=~is_server,
+            dst=jnp.full(num_hosts, server),
+            total_bytes=jnp.where(is_server, 0, bytes_per_client),
+            start_t=jnp.full(num_hosts, start_time),
+        ))
+        return state, params
+
+    state, params = _pkg.build_on_host(_build_all)
     app = bulk_app.Bulk()
-    state = state.replace(app=bulk_app.init_state(
-        num_hosts,
-        is_client=~is_server,
-        dst=jnp.full(num_hosts, server),
-        total_bytes=jnp.where(is_server, 0, bytes_per_client),
-        start_t=jnp.full(num_hosts, start_time),
-    ))
     return state, params, app
 
 

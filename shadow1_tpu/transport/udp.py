@@ -66,31 +66,54 @@ def lookup_socket(socks: st.SocketTable, mask, src, sport, dport):
     return jnp.where(ok, slot, -1)
 
 
+def _onehot_s(socks, slot):
+    """[H,S] one-hot for a per-host slot (indexed table access costs real
+    milliseconds inside a compiled loop; one-hot selects fuse for free --
+    tools/opbench2.py)."""
+    safe = jnp.clip(slot, 0, socks.slots - 1)
+    return safe, safe[:, None] == jnp.arange(socks.slots, dtype=I32)[None, :]
+
+
+def _gather_s(tab, oh):
+    return jnp.sum(jnp.where(oh, tab, 0), axis=1, dtype=tab.dtype)
+
+
+def _gather_sr(tab, oh_sr):
+    """Gather [H] from [H,S,R] under an [H,S,R] one-hot."""
+    return jnp.sum(jnp.where(oh_sr, tab, 0), axis=(1, 2), dtype=tab.dtype)
+
+
 def push_ring(socks: st.SocketTable, host_mask, slot, src, sport, length,
               payload_id):
     """Append a datagram to each masked host's socket ring. Returns
     (socks, dropped_mask)."""
-    h = socks.num_hosts
-    rows = jnp.arange(h)
-    safe_slot = jnp.clip(slot, 0, socks.slots - 1)
-    count = socks.udp_count[rows, safe_slot]
+    _, oh = _onehot_s(socks, slot)
+    count = _gather_s(socks.udp_count, oh)
     full = count >= UDP_RING
     do = host_mask & (slot >= 0) & ~full
-    pos = (socks.udp_head[rows, safe_slot] + count) % UDP_RING
+    head = _gather_s(socks.udp_head, oh)
+    pos = (head + count) % UDP_RING
+    oh_sr = oh[:, :, None] & \
+        (pos[:, None, None] == jnp.arange(UDP_RING, dtype=I32)[None, None, :])
+    w = oh_sr & do[:, None, None]
 
     def scatter(arr, val, dtype):
-        return arr.at[rows, safe_slot, pos].set(
-            jnp.where(do, jnp.asarray(val).astype(dtype), arr[rows, safe_slot, pos]))
+        v = jnp.broadcast_to(jnp.asarray(val).astype(dtype),
+                             (socks.num_hosts,))
+        return jnp.where(w, v[:, None, None], arr)
 
     return socks.replace(
         udp_src=scatter(socks.udp_src, src, I32),
         udp_sport=scatter(socks.udp_sport, sport, I32),
         udp_len=scatter(socks.udp_len, length, I32),
         udp_payload=scatter(socks.udp_payload, payload_id, I32),
-        udp_count=socks.udp_count.at[rows, safe_slot].add(
-            jnp.where(do, 1, 0).astype(I32)),
-        bytes_recv=socks.bytes_recv.at[rows, safe_slot].add(
-            jnp.where(do, length, 0).astype(I64)),
+        udp_count=jnp.where(oh & do[:, None], socks.udp_count + 1,
+                            socks.udp_count),
+        bytes_recv=jnp.where(
+            oh & do[:, None],
+            socks.bytes_recv + jnp.broadcast_to(
+                jnp.asarray(length, I64), (socks.num_hosts,))[:, None],
+            socks.bytes_recv),
     ), (host_mask & (slot >= 0) & full)
 
 
@@ -98,21 +121,21 @@ def pop_ring(socks: st.SocketTable, host_mask, slot):
     """Pop the oldest datagram from each masked host's socket ring.
 
     Returns (socks, got_mask, src, sport, length, payload_id)."""
-    h = socks.num_hosts
-    rows = jnp.arange(h)
-    safe_slot = jnp.clip(slot, 0, socks.slots - 1)
-    count = socks.udp_count[rows, safe_slot]
+    _, oh = _onehot_s(socks, slot)
+    count = _gather_s(socks.udp_count, oh)
     got = host_mask & (slot >= 0) & (count > 0)
-    head = socks.udp_head[rows, safe_slot]
-    src = socks.udp_src[rows, safe_slot, head]
-    sport = socks.udp_sport[rows, safe_slot, head]
-    length = socks.udp_len[rows, safe_slot, head]
-    payload = socks.udp_payload[rows, safe_slot, head]
+    head = _gather_s(socks.udp_head, oh)
+    oh_sr = oh[:, :, None] & \
+        (head[:, None, None] == jnp.arange(UDP_RING, dtype=I32)[None, None, :])
+    src = _gather_sr(socks.udp_src, oh_sr)
+    sport = _gather_sr(socks.udp_sport, oh_sr)
+    length = _gather_sr(socks.udp_len, oh_sr)
+    payload = _gather_sr(socks.udp_payload, oh_sr)
+    adv = oh & got[:, None]
     socks = socks.replace(
-        udp_head=socks.udp_head.at[rows, safe_slot].set(
-            jnp.where(got, (head + 1) % UDP_RING, head)),
-        udp_count=socks.udp_count.at[rows, safe_slot].add(
-            jnp.where(got, -1, 0).astype(I32)),
+        udp_head=jnp.where(adv, (socks.udp_head + 1) % UDP_RING,
+                           socks.udp_head),
+        udp_count=jnp.where(adv, socks.udp_count - 1, socks.udp_count),
     )
     return socks, got, src, sport, length, payload
 

@@ -31,7 +31,8 @@ def test_phold_runs_and_conserves_messages():
     assert sent > 0 and recv > 0
     # Messages are conserved: every message is pending, in flight, or was
     # dropped by the (perfect-reliability) network -- here never dropped.
-    inflight = int((out.pool.stage != 0).sum())
+    inflight = int((out.pool.stage != 0).sum()) + \
+        int((out.inbox.stage != 0).sum())
     assert dropped == 0
     assert pending + inflight + int(out.socks.udp_count.sum()) == 8
     assert sent == recv + inflight + int(out.socks.udp_count.sum())
@@ -72,5 +73,6 @@ def test_phold_lossy_network_drops():
     assert dropped > 0
     # Conservation including drops: every sent message was received, is in
     # flight, queued, or dropped. (Dropped messages leave the population.)
-    inflight = int((out.pool.stage != 0).sum())
+    inflight = int((out.pool.stage != 0).sum()) + \
+        int((out.inbox.stage != 0).sum())
     assert sent == recv + inflight + int(out.socks.udp_count.sum()) + dropped

@@ -1,0 +1,121 @@
+"""Phase-level profile of the engine micro-step on the real chip.
+
+Times while-loops of increasing phase subsets at the benchmark state
+(slope method, 50 vs 200 iterations) to attribute per-micro-step cost.
+
+    python tools/stepprof.py
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import shadow1_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from shadow1_tpu import sim
+from shadow1_tpu.core import emit, engine, simtime
+
+I32, I64 = jnp.int32, jnp.int64
+
+NUM_HOSTS = 16384
+
+
+def timeloop(name, state0, params, app, body):
+    res = {}
+    for iters in (50, 200):
+        def run(st, th):
+            def cond(c):
+                return c[0] < iters
+
+            def b(c):
+                i, s, t = c
+                s, t = body(s, t)
+                return i + 1, s, t
+
+            return jax.lax.while_loop(cond, b, (jnp.asarray(0, I32),
+                                                st, th))
+
+        jf = jax.jit(run)
+        th0, _ = engine._scan_all(state0, params, app)
+        out = jf(state0, th0)
+        np.asarray(out[1].now)
+        ts = []
+        for trial in range(2):
+            st2 = state0.replace(now=state0.now + trial)
+            t0 = time.perf_counter()
+            out = jf(st2, th0)
+            np.asarray(out[1].now)
+            ts.append(time.perf_counter() - t0)
+        res[iters] = min(ts)
+    slope = (res[200] - res[50]) / 150 * 1e3
+    print(f"{name:48s} {slope:8.3f} ms/iter")
+
+
+def main():
+    state, params, app = sim.build_phold(
+        num_hosts=NUM_HOSTS, msgs_per_host=4,
+        mean_delay_ns=10 * simtime.SIMTIME_ONE_MILLISECOND,
+        stop_time=10 * simtime.SIMTIME_ONE_SECOND,
+        pool_capacity=NUM_HOSTS * 8)
+    # Advance into steady state so the loops run over a busy world.
+    state = engine.run_until(state, params, app,
+                             50 * simtime.SIMTIME_ONE_MILLISECOND)
+    jax.block_until_ready(state)
+    we = jnp.asarray(10 * simtime.SIMTIME_ONE_SECOND, I64)
+    h = state.hosts.num_hosts
+
+    def scan(s):
+        return engine._scan_all(s, params, app)
+
+    def v_scan(s, th):
+        # scan only (fed back through t_resume to keep a data dependence)
+        s = s.replace(hosts=s.hosts.replace(
+            t_resume=jnp.minimum(s.hosts.t_resume, th)))
+        th2, _ = scan(s)
+        return s, th2
+
+    def v_rx(s, th):
+        active = th < we
+        tick = jnp.where(active, th, we)
+        em = emit.empty(h)
+        s, em, _d = engine._rx_phase(s, params, em, tick, active, app)
+        th2, _ = scan(s)
+        return s, th2
+
+    def v_rx_app(s, th):
+        active = th < we
+        tick = jnp.where(active, th, we)
+        em = emit.empty(h)
+        s, em, _d = engine._rx_phase(s, params, em, tick, active, app)
+        s, em = app.on_tick(s, params, em, tick, active)
+        th2, _ = scan(s)
+        return s, th2
+
+    def v_rx_app_stage(s, th):
+        active = th < we
+        tick = jnp.where(active, th, we)
+        em = emit.empty(h)
+        s, em, _d = engine._rx_phase(s, params, em, tick, active, app)
+        s, em = app.on_tick(s, params, em, tick, active)
+        s, _p = engine._stage_emissions(s, params, em, tick, active, app)
+        th2, _ = scan(s)
+        return s, th2
+
+    def v_full(s, th):
+        s = engine._microstep_core(s, params, app, th, we)
+        th2, _ = scan(s)
+        return s, th2
+
+    timeloop("scan only", state, params, app, v_scan)
+    timeloop("rx_phase + scan", state, params, app, v_rx)
+    timeloop("rx + app + scan", state, params, app, v_rx_app)
+    timeloop("rx + app + stage + scan", state, params, app, v_rx_app_stage)
+    timeloop("full microstep + scan", state, params, app, v_full)
+
+
+if __name__ == "__main__":
+    main()
