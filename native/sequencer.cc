@@ -1,0 +1,262 @@
+// sequencer: host-side real-process supervisor for the simulation.
+//
+// The TPU-era replacement for the reference's in-process plugin machinery
+// (dlmopen namespaces + cooperative rpth threads + process_continue,
+// /root/reference/src/main/host/process.c:379-564,1197-1275): each plugin
+// runs as a REAL operating-system process with the shadow1_shim preloaded;
+// this library owns spawning (fork/exec with the shim + virtual-clock
+// environment), the per-process SOCK_SEQPACKET request pipe, and the
+// shared virtual-time page.  "Run a process until it blocks" is:
+// reply to its parked syscall, then block reading its next request --
+// a process only runs while the sequencer waits on it, which serializes
+// plugin execution exactly like the reference's pth main-thread handoff
+// and keeps the simulation deterministic.
+//
+// Scheduling policy (who to run, in what order, what each syscall means
+// against the simulated socket tables) lives in the Python bridge
+// (shadow1_tpu/substrate/); this layer is mechanism only.
+//
+// C API (ctypes-consumed); all functions return >= 0 on success.
+
+#include <cerrno>
+#include <cstddef>
+#include <csignal>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <poll.h>
+#include <string>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMaxData = 65536;
+
+struct Req {
+  uint32_t op;
+  int32_t fd;
+  int64_t a0;
+  int64_t a1;
+  uint32_t len;
+  unsigned char data[kMaxData];
+};
+
+struct Rep {
+  int64_t ret;
+  int32_t err;
+  int64_t vtime_ns;
+  uint32_t len;
+  unsigned char data[kMaxData];
+};
+
+constexpr size_t kReqHdr = offsetof(Req, data);
+constexpr size_t kRepHdr = offsetof(Rep, data);
+
+struct Proc {
+  pid_t pid = -1;
+  int sock = -1;       // our end of the seqpacket pair
+  bool exited = false;
+  int exit_code = -1;
+};
+
+struct Sequencer {
+  std::vector<Proc> procs;
+  int time_fd = -1;
+  volatile int64_t* time_page = nullptr;
+  std::string time_path;
+};
+
+std::vector<Sequencer*> g_seqs;
+
+Sequencer* get(int h) {
+  if (h < 0 || h >= (int)g_seqs.size()) return nullptr;
+  return g_seqs[h];
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a sequencer; `time_page_path` is created/truncated and mmapped
+// as the shared virtual-clock page the shim reads.
+int seq_create(const char* time_page_path) {
+  auto* s = new Sequencer();
+  s->time_path = time_page_path;
+  s->time_fd = open(time_page_path, O_RDWR | O_CREAT | O_TRUNC | O_CLOEXEC,
+                    0600);
+  if (s->time_fd < 0) {
+    delete s;
+    return -1;
+  }
+  if (ftruncate(s->time_fd, 4096) != 0) {
+    close(s->time_fd);
+    delete s;
+    return -1;
+  }
+  void* m = mmap(nullptr, 4096, PROT_READ | PROT_WRITE, MAP_SHARED,
+                 s->time_fd, 0);
+  if (m == MAP_FAILED) {
+    close(s->time_fd);
+    delete s;
+    return -1;
+  }
+  s->time_page = (volatile int64_t*)m;
+  *s->time_page = 0;
+  g_seqs.push_back(s);
+  return (int)g_seqs.size() - 1;
+}
+
+int seq_settime(int h, int64_t ns) {
+  Sequencer* s = get(h);
+  if (!s) return -1;
+  *s->time_page = ns;
+  return 0;
+}
+
+// Spawn argv[0..argc) as a supervised process with the shim preloaded.
+// stdout/stderr go to `out_path` (append).  Returns proc id.
+int seq_spawn(int h, int argc, const char* const* argv,
+              const char* shim_path, const char* out_path) {
+  Sequencer* s = get(h);
+  if (!s || argc < 1) return -1;
+  int sv[2];
+  if (socketpair(AF_UNIX, SOCK_SEQPACKET, 0, sv) != 0) return -1;
+  // The sequencer's end must not leak into plugin processes (a plugin
+  // closing or writing a sibling's channel would break the determinism
+  // contract); the child's end stays inheritable for the exec'd binary.
+  fcntl(sv[0], F_SETFD, FD_CLOEXEC);
+
+  pid_t pid = fork();
+  if (pid < 0) {
+    close(sv[0]);
+    close(sv[1]);
+    return -1;
+  }
+  if (pid == 0) {
+    close(sv[0]);
+    // The shim finds its pipe via env; keep the fd number stable.
+    char fdbuf[16];
+    snprintf(fdbuf, sizeof fdbuf, "%d", sv[1]);
+    setenv("SHADOW1_SHIM_FD", fdbuf, 1);
+    setenv("SHADOW1_TIME_PAGE", s->time_path.c_str(), 1);
+    setenv("LD_PRELOAD", shim_path, 1);
+    if (out_path && out_path[0]) {
+      int ofd = open(out_path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+      if (ofd >= 0) {
+        dup2(ofd, 1);
+        dup2(ofd, 2);
+        if (ofd > 2) close(ofd);
+      }
+    }
+    std::vector<char*> av;
+    for (int i = 0; i < argc; i++) av.push_back(const_cast<char*>(argv[i]));
+    av.push_back(nullptr);
+    execvp(av[0], av.data());
+    _exit(127);
+  }
+  close(sv[1]);
+  Proc p;
+  p.pid = pid;
+  p.sock = sv[0];
+  s->procs.push_back(p);
+  return (int)s->procs.size() - 1;
+}
+
+// Block (up to timeout_ms) for the process's next syscall request.
+// Returns 1 = request filled into out buffers, 0 = process exited
+// (exit code in *a0_out), -2 = timeout (still running), -1 = error.
+int seq_wait_request(int h, int proc, int timeout_ms, uint32_t* op_out,
+                     int32_t* fd_out, int64_t* a0_out, int64_t* a1_out,
+                     uint8_t* data_out, uint32_t* len_out) {
+  Sequencer* s = get(h);
+  if (!s || proc < 0 || proc >= (int)s->procs.size()) return -1;
+  Proc& p = s->procs[proc];
+  if (p.exited) {
+    *a0_out = p.exit_code;
+    return 0;
+  }
+  struct pollfd pfd = {p.sock, POLLIN, 0};
+  int pr = poll(&pfd, 1, timeout_ms);
+  if (pr == 0) return -2;
+  if (pr < 0) return -1;
+
+  static thread_local Req rq;
+  ssize_t n = recv(p.sock, &rq, sizeof rq, 0);
+  if (n <= 0) {
+    // EOF: the process exited (or crashed); reap it.
+    int st = 0;
+    waitpid(p.pid, &st, 0);
+    p.exited = true;
+    p.exit_code = WIFEXITED(st) ? WEXITSTATUS(st) : -WTERMSIG(st);
+    close(p.sock);
+    p.sock = -1;
+    *a0_out = p.exit_code;
+    return 0;
+  }
+  if ((size_t)n < kReqHdr) return -1;
+  *op_out = rq.op;
+  *fd_out = rq.fd;
+  *a0_out = rq.a0;
+  *a1_out = rq.a1;
+  uint32_t len = rq.len;
+  if (len > kMaxData) len = kMaxData;
+  *len_out = len;
+  if (len) memcpy(data_out, rq.data, len);
+  return 1;
+}
+
+// Answer the process's parked syscall (it resumes immediately after).
+int seq_reply(int h, int proc, int64_t ret, int32_t err, int64_t vtime_ns,
+              const uint8_t* data, uint32_t len) {
+  Sequencer* s = get(h);
+  if (!s || proc < 0 || proc >= (int)s->procs.size()) return -1;
+  Proc& p = s->procs[proc];
+  if (p.exited || p.sock < 0) return -1;
+  static thread_local Rep rp;
+  rp.ret = ret;
+  rp.err = err;
+  rp.vtime_ns = vtime_ns;
+  if (len > kMaxData) len = kMaxData;
+  rp.len = len;
+  if (len) memcpy(rp.data, data, len);
+  ssize_t n = send(p.sock, &rp, kRepHdr + len, 0);
+  return n < 0 ? -1 : 0;
+}
+
+// 0 = running, 1 = exited (code in *code_out).
+int seq_status(int h, int proc, int* code_out) {
+  Sequencer* s = get(h);
+  if (!s || proc < 0 || proc >= (int)s->procs.size()) return -1;
+  Proc& p = s->procs[proc];
+  if (!p.exited) {
+    int st = 0;
+    pid_t r = waitpid(p.pid, &st, WNOHANG);
+    if (r == p.pid) {
+      p.exited = true;
+      p.exit_code = WIFEXITED(st) ? WEXITSTATUS(st) : -WTERMSIG(st);
+    }
+  }
+  if (p.exited) {
+    *code_out = p.exit_code;
+    return 1;
+  }
+  return 0;
+}
+
+int seq_kill(int h, int proc) {
+  Sequencer* s = get(h);
+  if (!s || proc < 0 || proc >= (int)s->procs.size()) return -1;
+  Proc& p = s->procs[proc];
+  if (!p.exited && p.pid > 0) kill(p.pid, SIGKILL);
+  return 0;
+}
+
+}  // extern "C"

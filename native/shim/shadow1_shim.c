@@ -1,0 +1,391 @@
+/* shadow1_shim: LD_PRELOAD syscall interposer for real plugin processes.
+ *
+ * The TPU-era equivalent of the reference's libshadow-interpose.so
+ * (/root/reference/src/preload/interposer.c): a plugin binary runs as a
+ * REAL process with this library preloaded; calls touching the simulated
+ * world (AF_INET sockets, sleeps, wall-clock reads) are marshaled over a
+ * SOCK_SEQPACKET pipe to the host-side sequencer, which answers them in
+ * deterministic virtual-time order.  Everything else falls through to
+ * libc.
+ *
+ * Differences from the reference by design (docs/design-process-substrate.md):
+ * no dlmopen namespaces (process isolation replaces the custom ELF loader,
+ * src/external/elf-loader/) and no cooperative pth threads (the sequencer
+ * runs whole processes until they block, the analog of
+ * process.c:1197-1275 run-until-blocked).
+ *
+ * Virtual fds: simulated sockets get descriptor numbers >= VFD_BASE so the
+ * shim can route by fd value without tracking real fds.
+ *
+ * Virtual clock: the sequencer publishes nanoseconds-since-epoch in a
+ * shared mmap page (env SHADOW1_TIME_PAGE); clock_gettime and friends are
+ * answered in-process from that page, no round trip (emulated epoch starts
+ * Jan 1 2000 like the reference, definitions.h:78).
+ */
+
+#define _GNU_SOURCE
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <stdarg.h>
+#include <stddef.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <time.h>
+#include <unistd.h>
+
+#define VFD_BASE (1 << 20)
+#define MAX_VFD 4096
+#define MAX_DATA 65536
+
+/* ---- wire protocol (must match native/sequencer.cc + substrate) ---- */
+enum {
+  OP_SOCKET = 1,
+  OP_CONNECT = 2,
+  OP_SEND = 3,
+  OP_RECV = 4,
+  OP_CLOSE = 5,
+  OP_SLEEP = 6,
+  OP_GETTIME = 7,
+  OP_BIND = 8,
+  OP_LISTEN = 9,
+  OP_ACCEPT = 10,
+  OP_POLL = 11,
+  OP_EXIT = 12,
+};
+
+typedef struct {
+  uint32_t op;
+  int32_t fd;
+  int64_t a0;
+  int64_t a1;
+  uint32_t len;
+  unsigned char data[MAX_DATA];
+} req_t;
+
+typedef struct {
+  int64_t ret;
+  int32_t err;
+  int64_t vtime_ns;
+  uint32_t len;
+  unsigned char data[MAX_DATA];
+} rep_t;
+
+#define REQ_HDR ((size_t)offsetof(req_t, data))
+#define REP_HDR ((size_t)offsetof(rep_t, data))
+
+static int g_seq_fd = -1;
+static volatile int64_t *g_time_page = NULL;
+static int g_vfd_open[MAX_VFD];
+static int g_vfd_nonblock[MAX_VFD];
+
+static ssize_t (*real_read)(int, void *, size_t);
+static ssize_t (*real_write)(int, const void *, size_t);
+static int (*real_close)(int);
+static int (*real_clock_gettime)(clockid_t, struct timespec *);
+static int (*real_nanosleep)(const struct timespec *, struct timespec *);
+
+static void shim_init(void) __attribute__((constructor));
+
+static void shim_init(void) {
+  real_read = dlsym(RTLD_NEXT, "read");
+  real_write = dlsym(RTLD_NEXT, "write");
+  real_close = dlsym(RTLD_NEXT, "close");
+  real_clock_gettime = dlsym(RTLD_NEXT, "clock_gettime");
+  real_nanosleep = dlsym(RTLD_NEXT, "nanosleep");
+
+  const char *fd_s = getenv("SHADOW1_SHIM_FD");
+  if (fd_s) g_seq_fd = atoi(fd_s);
+  const char *page = getenv("SHADOW1_TIME_PAGE");
+  if (page) {
+    int pfd = open(page, O_RDONLY);
+    if (pfd >= 0) {
+      void *m = mmap(NULL, 4096, PROT_READ, MAP_SHARED, pfd, 0);
+      if (m != MAP_FAILED) g_time_page = (volatile int64_t *)m;
+      ((int (*)(int))real_close)(pfd);
+    }
+  }
+}
+
+static int is_vfd(int fd) {
+  return fd >= VFD_BASE && fd < VFD_BASE + MAX_VFD && g_vfd_open[fd - VFD_BASE];
+}
+
+/* One blocking round trip to the sequencer. */
+static int64_t rpc(req_t *rq, rep_t *rp) {
+  if (g_seq_fd < 0) {
+    errno = ENOSYS;
+    return -1;
+  }
+  ssize_t n = send(g_seq_fd, rq, REQ_HDR + rq->len, 0);
+  if (n < 0) _exit(117);
+  n = recv(g_seq_fd, rp, sizeof(*rp), 0);
+  if (n < (ssize_t)REP_HDR) _exit(118);
+  if (rp->ret < 0 && rp->err) errno = rp->err;
+  return rp->ret;
+}
+
+static int64_t vnow(void) {
+  if (g_time_page) return *g_time_page;
+  req_t rq = {.op = OP_GETTIME, .fd = -1, .len = 0};
+  rep_t rp;
+  rpc(&rq, &rp);
+  return rp.vtime_ns;
+}
+
+/* ---- sockets ---- */
+
+int socket(int domain, int type, int protocol) {
+  if (g_seq_fd >= 0 && domain == AF_INET) {
+    req_t rq = {.op = OP_SOCKET, .fd = -1, .a0 = type, .a1 = protocol,
+                .len = 0};
+    rep_t rp;
+    int64_t r = rpc(&rq, &rp);
+    if (r >= VFD_BASE && r < VFD_BASE + MAX_VFD) {
+      g_vfd_open[r - VFD_BASE] = 1;
+      g_vfd_nonblock[r - VFD_BASE] = (type & SOCK_NONBLOCK) != 0;
+    }
+    return (int)r;
+  }
+  static int (*real_socket)(int, int, int);
+  if (!real_socket) real_socket = dlsym(RTLD_NEXT, "socket");
+  return real_socket(domain, type, protocol);
+}
+
+int connect(int fd, const struct sockaddr *addr, socklen_t alen) {
+  if (is_vfd(fd) && addr && addr->sa_family == AF_INET) {
+    const struct sockaddr_in *a = (const struct sockaddr_in *)addr;
+    req_t rq = {.op = OP_CONNECT, .fd = fd,
+                .a0 = (int64_t)ntohl(a->sin_addr.s_addr),
+                .a1 = (int64_t)ntohs(a->sin_port), .len = 0};
+    rep_t rp;
+    return (int)rpc(&rq, &rp);
+  }
+  static int (*real_connect)(int, const struct sockaddr *, socklen_t);
+  if (!real_connect) real_connect = dlsym(RTLD_NEXT, "connect");
+  return real_connect(fd, addr, alen);
+}
+
+int bind(int fd, const struct sockaddr *addr, socklen_t alen) {
+  if (is_vfd(fd) && addr && addr->sa_family == AF_INET) {
+    const struct sockaddr_in *a = (const struct sockaddr_in *)addr;
+    req_t rq = {.op = OP_BIND, .fd = fd,
+                .a0 = (int64_t)ntohl(a->sin_addr.s_addr),
+                .a1 = (int64_t)ntohs(a->sin_port), .len = 0};
+    rep_t rp;
+    return (int)rpc(&rq, &rp);
+  }
+  static int (*real_bind)(int, const struct sockaddr *, socklen_t);
+  if (!real_bind) real_bind = dlsym(RTLD_NEXT, "bind");
+  return real_bind(fd, addr, alen);
+}
+
+int listen(int fd, int backlog) {
+  if (is_vfd(fd)) {
+    req_t rq = {.op = OP_LISTEN, .fd = fd, .a0 = backlog, .len = 0};
+    rep_t rp;
+    return (int)rpc(&rq, &rp);
+  }
+  static int (*real_listen)(int, int);
+  if (!real_listen) real_listen = dlsym(RTLD_NEXT, "listen");
+  return real_listen(fd, backlog);
+}
+
+int accept(int fd, struct sockaddr *addr, socklen_t *alen) {
+  if (is_vfd(fd)) {
+    req_t rq = {.op = OP_ACCEPT, .fd = fd, .len = 0};
+    rep_t rp;
+    int64_t r = rpc(&rq, &rp);
+    if (r >= VFD_BASE && r < VFD_BASE + MAX_VFD) {
+      g_vfd_open[r - VFD_BASE] = 1;
+      if (addr && alen && *alen >= sizeof(struct sockaddr_in)) {
+        struct sockaddr_in a = {0};
+        a.sin_family = AF_INET;
+        a.sin_addr.s_addr = htonl((uint32_t)rp.vtime_ns); /* unused for MVP */
+        *alen = sizeof(a);
+        memcpy(addr, &a, sizeof(a));
+      }
+    }
+    return (int)r;
+  }
+  static int (*real_accept)(int, struct sockaddr *, socklen_t *);
+  if (!real_accept) real_accept = dlsym(RTLD_NEXT, "accept");
+  return real_accept(fd, addr, alen);
+}
+
+static ssize_t vsend(int fd, const void *buf, size_t n, int flags) {
+  size_t chunk = n > MAX_DATA ? MAX_DATA : n;
+  req_t rq = {.op = OP_SEND, .fd = fd, .a0 = (int64_t)flags,
+              .a1 = g_vfd_nonblock[fd - VFD_BASE],
+              .len = (uint32_t)chunk};
+  memcpy(rq.data, buf, chunk);
+  rep_t rp;
+  return (ssize_t)rpc(&rq, &rp);
+}
+
+static ssize_t vrecv(int fd, void *buf, size_t n, int flags) {
+  size_t chunk = n > MAX_DATA ? MAX_DATA : n;
+  req_t rq = {.op = OP_RECV, .fd = fd, .a0 = (int64_t)chunk,
+              .a1 = (int64_t)flags | (g_vfd_nonblock[fd - VFD_BASE] ? (1 << 30) : 0),
+              .len = 0};
+  rep_t rp;
+  int64_t r = rpc(&rq, &rp);
+  if (r > 0) memcpy(buf, rp.data, (size_t)r);
+  return (ssize_t)r;
+}
+
+ssize_t send(int fd, const void *buf, size_t n, int flags) {
+  if (is_vfd(fd)) return vsend(fd, buf, n, flags);
+  static ssize_t (*real_send)(int, const void *, size_t, int);
+  if (!real_send) real_send = dlsym(RTLD_NEXT, "send");
+  return real_send(fd, buf, n, flags);
+}
+
+ssize_t recv(int fd, void *buf, size_t n, int flags) {
+  if (is_vfd(fd)) return vrecv(fd, buf, n, flags);
+  static ssize_t (*real_recv)(int, void *, size_t, int);
+  if (!real_recv) real_recv = dlsym(RTLD_NEXT, "recv");
+  return real_recv(fd, buf, n, flags);
+}
+
+ssize_t read(int fd, void *buf, size_t n) {
+  if (is_vfd(fd)) return vrecv(fd, buf, n, 0);
+  return real_read(fd, buf, n);
+}
+
+ssize_t write(int fd, const void *buf, size_t n) {
+  if (is_vfd(fd)) return vsend(fd, buf, n, 0);
+  return real_write(fd, buf, n);
+}
+
+int close(int fd) {
+  if (is_vfd(fd)) {
+    g_vfd_open[fd - VFD_BASE] = 0;
+    req_t rq = {.op = OP_CLOSE, .fd = fd, .len = 0};
+    rep_t rp;
+    return (int)rpc(&rq, &rp);
+  }
+  return real_close(fd);
+}
+
+int setsockopt(int fd, int level, int name, const void *val, socklen_t len) {
+  if (is_vfd(fd)) return 0; /* accepted, modeled elsewhere */
+  static int (*real_so)(int, int, int, const void *, socklen_t);
+  if (!real_so) real_so = dlsym(RTLD_NEXT, "setsockopt");
+  return real_so(fd, level, name, val, len);
+}
+
+int getsockopt(int fd, int level, int name, void *val, socklen_t *len) {
+  if (is_vfd(fd)) {
+    if (level == SOL_SOCKET && name == SO_ERROR && val && len &&
+        *len >= sizeof(int)) {
+      *(int *)val = 0;
+      *len = sizeof(int);
+      return 0;
+    }
+    return 0;
+  }
+  static int (*real_go)(int, int, int, void *, socklen_t *);
+  if (!real_go) real_go = dlsym(RTLD_NEXT, "getsockopt");
+  return real_go(fd, level, name, val, len);
+}
+
+int fcntl(int fd, int cmd, ...) {
+  va_list ap;
+  va_start(ap, cmd);
+  long arg = va_arg(ap, long);
+  va_end(ap);
+  if (is_vfd(fd)) {
+    if (cmd == F_SETFL) {
+      g_vfd_nonblock[fd - VFD_BASE] = (arg & O_NONBLOCK) != 0;
+      return 0;
+    }
+    if (cmd == F_GETFL)
+      return g_vfd_nonblock[fd - VFD_BASE] ? O_NONBLOCK : 0;
+    return 0;
+  }
+  static int (*real_fcntl)(int, int, ...);
+  if (!real_fcntl) real_fcntl = dlsym(RTLD_NEXT, "fcntl");
+  return real_fcntl(fd, cmd, arg);
+}
+
+int shutdown(int fd, int how) {
+  if (is_vfd(fd)) {
+    req_t rq = {.op = OP_CLOSE, .fd = fd, .a0 = 1 /* half-close */,
+                .len = 0};
+    rep_t rp;
+    return (int)rpc(&rq, &rp);
+  }
+  static int (*real_shutdown)(int, int);
+  if (!real_shutdown) real_shutdown = dlsym(RTLD_NEXT, "shutdown");
+  return real_shutdown(fd, how);
+}
+
+/* ---- time ---- */
+
+int clock_gettime(clockid_t clk, struct timespec *ts) {
+  if (g_seq_fd >= 0 && ts &&
+      (clk == CLOCK_REALTIME || clk == CLOCK_MONOTONIC ||
+       clk == CLOCK_MONOTONIC_RAW || clk == CLOCK_BOOTTIME)) {
+    int64_t t = vnow();
+    ts->tv_sec = t / 1000000000LL;
+    ts->tv_nsec = t % 1000000000LL;
+    return 0;
+  }
+  return real_clock_gettime(clk, ts);
+}
+
+int gettimeofday(struct timeval *tv, void *tz) {
+  (void)tz;
+  if (g_seq_fd >= 0 && tv) {
+    int64_t t = vnow();
+    tv->tv_sec = t / 1000000000LL;
+    tv->tv_usec = (t % 1000000000LL) / 1000;
+    return 0;
+  }
+  static int (*real_gtod)(struct timeval *, void *);
+  if (!real_gtod) real_gtod = dlsym(RTLD_NEXT, "gettimeofday");
+  return real_gtod(tv, tz);
+}
+
+time_t time(time_t *out) {
+  if (g_seq_fd >= 0) {
+    time_t t = (time_t)(vnow() / 1000000000LL);
+    if (out) *out = t;
+    return t;
+  }
+  static time_t (*real_time)(time_t *);
+  if (!real_time) real_time = dlsym(RTLD_NEXT, "time");
+  return real_time(out);
+}
+
+int nanosleep(const struct timespec *req, struct timespec *rem) {
+  if (g_seq_fd >= 0 && req) {
+    req_t rq = {.op = OP_SLEEP, .fd = -1,
+                .a0 = (int64_t)req->tv_sec * 1000000000LL + req->tv_nsec,
+                .len = 0};
+    rep_t rp;
+    rpc(&rq, &rp);
+    if (rem) rem->tv_sec = rem->tv_nsec = 0;
+    return 0;
+  }
+  return real_nanosleep(req, rem);
+}
+
+int usleep(useconds_t us) {
+  struct timespec ts = {us / 1000000, (long)(us % 1000000) * 1000};
+  return nanosleep(&ts, NULL);
+}
+
+unsigned int sleep(unsigned int sec) {
+  struct timespec ts = {sec, 0};
+  nanosleep(&ts, NULL);
+  return 0;
+}

@@ -1,0 +1,81 @@
+"""Modeled TCP echo server: mirrors every received byte back to the peer.
+
+The first real-code workload's counterpart (tests/test_substrate.py): a
+real client binary talks to this on-device model, so the whole transport
+path -- handshake, windows, delivery timing -- is exercised end-to-end
+while the server side stays a pure vectorized app.  Equivalent role to
+the reference's shadow-plugin test servers (src/test/tcp/test_tcp.c
+server mode).
+"""
+
+from __future__ import annotations
+
+from flax import struct
+import jax.numpy as jnp
+
+from ..core import simtime
+from ..core.state import I64, SOCK_TCP, TCPS_CLOSEWAIT, TCPS_ESTABLISHED
+from ..transport.tcp import _sdiff
+
+
+@struct.dataclass
+class EchoState:
+    is_server: jnp.ndarray   # [H] bool
+
+
+class EchoServer:
+    """Echo every readable byte on every established server socket."""
+
+    uses_tcp = True
+    may_loopback = False
+
+    def __hash__(self):
+        return hash("echo-server")
+
+    def __eq__(self, other):
+        return isinstance(other, EchoServer)
+
+    def next_time(self, state):
+        h = state.app.is_server.shape[0]
+        return jnp.full((h,), simtime.SIMTIME_INVALID, I64)
+
+    def on_tick(self, state, params, em, tick_t, active):
+        a = state.app
+        socks = state.socks
+        srv = a.is_server[:, None] & active[:, None]
+
+        # Children of a listener carry parent >= 0; those are the data
+        # sockets (the listener itself never reaches ESTABLISHED).
+        live = (socks.stype == SOCK_TCP) & (socks.parent >= 0) & srv & (
+            (socks.tcp_state == TCPS_ESTABLISHED) |
+            (socks.tcp_state == TCPS_CLOSEWAIT))
+
+        avail = _sdiff(socks.rcv_nxt, socks.rcv_read)
+        used = _sdiff(socks.snd_end, socks.snd_una)
+        room = jnp.maximum(socks.snd_buf_cap - used, 0)
+        n = jnp.clip(jnp.minimum(avail, room), 0)
+        do = live & (n > 0)
+        # Writing into a zero peer window must arm the persist timer or
+        # nothing ever fires for the socket again (same rule as
+        # tcp.write_v; the window-reopening ACK can be lost).
+        blocked = do & (socks.snd_wnd == 0) & \
+            (socks.t_persist == simtime.SIMTIME_INVALID) & \
+            (socks.t_rto == simtime.SIMTIME_INVALID)
+        socks = socks.replace(
+            snd_end=jnp.where(do, socks.snd_end + n.astype(jnp.uint32),
+                              socks.snd_end),
+            rcv_read=jnp.where(do, socks.rcv_read + n.astype(jnp.uint32),
+                               socks.rcv_read),
+            t_persist=jnp.where(blocked, tick_t[:, None] + socks.rto,
+                                socks.t_persist),
+        )
+
+        # Peer closed and everything echoed: close our side too.
+        done = live & (socks.tcp_state == TCPS_CLOSEWAIT) & \
+            (_sdiff(socks.rcv_nxt, socks.rcv_read) <= 0) & ~socks.app_closed
+        socks = socks.replace(app_closed=socks.app_closed | done)
+        return state.replace(socks=socks), em
+
+
+def init_state(is_server) -> EchoState:
+    return EchoState(is_server=jnp.asarray(is_server, bool))

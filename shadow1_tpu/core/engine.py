@@ -70,7 +70,7 @@ from .state import (ERR_POOL_OVERFLOW, I32, I64, U32, PROTO_TCP, PROTO_UDP,
                     ICOL_SEQ, ICOL_ACK, ICOL_WND, ICOL_LEN, ICOL_PAYLOAD,
                     ICOL_TIME_LO, ICOL_TIME_HI, ICOL_CTR_LO, ICOL_CTR_HI,
                     ICOL_TS_LO, ICOL_TS_HI, ICOL_TSE_LO, ICOL_TSE_HI, ICOLS,
-                    enc_lo, enc_hi, dec_i64, SimState)
+                    enc_lo, enc_hi, dec_i64, pack_inbox_cols, SimState)
 
 INV = simtime.SIMTIME_INVALID
 
@@ -258,26 +258,12 @@ def _exchange_body(state: SimState, params) -> SimState:
     def pad0(x):
         return jnp.pad(x, (0, pad))
 
-    ctr = pool.pkt_id & _MASK40
-    cols = [None] * ICOLS
-    cols[ICOL_SRC] = pool.src
-    cols[ICOL_SPORT] = pool.sport
-    cols[ICOL_DPORT] = pool.dport
-    cols[ICOL_PROTO] = pool.proto
-    cols[ICOL_FLAGS] = pool.flags
-    cols[ICOL_SEQ] = _bitcast_u32_i32(pool.seq)
-    cols[ICOL_ACK] = _bitcast_u32_i32(pool.ack)
-    cols[ICOL_WND] = pool.wnd
-    cols[ICOL_LEN] = pool.length
-    cols[ICOL_PAYLOAD] = pool.payload_id
-    cols[ICOL_TIME_LO] = enc_lo(pool.time)
-    cols[ICOL_TIME_HI] = enc_hi(pool.time)
-    cols[ICOL_CTR_LO] = enc_lo(ctr)
-    cols[ICOL_CTR_HI] = enc_hi(ctr)
-    cols[ICOL_TS_LO] = enc_lo(pool.ts)
-    cols[ICOL_TS_HI] = enc_hi(pool.ts)
-    cols[ICOL_TSE_LO] = enc_lo(pool.ts_echo)
-    cols[ICOL_TSE_HI] = enc_hi(pool.ts_echo)
+    cols = pack_inbox_cols(
+        src=pool.src, sport=pool.sport, dport=pool.dport, proto=pool.proto,
+        flags=pool.flags, seq_i32=_bitcast_u32_i32(pool.seq),
+        ack_i32=_bitcast_u32_i32(pool.ack), wnd=pool.wnd,
+        length=pool.length, payload_id=pool.payload_id, time=pool.time,
+        ctr=pool.pkt_id & _MASK40, ts=pool.ts, ts_echo=pool.ts_echo)
     vals = jnp.stack([pad0(c.astype(I32)) for c in cols], axis=1)  # [npad, C]
 
     blk = ib.blk.at[islot].set(vals, mode="drop")
@@ -677,25 +663,12 @@ def _loopback_insert(state: SimState, em, lb, src2, ctr2, send_t):
     islot = jnp.where(ok, src2 * ki + within, p1).reshape(-1)
 
     arr = send_t + simtime.SIMTIME_ONE_NANOSECOND
-    cols = [None] * ICOLS
-    cols[ICOL_SRC] = src2
-    cols[ICOL_SPORT] = em.sport
-    cols[ICOL_DPORT] = em.dport
-    cols[ICOL_PROTO] = em.proto
-    cols[ICOL_FLAGS] = em.flags
-    cols[ICOL_SEQ] = _bitcast_u32_i32(em.seq)
-    cols[ICOL_ACK] = _bitcast_u32_i32(em.ack)
-    cols[ICOL_WND] = em.wnd
-    cols[ICOL_LEN] = em.length
-    cols[ICOL_PAYLOAD] = em.payload_id
-    cols[ICOL_TIME_LO] = enc_lo(arr)
-    cols[ICOL_TIME_HI] = enc_hi(arr)
-    cols[ICOL_CTR_LO] = enc_lo(ctr2)
-    cols[ICOL_CTR_HI] = enc_hi(ctr2)
-    cols[ICOL_TS_LO] = enc_lo(send_t)
-    cols[ICOL_TS_HI] = enc_hi(send_t)
-    cols[ICOL_TSE_LO] = enc_lo(em.ts_echo)
-    cols[ICOL_TSE_HI] = enc_hi(em.ts_echo)
+    cols = pack_inbox_cols(
+        src=src2, sport=em.sport, dport=em.dport, proto=em.proto,
+        flags=em.flags, seq_i32=_bitcast_u32_i32(em.seq),
+        ack_i32=_bitcast_u32_i32(em.ack), wnd=em.wnd, length=em.length,
+        payload_id=em.payload_id, time=arr, ctr=ctr2, ts=send_t,
+        ts_echo=em.ts_echo)
     vals = jnp.stack([c.astype(I32).reshape(-1) for c in cols], axis=1)
 
     pds = PDS_SND_CREATED | PDS_SND_INTERFACE_SENT | PDS_INET_SENT

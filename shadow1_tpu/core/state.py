@@ -219,6 +219,51 @@ def dec_i64(lo, hi):
     return (hi.astype(I64) << 31) | lo.astype(I64)
 
 
+def pack_inbox_cols(*, src, sport, dport, proto, flags, seq_i32, ack_i32,
+                    wnd, length, payload_id, time, ctr, ts, ts_echo):
+    """The ONE encode site for the packed inbox block: returns the list of
+    ICOLS i32 column arrays in ICOL_* order (callers stack them).  Both
+    the boundary exchange and the loopback insert must agree with
+    Inbox/engine.RxPkt decoding, so they share this."""
+    cols = [None] * ICOLS
+    cols[ICOL_SRC] = src
+    cols[ICOL_SPORT] = sport
+    cols[ICOL_DPORT] = dport
+    cols[ICOL_PROTO] = proto
+    cols[ICOL_FLAGS] = flags
+    cols[ICOL_SEQ] = seq_i32
+    cols[ICOL_ACK] = ack_i32
+    cols[ICOL_WND] = wnd
+    cols[ICOL_LEN] = length
+    cols[ICOL_PAYLOAD] = payload_id
+    cols[ICOL_TIME_LO] = enc_lo(time)
+    cols[ICOL_TIME_HI] = enc_hi(time)
+    cols[ICOL_CTR_LO] = enc_lo(ctr)
+    cols[ICOL_CTR_HI] = enc_hi(ctr)
+    cols[ICOL_TS_LO] = enc_lo(ts)
+    cols[ICOL_TS_HI] = enc_hi(ts)
+    cols[ICOL_TSE_LO] = enc_lo(ts_echo)
+    cols[ICOL_TSE_HI] = enc_hi(ts_echo)
+    return cols
+
+
+def onehot_slot(slots: int, slot):
+    """[H,S] one-hot for a per-host slot index (clipped).  Indexed [H,S]
+    gather/scatter costs real milliseconds inside a compiled loop; one-hot
+    masked selects fuse for free (tools/opbench2.py)."""
+    safe = jnp.clip(slot, 0, slots - 1)
+    return safe[..., None] == jnp.arange(slots, dtype=I32)
+
+
+def onehot_gather(tab, oh):
+    """Gather [H] from [H,S] (or [H,S,R] with an [H,S,R] one-hot) under a
+    one-hot mask; bool tables reduce with any()."""
+    axes = tuple(range(1, tab.ndim)) if oh.ndim == tab.ndim else (1,)
+    if tab.dtype == jnp.bool_:
+        return jnp.any(oh & tab, axis=axes)
+    return jnp.sum(jnp.where(oh, tab, 0), axis=axes, dtype=tab.dtype)
+
+
 @struct.dataclass
 class Inbox:
     """Packets at (or heading to) their destination, in per-destination

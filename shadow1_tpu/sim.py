@@ -38,6 +38,9 @@ def build_phold(num_hosts: int,
     The topology is capped at 256 vertices with hosts striped across them
     (all pair latencies are identical anyway), so the [V,V] routing
     matrices stay small however many hosts the benchmark scales to."""
+    if num_hosts < 2:
+        raise ValueError("phold needs at least 2 hosts (every message is "
+                         "forwarded to a different host)")
     v = min(num_hosts, 256)
 
     def _build_params():
@@ -65,9 +68,6 @@ def build_phold(num_hosts: int,
             hosts=state.hosts.replace(rng_ctr=state.hosts.rng_ctr + 1),
         )
 
-    if num_hosts < 2:
-        raise ValueError("phold needs at least 2 hosts (every message is "
-                         "forwarded to a different host)")
     state = _pkg.build_on_host(_build_state)
     # App init keys off params.seed_key (already on the default backend),
     # so it runs there -- it is only a handful of ops.

@@ -1,0 +1,13 @@
+"""Real-code process substrate: run actual compiled binaries against the
+simulated network (the reference's defining capability, rebuilt per
+docs/design-process-substrate.md).
+
+- native/shim/shadow1_shim.c: LD_PRELOAD syscall interposer (the
+  reference's src/preload/interposer.c equivalent).
+- native/sequencer.cc: process supervisor + deterministic run-until-
+  blocked IPC pump (the process.c/rpth equivalent).
+- bridge.py: fd tables, blocking semantics, and the window-protocol
+  bridge onto the device engine.
+"""
+
+from .bridge import RealProcess, Substrate, run  # noqa: F401

@@ -1,0 +1,505 @@
+"""The window-protocol bridge: real processes <-> the device engine.
+
+Architecture (docs/design-process-substrate.md): real plugin binaries run
+as OS processes under the native sequencer (native/sequencer.cc) with the
+syscall shim preloaded (native/shim/shadow1_shim.c).  Between device
+windows the bridge
+
+  1. publishes the virtual clock,
+  2. fetches each real socket's transport registers from the device state,
+  3. runs every runnable process until it blocks (reply -> next request,
+     one process at a time, in deterministic (host, process) order),
+  4. applies the produced socket operations to the device state through
+     the same vectorized API modeled apps use (tcp.connect_v / write_v /
+     close_v, rcv_read advances).
+
+This reproduces the reference's contract -- plugins execute serially
+between event-loop steps, blocked syscalls resume on readiness
+(process.c:1197-1275 run-until-blocked, epoll.c:638-671 tryNotify) --
+with the conservative window, not an in-process scheduler, as the
+synchronization boundary.
+
+Payload bytes never touch the device: each virtual socket keeps its sent
+byte stream host-side, and inbound bytes come from a `content_provider`
+(for a modeled peer like the echo server, content derives from the
+stream; a future real-peer path reads the opposite endpoint's stream).
+The device controls *timing only* -- how many bytes are deliverable when
+-- which is exactly the reference's split between Payload refcounts and
+packet events (src/main/routing/payload.c).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from .. import build_on_host
+from ..core import simtime
+from ..transport import tcp
+from . import buildlib
+
+# Wire protocol (matches native/shim/shadow1_shim.c + sequencer.cc).
+OP_SOCKET, OP_CONNECT, OP_SEND, OP_RECV, OP_CLOSE, OP_SLEEP, OP_GETTIME, \
+    OP_BIND, OP_LISTEN, OP_ACCEPT, OP_POLL, OP_EXIT = range(1, 13)
+
+VFD_BASE = 1 << 20
+MAX_DATA = 65536
+
+# Reference EMULATED_TIME_OFFSET: plugin wall clocks start at Jan 1 2000
+# (definitions.h:78).
+EMULATED_EPOCH_NS = 946_684_800 * simtime.SIMTIME_ONE_SECOND
+
+_EAGAIN = 11
+_ECONNREFUSED = 111
+
+
+class _SeqLib:
+    """ctypes binding of native/sequencer.cc."""
+
+    def __init__(self):
+        lib = ctypes.CDLL(buildlib.sequencer_path())
+        lib.seq_create.argtypes = [ctypes.c_char_p]
+        lib.seq_create.restype = ctypes.c_int
+        lib.seq_settime.argtypes = [ctypes.c_int, ctypes.c_int64]
+        lib.seq_spawn.argtypes = [ctypes.c_int, ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_char_p),
+                                  ctypes.c_char_p, ctypes.c_char_p]
+        lib.seq_spawn.restype = ctypes.c_int
+        lib.seq_wait_request.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint32)]
+        lib.seq_wait_request.restype = ctypes.c_int
+        lib.seq_reply.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int64,
+                                  ctypes.c_int32, ctypes.c_int64,
+                                  ctypes.POINTER(ctypes.c_uint8),
+                                  ctypes.c_uint32]
+        lib.seq_status.argtypes = [ctypes.c_int, ctypes.c_int,
+                                   ctypes.POINTER(ctypes.c_int)]
+        lib.seq_kill.argtypes = [ctypes.c_int, ctypes.c_int]
+        self.lib = lib
+
+
+@dataclass
+class VSocket:
+    """Host-side view of one simulated socket owned by a real process."""
+
+    slot: int
+    vfd: int
+    local_port: int = 0
+    connecting: bool = False
+    connected: bool = False
+    closed: bool = False
+    sent: bytearray = field(default_factory=bytearray)  # app->net stream
+    recv_cursor: int = 0                                # bytes handed to app
+
+
+@dataclass
+class Parked:
+    op: int
+    fd: int = -1
+    a0: int = 0
+    a1: int = 0
+    wake_ns: int = -1   # for OP_SLEEP
+
+
+class RealProcess:
+    """One supervised plugin process (reference Process analog)."""
+
+    def __init__(self, host: int, proc_id: int):
+        self.host = host
+        self.proc_id = proc_id
+        self.vfds: dict[int, VSocket] = {}
+        self.next_vfd = VFD_BASE
+        self.parked: Parked | None = None
+        self.started = False
+        self.exited = False
+        self.exit_code: int | None = None
+        self.trace: list[tuple] = []   # deterministic syscall transcript
+
+
+class Substrate:
+    """Owns the sequencer, all real processes, and the device bridge."""
+
+    def __init__(self, resolve_ip, workdir: str, sock_slot_base: int = 0,
+                 ephemeral_base: int = 40000):
+        """resolve_ip: callable(int ipv4) -> host index (DNS analog)."""
+        self._lib = _SeqLib().lib
+        os.makedirs(workdir, exist_ok=True)
+        self.workdir = workdir
+        self.handle = self._lib.seq_create(
+            os.path.join(workdir, "vclock").encode())
+        assert self.handle >= 0, "sequencer init failed"
+        self.shim = buildlib.shim_path()
+        self.resolve_ip = resolve_ip
+        self.procs: list[RealProcess] = []
+        self.sock_slot_base = sock_slot_base
+        self._next_slot: dict[int, int] = {}
+        self._next_port = ephemeral_base
+        self.content_provider = None   # (host, slot, vsock, n) -> bytes
+        self._pending = []             # queued device ops for this sync
+        self.max_slots = 1 << 30       # refined from the state at sync
+
+    # -- process management -------------------------------------------------
+
+    def spawn(self, host: int, argv: list[str]) -> RealProcess:
+        arr = (ctypes.c_char_p * len(argv))(*[a.encode() for a in argv])
+        out = os.path.join(self.workdir,
+                           f"proc-{len(self.procs)}.stdout")
+        pid = self._lib.seq_spawn(self.handle, len(argv), arr,
+                                  self.shim.encode(), out.encode())
+        assert pid >= 0, f"spawn failed: {argv}"
+        p = RealProcess(host, pid)
+        self.procs.append(p)
+        return p
+
+    def _alloc_slot(self, host: int) -> int:
+        s = self._next_slot.get(host, self.sock_slot_base)
+        self._next_slot[host] = s + 1
+        return s
+
+    def _alloc_port(self) -> int:
+        self._next_port += 1
+        return self._next_port
+
+    # -- the per-window sync -------------------------------------------------
+
+    def sync(self, state, params, now_ns: int):
+        """Publish the clock, run every runnable process until it blocks,
+        apply the produced socket ops.  Returns the updated state."""
+        self._lib.seq_settime(self.handle, EMULATED_EPOCH_NS + now_ns)
+        regs = self._fetch(state)
+        self._pending = []
+        # Local deltas so several syscalls within one sync see each
+        # other's effects before the device does.
+        self._local_written: dict[tuple, int] = {}
+        self._local_read: dict[tuple, int] = {}
+
+        for p in self.procs:          # deterministic order: spawn order
+            self._run_until_blocked(p, regs, now_ns)
+
+        return self._apply(state, now_ns)
+
+    def next_wake(self) -> int | None:
+        """Earliest virtual time a parked process needs (sleep expiry)."""
+        wakes = [p.parked.wake_ns for p in self.procs
+                 if not p.exited and p.parked is not None
+                 and p.parked.op == OP_SLEEP]
+        return min(wakes) if wakes else None
+
+    def all_exited(self) -> bool:
+        return all(p.exited for p in self.procs)
+
+    # -- internals ------------------------------------------------------------
+
+    def _fetch(self, state):
+        socks = state.socks
+        self.max_slots = socks.slots
+        names = ("tcp_state", "rcv_nxt", "rcv_read", "snd_una", "snd_end",
+                 "snd_buf_cap", "error", "fin_seq", "stype")
+        vals = jax.device_get(tuple(getattr(socks, n) for n in names))
+        return dict(zip(names, vals))
+
+    def _run_until_blocked(self, p: RealProcess, regs, now_ns):
+        if p.exited:
+            return
+        # A parked syscall must become unblocked before the process runs.
+        if p.parked is not None:
+            rep = self._try_unpark(p, regs, now_ns)
+            if rep is None:
+                return
+            self._reply(p, *rep)
+            p.parked = None
+        elif p.started:
+            return  # running state impossible: it always parks or exits
+        p.started = True
+
+        # Pump: read requests until the process parks or exits.
+        while True:
+            status, req = self._wait(p)
+            if status == 0:
+                p.exited = True
+                p.exit_code = req
+                return
+            if status == -2:
+                raise RuntimeError(
+                    f"process {p.proc_id} wedged (no syscall within "
+                    f"timeout); runaway compute loop?")
+            if status < 0:
+                raise RuntimeError(
+                    f"sequencer IPC error for process {p.proc_id} "
+                    f"(status {status})")
+            rep = self._handle(p, req, regs, now_ns)
+            if rep is None:
+                return  # parked
+            self._reply(p, *rep)
+
+    def _wait(self, p: RealProcess, timeout_ms: int = 30000):
+        op = ctypes.c_uint32()
+        fd = ctypes.c_int32()
+        a0 = ctypes.c_int64()
+        a1 = ctypes.c_int64()
+        data = (ctypes.c_uint8 * MAX_DATA)()
+        length = ctypes.c_uint32()
+        r = self._lib.seq_wait_request(self.handle, p.proc_id, timeout_ms,
+                                       ctypes.byref(op), ctypes.byref(fd),
+                                       ctypes.byref(a0), ctypes.byref(a1),
+                                       data, ctypes.byref(length))
+        if r == 0:
+            return 0, int(a0.value)
+        if r == 1:
+            return 1, (int(op.value), int(fd.value), int(a0.value),
+                       int(a1.value), bytes(data[:length.value]))
+        return r, None
+
+    def _reply(self, p: RealProcess, ret, err=0, payload=b""):
+        buf = (ctypes.c_uint8 * max(1, len(payload)))(*payload)
+        r = self._lib.seq_reply(self.handle, p.proc_id, ret, err,
+                                EMULATED_EPOCH_NS + self._now, buf,
+                                len(payload))
+        assert r == 0
+
+    # --- syscall semantics ---------------------------------------------------
+
+    def _handle(self, p: RealProcess, req, regs, now_ns):
+        """Returns a reply tuple (ret, err, payload) or None to park."""
+        self._now = now_ns
+        op, fd, a0, a1, data = req
+        h = p.host
+        p.trace.append((now_ns, op, fd, a0, a1, len(data)))
+
+        if op == OP_SOCKET:
+            if p.next_vfd - VFD_BASE >= 4096:
+                return (-1, 24, b"")  # EMFILE: shim table exhausted
+            slot = self._alloc_slot(h)
+            if slot >= self.max_slots:
+                self._next_slot[h] = slot  # keep counter honest
+                return (-1, 24, b"")  # EMFILE: device socket table full
+            vfd = p.next_vfd
+            p.next_vfd += 1
+            vs = VSocket(slot=slot, vfd=vfd)
+            p.vfds[vfd] = vs
+            return (vfd, 0, b"")
+
+        if op == OP_GETTIME:
+            return (0, 0, b"")
+
+        if op == OP_SLEEP:
+            p.parked = Parked(OP_SLEEP, wake_ns=now_ns + max(0, a0))
+            return None
+
+        vs = p.vfds.get(fd)
+        if vs is None:
+            return (-1, 9, b"")  # EBADF
+
+        if op == OP_BIND:
+            vs.local_port = int(a1)
+            return (0, 0, b"")
+
+        if op == OP_CONNECT:
+            dst = self.resolve_ip(int(a0))
+            if dst is None:
+                return (-1, _ECONNREFUSED, b"")
+            if not vs.local_port:
+                vs.local_port = self._alloc_port()
+            vs.connecting = True
+            self._pending.append(("connect", h, vs.slot, dst, int(a1),
+                                  vs.local_port))
+            p.parked = Parked(OP_CONNECT, fd=fd)
+            return None
+
+        if op == OP_SEND:
+            return self._do_send(p, vs, data, regs, nonblock=bool(a1))
+
+        if op == OP_RECV:
+            nonblock = bool(a1 & (1 << 30))
+            return self._do_recv(p, vs, int(a0), regs, nonblock)
+
+        if op == OP_CLOSE:
+            if not vs.closed:
+                vs.closed = True
+                self._pending.append(("close", p.host, vs.slot))
+            return (0, 0, b"")
+
+        return (-1, 38, b"")  # ENOSYS
+
+    def _room(self, p, vs, regs):
+        h = p.host
+        key = (h, vs.slot)
+        snd_end = int(regs["snd_end"][h, vs.slot]) + \
+            self._local_written.get(key, 0)
+        used = (snd_end - int(regs["snd_una"][h, vs.slot])) & 0xFFFFFFFF
+        return int(regs["snd_buf_cap"][h, vs.slot]) - used
+
+    def _avail(self, p, vs, regs):
+        h = p.host
+        key = (h, vs.slot)
+        d = (int(regs["rcv_nxt"][h, vs.slot]) -
+             int(regs["rcv_read"][h, vs.slot])) & 0xFFFFFFFF
+        return d - self._local_read.get(key, 0)
+
+    def _do_send(self, p, vs, data, regs, nonblock):
+        room = self._room(p, vs, regs)
+        if room <= 0:
+            if nonblock:
+                return (-1, _EAGAIN, b"")
+            p.parked = Parked(OP_SEND, fd=vs.vfd)
+            p.parked.data = data  # type: ignore[attr-defined]
+            return None
+        n = min(len(data), room)
+        vs.sent.extend(data[:n])
+        key = (p.host, vs.slot)
+        self._local_written[key] = self._local_written.get(key, 0) + n
+        self._pending.append(("write", p.host, vs.slot, n))
+        return (n, 0, b"")
+
+    def _do_recv(self, p, vs, maxlen, regs, nonblock):
+        avail = self._avail(p, vs, regs)
+        if avail <= 0:
+            st = int(regs["tcp_state"][p.host, vs.slot])
+            err = int(regs["error"][p.host, vs.slot])
+            if err != 0:
+                # RST/timeout surfaces as a recv error, like Linux
+                # (ECONNRESET/ETIMEDOUT), not a clean EOF.
+                return (-1, err, b"")
+            # Peer closed and everything consumed -> EOF.
+            if st in (tcp.TCPS_CLOSEWAIT, tcp.TCPS_LASTACK,
+                      tcp.TCPS_CLOSED):
+                return (0, 0, b"")
+            if nonblock:
+                return (-1, _EAGAIN, b"")
+            p.parked = Parked(OP_RECV, fd=vs.vfd, a0=maxlen)
+            return None
+        n = min(maxlen, avail, MAX_DATA)
+        payload = self._content(p.host, vs, n)
+        vs.recv_cursor += n
+        key = (p.host, vs.slot)
+        self._local_read[key] = self._local_read.get(key, 0) + n
+        self._pending.append(("read", p.host, vs.slot, n))
+        return (n, 0, payload)
+
+    def _content(self, host, vs, n):
+        if self.content_provider is None:
+            return bytes(n)
+        out = self.content_provider(host, vs, vs.recv_cursor, n)
+        assert len(out) == n, "content provider returned wrong length"
+        return out
+
+    def _try_unpark(self, p: RealProcess, regs, now_ns):
+        """If the parked syscall's condition now holds, produce its reply."""
+        self._now = now_ns
+        pk = p.parked
+        if pk.op == OP_SLEEP:
+            return (0, 0, b"") if now_ns >= pk.wake_ns else None
+        vs = p.vfds.get(pk.fd)
+        if vs is None:
+            return (-1, 9, b"")
+        h = p.host
+        if pk.op == OP_CONNECT:
+            st = int(regs["tcp_state"][h, vs.slot])
+            err = int(regs["error"][h, vs.slot])
+            if st == tcp.TCPS_ESTABLISHED:
+                vs.connected = True
+                vs.connecting = False
+                return (0, 0, b"")
+            if err != 0:
+                # Every failure path (RST, handshake timeout) sets the
+                # socket error register.
+                return (-1, _ECONNREFUSED, b"")
+            return None
+        if pk.op == OP_SEND:
+            data = getattr(pk, "data", b"")
+            rep = self._do_send(p, vs, data, regs, nonblock=False)
+            if rep is None:
+                p.parked = pk  # still blocked
+            return rep
+        if pk.op == OP_RECV:
+            rep = self._do_recv(p, vs, pk.a0, regs, nonblock=False)
+            if rep is None:
+                p.parked = pk
+            return rep
+        return (-1, 38, b"")
+
+    # --- device application ---------------------------------------------------
+
+    def _apply(self, state, now_ns):
+        """Apply queued socket ops through the vectorized transport API."""
+        if not self._pending:
+            return state
+        import jax.numpy as jnp
+
+        socks = state.socks
+        hN = socks.num_hosts
+        now = jnp.asarray(now_ns, jnp.int64)
+        wake = np.zeros(hN, bool)   # hosts that must tick to act on this
+
+        for op in self._pending:
+            kind = op[0]
+            if kind == "connect":
+                _, h, slot, dst, dport, lport = op
+                mask = np.zeros(hN, bool)
+                mask[h] = True
+                socks = tcp.connect_v(socks, jnp.asarray(mask), slot,
+                                      dst, dport, lport, now)
+            elif kind == "write":
+                _, h, slot, n = op
+                mask = np.zeros(hN, bool)
+                mask[h] = True
+                target = (socks.snd_end[h, slot] + np.uint32(n))
+                socks = tcp.write_v(socks, jnp.asarray(mask), slot,
+                                    target, now=now)
+                wake[h] = True
+            elif kind == "read":
+                _, h, slot, n = op
+                socks = socks.replace(
+                    rcv_read=socks.rcv_read.at[h, slot].add(np.uint32(n)))
+                wake[h] = True   # reopened window may need an ACK/update
+            elif kind == "close":
+                _, h, slot = op
+                mask = np.zeros(hN, bool)
+                mask[h] = True
+                socks = tcp.close_v(socks, jnp.asarray(mask), slot)
+                wake[h] = True
+        self._pending = []
+        state = state.replace(socks=socks)
+        if wake.any():
+            # New sendable work exists outside any tick: the host must
+            # micro-step at `now` for the transmitter to see it (modeled
+            # apps get this for free because they write during phase C).
+            import jax.numpy as jnp2
+            hosts = state.hosts
+            state = state.replace(hosts=hosts.replace(
+                t_resume=jnp2.minimum(hosts.t_resume,
+                                      jnp2.where(jnp2.asarray(wake), now,
+                                                 jnp2.asarray(
+                                                     simtime.SIMTIME_INVALID,
+                                                     jnp2.int64)))))
+        return state
+
+
+def run(substrate: Substrate, state, params, app, t_target: int,
+        sync_interval_ns: int | None = None):
+    """Drive the simulation with real processes attached: alternate device
+    windows with substrate syncs until t_target (or everything exits)."""
+    from ..core import engine
+
+    if sync_interval_ns is None:
+        sync_interval_ns = int(params.min_latency_ns)
+    t = int(state.now)
+    state = substrate.sync(state, params, t)
+    while t < t_target:
+        wake = substrate.next_wake()
+        t_next = min(t + sync_interval_ns, t_target)
+        if wake is not None:
+            t_next = min(max(wake, t + 1), t_next)
+        state = engine.run_until(state, params, app, t_next)
+        t = t_next
+        state = substrate.sync(state, params, t)
+        if substrate.all_exited():
+            break
+    return state

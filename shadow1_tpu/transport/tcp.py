@@ -156,8 +156,7 @@ class _Sock:
             jnp.clip(jnp.asarray(slot, I32), 0, socks.slots - 1),
             (socks.num_hosts,))
         d(self, "_slot", slot)
-        d(self, "_onehot",
-          slot[:, None] == jnp.arange(socks.slots, dtype=slot.dtype)[None, :])
+        d(self, "_onehot", st.onehot_slot(socks.slots, slot))
         d(self, "_orig", {})    # field -> value at first gather
         d(self, "_dirty", set())
 
@@ -165,11 +164,7 @@ class _Sock:
         # Only called for attributes not yet materialized.
         oh = self._onehot
         if name in self.FIELDS:
-            tab = getattr(self._socks, name)
-            if tab.dtype == jnp.bool_:
-                v = jnp.any(oh & tab, axis=1)
-            else:
-                v = jnp.sum(jnp.where(oh, tab, 0), axis=1, dtype=tab.dtype)
+            v = st.onehot_gather(getattr(self._socks, name), oh)
         elif name in self.RANGE_FIELDS:
             tab = getattr(self._socks, name)
             v = jnp.sum(jnp.where(oh[:, :, None], tab, 0), axis=1,

@@ -19,7 +19,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core import state as st
-from ..core.state import I32, I64, U32, UDP_RING
+from ..core.state import I32, I64, U32, UDP_RING, onehot_gather, onehot_slot
 
 
 def open_bind(socks: st.SocketTable, host: int, slot: int, port: int,
@@ -67,20 +67,12 @@ def lookup_socket(socks: st.SocketTable, mask, src, sport, dport):
 
 
 def _onehot_s(socks, slot):
-    """[H,S] one-hot for a per-host slot (indexed table access costs real
-    milliseconds inside a compiled loop; one-hot selects fuse for free --
-    tools/opbench2.py)."""
     safe = jnp.clip(slot, 0, socks.slots - 1)
-    return safe, safe[:, None] == jnp.arange(socks.slots, dtype=I32)[None, :]
+    return safe, onehot_slot(socks.slots, slot)
 
 
-def _gather_s(tab, oh):
-    return jnp.sum(jnp.where(oh, tab, 0), axis=1, dtype=tab.dtype)
-
-
-def _gather_sr(tab, oh_sr):
-    """Gather [H] from [H,S,R] under an [H,S,R] one-hot."""
-    return jnp.sum(jnp.where(oh_sr, tab, 0), axis=(1, 2), dtype=tab.dtype)
+_gather_s = onehot_gather
+_gather_sr = onehot_gather
 
 
 def push_ring(socks: st.SocketTable, host_mask, slot, src, sport, length,

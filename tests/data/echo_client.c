@@ -1,0 +1,64 @@
+/* A real TCP echo client run INSIDE the simulation (tests/test_substrate.py).
+ *
+ * Plain POSIX sockets + clock reads; when executed under the shadow1 shim
+ * every one of these calls is served by the simulator in virtual time.
+ * Exits 0 iff every echoed byte matches and the virtual clock advanced.
+ */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long now_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) return 2;
+  const char *ip = argv[1];
+  int port = atoi(argv[2]);
+  int rounds = atoi(argv[3]);
+
+  long long t0 = now_ns();
+
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 3;
+  struct sockaddr_in a = {0};
+  a.sin_family = AF_INET;
+  a.sin_port = htons(port);
+  if (inet_pton(AF_INET, ip, &a.sin_addr) != 1) return 4;
+  if (connect(fd, (struct sockaddr *)&a, sizeof a) != 0) return 5;
+
+  char msg[64], back[64];
+  for (int i = 0; i < rounds; i++) {
+    memset(msg, 'a' + (i % 26), sizeof msg);
+    snprintf(msg, sizeof msg, "round-%04d", i);
+    msg[10] = 'x'; /* fixed filler after the counter */
+    ssize_t off = 0;
+    while (off < (ssize_t)sizeof msg) {
+      ssize_t n = send(fd, msg + off, sizeof msg - off, 0);
+      if (n <= 0) return 6;
+      off += n;
+    }
+    off = 0;
+    while (off < (ssize_t)sizeof msg) {
+      ssize_t n = recv(fd, back + off, sizeof msg - off, 0);
+      if (n <= 0) return 7;
+      off += n;
+    }
+    if (memcmp(msg, back, sizeof msg) != 0) return 8;
+    if (i % 8 == 3) usleep(2000); /* mix sleeps into the pattern */
+  }
+
+  long long t1 = now_ns();
+  if (t1 <= t0) return 9; /* virtual clock must move */
+  printf("echo_client ok rounds=%d vtime_delta_ns=%lld\n", rounds, t1 - t0);
+  close(fd);
+  return 0;
+}
