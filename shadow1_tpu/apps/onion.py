@@ -1,0 +1,187 @@
+"""Onion-circuit traffic model: multi-hop store-and-forward TCP chains.
+
+The Tor-scale rung of the benchmark ladder (BASELINE.json configs 3/5)
+needs onion-routing *traffic shape* -- every circuit is a chain of TCP
+hops client -> guard -> middle -> exit -> server, with each relay
+store-and-forwarding the stream hop by hop -- without executing real Tor.
+This app models exactly that: clients push a stream of cells into their
+circuit, every relay forwards bytes from its inbound (accepted) socket to
+its outbound connection, and the destination server counts delivery.
+
+Modeled simplifications (documented divergences from real Tor):
+
+* Each circuit gets dedicated relay hosts (one forwarding lane per
+  relay) instead of multiplexing many circuits per relay -- the per-hop
+  transport work and traffic pattern are identical, the sharing is not.
+* One-way cell flow (client -> server); no directory/handshake traffic.
+* Cells are byte-stream quantities (512-byte cells arrive back to back,
+  so the byte counts and pacing match; cell framing is not modeled).
+
+Roles are positions in a circuit chain: hop 0 = client (originates
+`total_bytes`), hops 1..n-2 = relays (forward), hop n-1 = server (sink).
+"""
+
+from __future__ import annotations
+
+from flax import struct
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import simtime
+from ..core.state import (I32, I64, SOCK_TCP, TCPS_CLOSEWAIT,
+                          TCPS_ESTABLISHED, U32)
+from ..transport import tcp
+from ..transport.tcp import _sdiff
+
+INV = simtime.SIMTIME_INVALID
+
+ONION_PORT = 9001
+CLIENT_SLOT = 0     # outbound connection slot on clients and relays
+CELL = 512
+
+
+@struct.dataclass
+class OnionState:
+    role: jnp.ndarray        # [H] i32: 0 client, 1 relay, 2 server, -1 idle
+    next_hop: jnp.ndarray    # [H] i32 downstream host (-1 for servers/idle)
+    total: jnp.ndarray       # [H] i64 bytes the circuit's client pushes
+    start_t: jnp.ndarray     # [H] i64 client start time
+    started: jnp.ndarray     # [H] bool outbound connection opened
+    done_t: jnp.ndarray      # [H] i64 server-side completion time (INV)
+    forwarded: jnp.ndarray   # [H] i64 bytes this host moved downstream
+
+
+class Onion:
+    """Vectorized circuit interpreter (client send + relay forward)."""
+
+    uses_tcp = True
+    may_loopback = False
+
+    def __hash__(self):
+        return hash("onion")
+
+    def __eq__(self, other):
+        return isinstance(other, Onion)
+
+    def next_time(self, state):
+        # Clients AND relays wake at their start times (a relay that only
+        # woke on inbound traffic would open its outbound in the same tick
+        # the first SYN spawns a child -- and clobber it in CLIENT_SLOT).
+        a = state.app
+        return jnp.where((a.role >= 0) & (a.role <= 1) & ~a.started &
+                         (a.next_hop >= 0), a.start_t,
+                         jnp.asarray(INV, I64))
+
+    def on_tick(self, state, params, em, tick_t, active):
+        a = state.app
+        socks = state.socks
+        h = a.role.shape[0]
+        slot = jnp.full((h,), CLIENT_SLOT, I32)
+
+        # -- open outbound connections at start_t.  Relays start BEFORE
+        # any client can reach them (build staggers relay starts first):
+        # the outbound connection must occupy CLIENT_SLOT before an
+        # inbound SYN spawns a child there (children take the lowest free
+        # slot).
+        want = active & ~a.started & (a.next_hop >= 0) & \
+            (a.role <= 1) & (a.start_t <= tick_t)
+        lport = (20000 + jnp.arange(h, dtype=I32) % 20000)
+        socks = tcp.connect_v(socks, want, slot, a.next_hop, ONION_PORT,
+                              lport, tick_t)
+        a = a.replace(started=a.started | want)
+
+        # -- clients: stream total bytes into the outbound socket, then
+        # half-close (FIN cascades down the circuit).
+        is_cli = active & (a.role == 0) & a.started
+        target = (jnp.uint32(1) + a.total.astype(U32))
+        socks = tcp.write_v(socks, is_cli, slot, target, now=tick_t)
+        cs = CLIENT_SLOT
+        written_all = socks.snd_end[:, cs] == target
+        socks = tcp.close_v(socks, is_cli & written_all, slot)
+
+        # -- relays: forward inbound bytes to the outbound socket.
+        # Inbound legs are accepted children (parent >= 0); a relay serves
+        # one circuit, so the sum over child sockets is its one leg.
+        child = (socks.stype == SOCK_TCP) & (socks.parent >= 0)
+        # Readable DATA bytes: the FIN consumes a sequence number too
+        # (rcv_nxt passes it), but it must not be forwarded as payload.
+        data_end = jnp.where(
+            (socks.fin_seq != 0) &
+            (_sdiff(socks.fin_seq, socks.rcv_nxt) <= 0),
+            socks.fin_seq, socks.rcv_nxt)
+        avail2 = jnp.where(child, _sdiff(data_end, socks.rcv_read), 0)
+        avail2 = jnp.maximum(avail2, 0)
+        in_avail = jnp.sum(avail2, axis=1)
+        out_est = (socks.tcp_state[:, cs] == TCPS_ESTABLISHED) | \
+            (socks.tcp_state[:, cs] == TCPS_CLOSEWAIT)
+        out_used = _sdiff(socks.snd_end[:, cs], socks.snd_una[:, cs])
+        out_room = jnp.maximum(socks.snd_buf_cap[:, cs] - out_used, 0)
+        fwd = jnp.where(active & (a.role == 1) & a.started & out_est,
+                        jnp.minimum(in_avail, out_room), 0)
+        do_fwd = fwd > 0
+        socks = tcp.write_v(socks, do_fwd, slot,
+                            (socks.snd_end[:, cs] + fwd.astype(U32)),
+                            now=tick_t)
+        # Consume forwarded bytes from the inbound leg (single child, so a
+        # full-row masked drain up to `fwd` is exact).
+        take2 = jnp.where(child & do_fwd[:, None],
+                          jnp.minimum(avail2, fwd[:, None]), 0)
+        socks = socks.replace(
+            rcv_read=socks.rcv_read + take2.astype(jnp.uint32))
+        a = a.replace(forwarded=a.forwarded + fwd)
+
+        # -- servers: consume and count.
+        is_srv = (a.role == 2)
+        srv_take = jnp.where(is_srv[:, None] & child & active[:, None],
+                             avail2, 0)
+        socks = socks.replace(
+            rcv_read=socks.rcv_read + srv_take.astype(jnp.uint32))
+        got = a.forwarded + jnp.sum(srv_take, axis=1)
+        newly_done = active & is_srv & (a.done_t == INV) & \
+            (got >= a.total) & (a.total > 0)
+        a = a.replace(forwarded=got,
+                      done_t=jnp.where(newly_done, tick_t, a.done_t))
+
+        # -- teardown cascade: inbound leg closed & fully drained -> close
+        # our outbound leg too (relays), mirroring the echo server logic.
+        in_closed = jnp.any(child & (socks.tcp_state == TCPS_CLOSEWAIT),
+                            axis=1)
+        drained = in_avail <= 0
+        relay_done = active & (a.role == 1) & a.started & in_closed & drained
+        socks = tcp.close_v(socks, relay_done, slot)
+        closewait = child & (socks.tcp_state == TCPS_CLOSEWAIT) & \
+            (avail2 - take2 - srv_take <= 0) & active[:, None] & \
+            ~socks.app_closed
+        socks = socks.replace(app_closed=socks.app_closed | closewait)
+
+        return state.replace(app=a, socks=socks), em
+
+
+def build_circuits(num_circuits: int, hops: int = 3, seed: int = 1):
+    """Host layout: per circuit, 1 client + `hops` relays + 1 server
+    (dedicated hosts; see module docstring).  Returns role/next_hop arrays
+    of length num_circuits * (hops + 2)."""
+    per = hops + 2
+    h = num_circuits * per
+    role = np.full(h, -1, np.int32)
+    nxt = np.full(h, -1, np.int32)
+    for c in range(num_circuits):
+        base = c * per
+        for k in range(per):
+            role[base + k] = 0 if k == 0 else (2 if k == per - 1 else 1)
+            if k < per - 1:
+                nxt[base + k] = base + k + 1
+    return role, nxt
+
+
+def init_state(role, next_hop, total_bytes, start_t) -> OnionState:
+    h = len(role)
+    return OnionState(
+        role=jnp.asarray(role, I32),
+        next_hop=jnp.asarray(next_hop, I32),
+        total=jnp.asarray(total_bytes, I64),
+        start_t=jnp.asarray(start_t, I64),
+        started=jnp.zeros((h,), bool),
+        done_t=jnp.full((h,), INV, I64),
+        forwarded=jnp.zeros((h,), I64),
+    )

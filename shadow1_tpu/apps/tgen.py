@@ -20,6 +20,20 @@ This is the stepping stone to the real-code substrate: the interpreter
 consumes exactly the information a real tgen would put on the wire, so
 swapping in real process execution changes the driver, not the protocol
 stack underneath.
+
+Documented divergences from real tgen (violations raise at load, never
+silently truncate):
+
+* Single action chain: fan-out graphs (a node with several successors)
+  are rejected at parse time.
+* One shared peers list per graph (conflicting per-node lists rejected);
+  stream clients must declare peers or assembly fails.
+* The server learns each stream's recvsize from the client's app
+  registers instead of a stream header on the wire -- byte counts and
+  timing on the wire are the same, the header bytes themselves are not
+  modeled.
+* One in-flight stream per host at a time (CLIENT_SLOT), and one process
+  per host (config/assemble.py rejects multi-process hosts).
 """
 
 from __future__ import annotations
@@ -143,8 +157,15 @@ def parse_tgen(source: str) -> TgenGraph:
     for edge in graph.findall(_NS + "edge"):
         s = index[edge.get("source")]
         t = index[edge.get("target")]
-        if nxt[s] == -1:  # single-successor model: first edge wins
-            nxt[s] = t
+        if nxt[s] != -1:
+            # Real tgen supports fan-out graphs (parallel successors);
+            # this model interprets a single action chain.  Refusing is
+            # better than silently truncating the workload.
+            raise ValueError(
+                f"tgen action node {ids[s]!r} has multiple successors; "
+                f"the modeled interpreter supports single-chain graphs "
+                f"only (real-tgen fan-out is not modeled)")
+        nxt[s] = t
 
     sendsize = np.zeros(n, np.int64)
     recvsize = np.zeros(n, np.int64)
@@ -399,12 +420,28 @@ def build_state(num_hosts: int, graphs: list, host_graph, host_start_t,
         g_ph = [-1] * max_peer
         g_pp = [0] * max_peer
         g_pn = 0
+        seen_peers = None
         for i in range(n):
             if g.peers[i]:
+                if seen_peers is not None and g.peers[i] != seen_peers:
+                    raise ValueError(
+                        f"tgen graph defines conflicting peers lists "
+                        f"({seen_peers} vs {g.peers[i]}); the modeled "
+                        f"interpreter shares one peers list per graph")
+                seen_peers = g.peers[i]
                 for j, spec in enumerate(g.peers[i][:max_peer]):
                     hidx, port = resolve_peer(spec)
                     g_ph[j], g_pp[j] = hidx, port
                 g_pn = len(g.peers[i][:max_peer])
+        # A client graph with stream actions but no resolvable peers would
+        # hang at the stream node forever (init never fires); fail loudly
+        # at assembly instead.
+        has_stream = any(int(t) == NT_STREAM for t in g.ntype)
+        if has_stream and g.serverport <= 0 and g_pn == 0:
+            raise ValueError(
+                "tgen client graph has stream actions but no peers list; "
+                "add a 'peers' attribute (host:port, ...) to the start or "
+                "stream node")
         for i in range(n):
             ntype.append(int(g.ntype[i]))
             nxt.append(off + int(g.nxt[i]) if g.nxt[i] >= 0 else -1)

@@ -40,6 +40,10 @@ def _parser():
                    help="per-host socket-table slots (default: auto)")
     r.add_argument("--pool-slab", type=int, default=128,
                    help="packet-pool slots per host")
+    r.add_argument("--tcp-congestion-control", choices=("reno", "cubic"),
+                   default="reno",
+                   help="TCP congestion-control algorithm "
+                        "(reference --tcp-congestion-control)")
     r.add_argument("--interface-qdisc", choices=("fifo", "rr"),
                    default="fifo",
                    help="NIC socket-selection discipline "
@@ -59,6 +63,12 @@ def _parser():
                    help="capture ring capacity (older records overwritten)")
     r.add_argument("--heartbeat-frequency", type=int, default=1,
                    help="heartbeat interval in sim seconds (0 = off)")
+    r.add_argument("--log-level", choices=("off", "warning", "debug"),
+                   default="off",
+                   help="simulation event log level (reference --log-level); "
+                        "writes shadow.log to the data directory")
+    r.add_argument("--log-ring", type=int, default=1 << 16,
+                   help="event-log ring capacity")
     r.add_argument("--quiet", action="store_true")
     return p
 
@@ -72,7 +82,8 @@ def run_config(args) -> int:
                         pool_slab=args.pool_slab,
                         qdisc=args.interface_qdisc,
                         cpu_threshold_us=args.cpu_threshold,
-                        cpu_precision_us=args.cpu_precision)
+                        cpu_precision_us=args.cpu_precision,
+                        cong=args.tcp_congestion_control)
     stop = (args.stop_time * SEC) if args.stop_time else asm.stop_time
     if not args.quiet:
         print(f"[shadow1-tpu] {len(asm.hostnames)} hosts, "
@@ -81,19 +92,51 @@ def run_config(args) -> int:
               file=sys.stderr)
 
     tracker = None
-    if args.data_directory:
+    if args.data_directory and args.heartbeat_frequency > 0:
         from .observe import Tracker
         tracker = Tracker(args.data_directory, asm.hostnames,
-                          interval_s=max(1, args.heartbeat_frequency))
+                          interval_s=args.heartbeat_frequency,
+                          per_host_interval_s=asm.heartbeat_freq_s)
 
     state, params, app = asm.state, asm.params, asm.app
-    if args.pcap:
+    want_pcap = args.pcap or (asm.pcap_mask is not None
+                              and asm.pcap_mask.any())
+    if want_pcap:
         if not args.data_directory:
-            print("error: --pcap requires --data-directory (where "
-                  "capture.pcap is written)", file=sys.stderr)
+            print("error: packet capture requires --data-directory",
+                  file=sys.stderr)
             return 2
         from .core.state import make_capture_ring
         state = state.replace(cap=make_capture_ring(args.pcap_ring))
+        if args.pcap:
+            # An explicit global capture must not be filtered down by
+            # per-host logpcap masks.
+            import jax.numpy as jnp_m
+            params = params.replace(
+                pcap_mask=jnp_m.ones_like(params.pcap_mask))
+
+    # Leveled sim-time event log (reference ShadowLogger): enabled by
+    # --log-level or any per-host <host loglevel>.
+    _LVL = {None: 0, "off": 0, "error": 1, "critical": 1, "warning": 1,
+            "message": 1, "info": 2, "debug": 2, "trace": 2}
+    global_lvl = _LVL[args.log_level]
+    host_lvls = [max(_LVL.get((lv or "").lower() or None, 0), global_lvl)
+                 for lv in (asm.loglevels or [None] * len(asm.hostnames))]
+    drain = None
+    if any(host_lvls):
+        if not args.data_directory:
+            print("error: --log-level requires --data-directory",
+                  file=sys.stderr)
+            return 2
+        import jax.numpy as jnp_
+        from .core.state import make_log_ring
+        from .observe import LogDrain
+        state = state.replace(
+            log=make_log_ring(args.log_ring),
+            log_level=jnp_.asarray(host_lvls, jnp_.int32))
+        drain = LogDrain(
+            __import__("os").path.join(args.data_directory, "shadow.log"),
+            asm.hostnames)
     t = int(state.now)
     hb_next = 0
     while t < stop:
@@ -105,6 +148,8 @@ def run_config(args) -> int:
         if tracker is not None and t >= hb_next:
             tracker.heartbeat(state, t)
             hb_next = t + tracker.interval_ns
+        if drain is not None:
+            drain.drain(state)
     jax.block_until_ready(state)
     wall = time.perf_counter() - t_wall
 
@@ -126,13 +171,26 @@ def run_config(args) -> int:
         "drops_pool": int(jnp.sum(state.hosts.pkts_dropped_pool)),
         "err_flags": int(state.err),
     }
-    if args.pcap and args.data_directory:
+    if want_pcap and args.data_directory:
         import os as _os
         from .observe import write_pcap
-        n = write_pcap(_os.path.join(args.data_directory, "capture.pcap"),
-                       state.cap,
-                       ip_of_host=lambda i: asm.dns.address_of(i).ip)
-        summary["pcap_records"] = n
+        ip_of = lambda i: asm.dns.address_of(i).ip  # noqa: E731
+        if args.pcap:
+            n = write_pcap(
+                _os.path.join(args.data_directory, "capture.pcap"),
+                state.cap, ip_of_host=ip_of)
+            summary["pcap_records"] = n
+        # Per-host captures (reference <host logpcap pcapdir>).
+        if asm.pcap_mask is not None:
+            for hi in [i for i, m in enumerate(asm.pcap_mask) if m]:
+                d = (asm.pcap_dirs or {}).get(hi, args.data_directory)
+                _os.makedirs(d, exist_ok=True)
+                write_pcap(
+                    _os.path.join(d, f"{asm.hostnames[hi]}.pcap"),
+                    state.cap, ip_of_host=ip_of, host_filter=hi)
+    if drain is not None:
+        drain.drain(state)
+        drain.close()
     if tracker is not None:
         tracker.summary(summary, state)
     print(json.dumps(summary))

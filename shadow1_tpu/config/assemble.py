@@ -28,6 +28,7 @@ from ..core.params import (NetParams, QDISC_FIFO, QDISC_RR,
 from ..core.state import make_sim_state
 from ..routing import apsp, graphml
 from ..routing.dns import DNS
+from ..transport import cong as _cong
 from ..transport import tcp
 
 SEC = simtime.SIMTIME_ONE_SECOND
@@ -55,6 +56,10 @@ class Assembled:
     topology: graphml.Topology
     config: object           # ShadowConfig
     stop_time: int           # ns
+    pcap_mask: object = None        # [H] bool: <host logpcap="true">
+    pcap_dirs: dict = None          # host index -> pcapdir
+    heartbeat_freq_s: object = None  # [H] i64, 0 = default
+    loglevels: list = None          # per-host loglevel strings
 
 
 def _expand_hosts(cfg):
@@ -84,7 +89,7 @@ def _plugin_kind(cfg, plugin_id: str) -> str:
 def build(cfg, seed: int = 1, sock_slots: int | None = None,
           pool_slab: int = 128, qdisc: str = "fifo",
           cpu_threshold_us: int = -1,
-          cpu_precision_us: int = 200) -> Assembled:
+          cpu_precision_us: int = 200, cong: str = "reno") -> Assembled:
     """Assemble a parsed ShadowConfig into (state, params, app)."""
     names, specs = _expand_hosts(cfg)
     h = len(names)
@@ -102,6 +107,13 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
     bw_up = np.empty(h, np.int64)
     bw_dn = np.empty(h, np.int64)
     cpu_ns = np.zeros(h, np.int64)
+    snd_buf = np.zeros(h, np.int64)      # 0 = default + autotune
+    rcv_buf = np.zeros(h, np.int64)
+    iface_pkts = np.zeros(h, np.int32)   # 0 = unbounded
+    hb_freq = np.zeros(h, np.int64)      # 0 = tracker default
+    pcap_mask = np.zeros(h, bool)
+    pcap_dirs: dict = {}
+    loglevels: list = [None] * h
     for i, s in enumerate(specs):
         v = host_vertex[i]
         up = s.bandwidthup_KiBps or int(topo.bw_up_KiBps[v]) or _DEFAULT_BW_KIBPS
@@ -110,6 +122,21 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
         if s.cpufrequency:
             cpu_ns[i] = max(1, (_BASE_EVENT_NS * _BASE_CPU_KHZ)
                             // max(1, s.cpufrequency))
+        if s.socketsendbuffer:
+            snd_buf[i] = s.socketsendbuffer
+        if s.socketrecvbuffer:
+            rcv_buf[i] = s.socketrecvbuffer
+        if s.interfacebuffer:
+            # Reference interfacebuffer is bytes; the router backlog is
+            # packet-counted, so round up in MTUs.
+            from ..core.state import MTU
+            iface_pkts[i] = max(1, -(-s.interfacebuffer // MTU))
+        if s.heartbeatfrequency_s:
+            hb_freq[i] = s.heartbeatfrequency_s
+        pcap_mask[i] = s.logpcap
+        if s.logpcap and s.pcapdir:
+            pcap_dirs[i] = s.pcapdir
+        loglevels[i] = s.loglevel
 
     # --- routing matrices -------------------------------------------------
     # Small graphs resolve APSP + parameter packing on the local CPU
@@ -136,6 +163,11 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
                               if cpu_threshold_us >= 0 else -1),
             cpu_precision_ns=max(1, cpu_precision_us) * 1000,
             qdisc={"fifo": QDISC_FIFO, "rr": QDISC_RR}[qdisc],
+            autotune_snd=(snd_buf == 0),
+            autotune_rcv=(rcv_buf == 0),
+            iface_buf_pkts=iface_pkts,
+            pcap_mask=pcap_mask if pcap_mask.any() else None,
+            cong=_cong.validate(cong),
         )
 
     if topo.num_vertices <= 1024:
@@ -201,6 +233,17 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
         state = make_sim_state(h, sock_slots=sock_slots,
                                pool_capacity=h * slab)
         socks = state.socks
+        # Per-host socket-buffer defaults (reference <host
+        # socketsendbuffer/socketrecvbuffer> -> host.c:162-220); every
+        # socket the host creates starts from these.
+        if (snd_buf > 0).any():
+            socks = socks.replace(def_snd_buf=jnp.where(
+                jnp.asarray(snd_buf > 0), jnp.asarray(snd_buf, jnp.int32),
+                socks.def_snd_buf))
+        if (rcv_buf > 0).any():
+            socks = socks.replace(def_rcv_buf=jnp.where(
+                jnp.asarray(rcv_buf > 0), jnp.asarray(rcv_buf, jnp.int32),
+                socks.def_rcv_buf))
         for gi, g in enumerate(graphs):
             if g.serverport > 0:
                 mask = jnp.asarray(host_graph == gi)
@@ -216,7 +259,9 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
 
     return Assembled(state=state, params=params, app=app, hostnames=names,
                      dns=dns, topology=topo, config=cfg,
-                     stop_time=cfg.stoptime_s * SEC)
+                     stop_time=cfg.stoptime_s * SEC,
+                     pcap_mask=pcap_mask, pcap_dirs=pcap_dirs,
+                     heartbeat_freq_s=hb_freq, loglevels=loglevels)
 
 
 def load(path: str, **kw) -> Assembled:

@@ -14,7 +14,28 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import sys
 import xml.etree.ElementTree as ET
+
+# Silent acceptance is worse than rejection (reference consumes every
+# configuration.h attribute); anything outside these sets triggers a loud
+# warning so config typos cannot pass unnoticed.
+_SHADOW_ATTRS = {"stoptime", "bootstraptime", "environment", "preload"}
+_HOST_ATTRS = {"id", "quantity", "iphint", "citycodehint", "countrycodehint",
+               "geocodehint", "typehint", "bandwidthdown", "bandwidthup",
+               "interfacebuffer", "socketrecvbuffer", "socketsendbuffer",
+               "cpufrequency", "loglevel", "heartbeatfrequency", "logpcap",
+               "pcapdir", "heartbeatloglevel", "heartbeatloginfo"}
+_PROCESS_ATTRS = {"plugin", "starttime", "time", "stoptime", "arguments",
+                  "preload"}
+_PLUGIN_ATTRS = {"id", "path", "startsymbol"}
+
+
+def _warn_unknown(tag, el, known):
+    for a in el.keys():
+        if a not in known:
+            print(f"[shadow1-tpu] WARNING: unknown <{tag}> attribute "
+                  f"{a!r} ignored (known: {sorted(known)})", file=sys.stderr)
 
 
 @dataclasses.dataclass
@@ -97,6 +118,7 @@ def parse(path_or_xml: str) -> ShadowConfig:
     root = ET.fromstring(text)
     if root.tag != "shadow":
         raise ValueError(f"expected <shadow> root, got <{root.tag}>")
+    _warn_unknown("shadow", root, _SHADOW_ATTRS)
     stoptime = _int(root, "stoptime")
     if stoptime is None:
         raise ValueError("<shadow> requires stoptime")
@@ -113,14 +135,17 @@ def parse(path_or_xml: str) -> ShadowConfig:
             if el.text and el.text.strip():
                 topo_cdata = el.text.strip()
         elif el.tag == "plugin":
+            _warn_unknown("plugin", el, _PLUGIN_ATTRS)
             pid = el.get("id")
             plugins[pid] = PluginSpec(id=pid, path=el.get("path") or "",
                                       startsymbol=el.get("startsymbol"))
         elif el.tag == "host" or el.tag == "node":  # "node" = legacy alias
+            _warn_unknown(el.tag, el, _HOST_ATTRS)
             procs = []
             for pe in el:
                 if pe.tag not in ("process", "application"):
                     continue
+                _warn_unknown(pe.tag, pe, _PROCESS_ATTRS)
                 st = pe.get("starttime") or pe.get("time")
                 procs.append(ProcessSpec(
                     plugin=pe.get("plugin"),

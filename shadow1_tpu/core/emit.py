@@ -19,7 +19,7 @@ from __future__ import annotations
 from flax import struct
 import jax.numpy as jnp
 
-from .state import F32, I32, I64, U32
+from .state import F32, I32, I64, U32, SACK_BLOCKS
 
 # Emission slots, in deterministic within-tick order.
 SLOT_RX_REPLY = 0   # ACK/SYN-ACK/RST generated while processing an arrival
@@ -45,6 +45,8 @@ class Emissions:
     wnd: jnp.ndarray         # [H,E] i32
     length: jnp.ndarray      # [H,E] i32
     ts_echo: jnp.ndarray     # [H,E] i64
+    sack_lo: jnp.ndarray     # [H,E,SACK_BLOCKS] u32 advertised SACK ranges
+    sack_hi: jnp.ndarray     # [H,E,SACK_BLOCKS] u32
     payload_id: jnp.ndarray  # [H,E] i32
     priority: jnp.ndarray    # [H,E] f32
 
@@ -67,6 +69,8 @@ def empty(num_hosts: int, num_slots: int = NUM_SLOTS) -> Emissions:
         wnd=jnp.zeros(he, I32),
         length=jnp.zeros(he, I32),
         ts_echo=jnp.zeros(he, I64),
+        sack_lo=jnp.zeros(he + (SACK_BLOCKS,), U32),
+        sack_hi=jnp.zeros(he + (SACK_BLOCKS,), U32),
         payload_id=jnp.full(he, -1, I32),
         priority=jnp.zeros(he, F32),
     )
@@ -74,7 +78,8 @@ def empty(num_hosts: int, num_slots: int = NUM_SLOTS) -> Emissions:
 
 def put(em: Emissions, mask: jnp.ndarray, slot: int, *, dst, sport, dport,
         proto, flags=0, seq=0, ack=0, wnd=0, length=0, ts_echo=0,
-        payload_id=-1, priority=0.0) -> Emissions:
+        sack_lo=None, sack_hi=None, payload_id=-1,
+        priority=0.0) -> Emissions:
     """Vectorized emit: for hosts where `mask` is set, stage one packet in
     `slot`.  All field arguments are scalars or [H] arrays."""
 
@@ -85,6 +90,15 @@ def put(em: Emissions, mask: jnp.ndarray, slot: int, *, dst, sport, dport,
 
     def upd(cur, val, dtype):
         return cur.at[:, slot].set(jnp.where(mask, b(val, dtype), cur[:, slot]))
+
+    def upd3(cur, val):
+        if val is None:
+            return cur
+        v = jnp.asarray(val).astype(U32)
+        if v.ndim == 1:
+            v = jnp.broadcast_to(v[None, :], (h, SACK_BLOCKS))
+        new = jnp.where(mask[:, None], v, cur[:, slot, :])
+        return cur.at[:, slot, :].set(new)
 
     return Emissions(
         valid=em.valid.at[:, slot].set(jnp.where(mask, True, em.valid[:, slot])),
@@ -98,6 +112,8 @@ def put(em: Emissions, mask: jnp.ndarray, slot: int, *, dst, sport, dport,
         wnd=upd(em.wnd, wnd, I32),
         length=upd(em.length, length, I32),
         ts_echo=upd(em.ts_echo, ts_echo, I64),
+        sack_lo=upd3(em.sack_lo, sack_lo),
+        sack_hi=upd3(em.sack_hi, sack_hi),
         payload_id=upd(em.payload_id, payload_id, I32),
         priority=upd(em.priority, priority, F32),
     )

@@ -69,7 +69,10 @@ from .state import (ERR_POOL_OVERFLOW, I32, I64, U32, PROTO_TCP, PROTO_UDP,
                     ICOL_SRC, ICOL_SPORT, ICOL_DPORT, ICOL_PROTO, ICOL_FLAGS,
                     ICOL_SEQ, ICOL_ACK, ICOL_WND, ICOL_LEN, ICOL_PAYLOAD,
                     ICOL_TIME_LO, ICOL_TIME_HI, ICOL_CTR_LO, ICOL_CTR_HI,
-                    ICOL_TS_LO, ICOL_TS_HI, ICOL_TSE_LO, ICOL_TSE_HI, ICOLS,
+                    ICOL_TS_LO, ICOL_TS_HI, ICOL_TSE_LO, ICOL_TSE_HI,
+                    ICOL_SACK0_LO, ICOL_SACK0_HI, ICOL_SACK2_HI, ICOLS,
+                    LOG_WARNING, LOG_DEBUG, LOG_DROP_INET, LOG_DROP_ROUTER,
+                    LOG_DROP_TAIL, LOG_DROP_POOL, LOG_DELIVER, LOG_SEND,
                     enc_lo, enc_hi, dec_i64, pack_inbox_cols, SimState)
 
 INV = simtime.SIMTIME_INVALID
@@ -104,7 +107,7 @@ class RxPkt:
 
     __slots__ = ("src", "sport", "dport", "proto", "flags", "seq", "ack",
                  "wnd", "length", "payload_id", "time", "ts", "ts_echo",
-                 "pkt_id")
+                 "pkt_id", "sack_lo", "sack_hi")
 
     def __init__(self, row, keys_row, time_row):
         self.src = row[:, ICOL_SRC]
@@ -120,7 +123,38 @@ class RxPkt:
         self.time = time_row
         self.ts = dec_i64(row[:, ICOL_TS_LO], row[:, ICOL_TS_HI])
         self.ts_echo = dec_i64(row[:, ICOL_TSE_LO], row[:, ICOL_TSE_HI])
+        self.sack_lo = _bitcast_i32_u32(
+            row[:, ICOL_SACK0_LO:ICOL_SACK2_HI + 1:2])
+        self.sack_hi = _bitcast_i32_u32(
+            row[:, ICOL_SACK0_HI:ICOL_SACK2_HI + 2:2])
         self.pkt_id = keys_row
+
+
+def _log_append(state: SimState, mask, code: int, level: int, time_v,
+                host_v, arg_v):
+    """Append one event per set mask element into the log ring (traced
+    away entirely when logging is off).  `mask`/`time_v`/`host_v`/`arg_v`
+    are flat arrays of equal length; per-host level gating applies."""
+    if state.log is None:
+        return state
+    lg = state.log
+    c = lg.capacity
+    lvl_ok = state.log_level[jnp.clip(host_v, 0,
+                                      state.log_level.shape[0] - 1)] >= level
+    m = mask & lvl_ok
+    rank = jnp.cumsum(m) - 1
+    n_tot = jnp.sum(m).astype(I64)
+    n_new = jnp.minimum(n_tot, c)
+    pos = ((lg.total + rank) % c).astype(I32)
+    idx = jnp.where(m & (rank < c), pos, c)
+    return state.replace(log=lg.replace(
+        time=lg.time.at[idx].set(time_v, mode="drop"),
+        host=lg.host.at[idx].set(host_v.astype(I32), mode="drop"),
+        code=lg.code.at[idx].set(code, mode="drop"),
+        arg=lg.arg.at[idx].set(arg_v.astype(I32), mode="drop"),
+        total=lg.total + n_new,
+        lost=lg.lost + (n_tot - n_new),
+    ))
 
 
 # ---------------------------------------------------------------------------
@@ -204,10 +238,14 @@ def _outbox_pending(state: SimState):
 # ---------------------------------------------------------------------------
 
 
-def _superblock(n: int) -> int:
-    """Items per rank superblock: large enough that the [B, M, M] pairwise
-    rank is a handful of MB, small enough that B*H count cells stay small."""
-    return min(512, n)
+def _superblock(n: int, h: int) -> int:
+    """Items per rank superblock.  Memory: the pairwise rank cube is
+    n*M bytes and the per-block count table is (n/M)*h*4 bytes, so the
+    sweet spot is M ~ sqrt(4h); clamp to [64, 512] and keep both sides
+    bounded at 10k-host scale (n can exceed a million items)."""
+    m = int((4 * max(h, 1)) ** 0.5)
+    m = max(64, min(512, (m // 64) * 64 if m >= 64 else 64))
+    return min(m, max(64, n))
 
 
 def _exchange_body(state: SimState, params) -> SimState:
@@ -225,7 +263,7 @@ def _exchange_body(state: SimState, params) -> SimState:
     # because outbox slots free only at boundaries, so allocation indices
     # are monotone across the window's micro-steps -- this reproduces the
     # reference's (srcHostID, srcHostEventID) tiebreak (event.c:110-153).
-    m = _superblock(p0)
+    m = _superblock(p0, h)
     npad = -(-p0 // m) * m
     pad = npad - p0
     dstp = jnp.pad(dst, (0, pad))
@@ -263,7 +301,9 @@ def _exchange_body(state: SimState, params) -> SimState:
         flags=pool.flags, seq_i32=_bitcast_u32_i32(pool.seq),
         ack_i32=_bitcast_u32_i32(pool.ack), wnd=pool.wnd,
         length=pool.length, payload_id=pool.payload_id, time=pool.time,
-        ctr=pool.pkt_id & _MASK40, ts=pool.ts, ts_echo=pool.ts_echo)
+        ctr=pool.pkt_id & _MASK40, ts=pool.ts, ts_echo=pool.ts_echo,
+        sack_lo_i32=[_bitcast_u32_i32(pool.sack_lo[:, i]) for i in range(3)],
+        sack_hi_i32=[_bitcast_u32_i32(pool.sack_hi[:, i]) for i in range(3)])
     vals = jnp.stack([pad0(c.astype(I32)) for c in cols], axis=1)  # [npad, C]
 
     blk = ib.blk.at[islot].set(vals, mode="drop")
@@ -280,7 +320,13 @@ def _exchange_body(state: SimState, params) -> SimState:
         pkts_dropped_pool=hosts.pkts_dropped_pool + drops)
     err = state.err | jnp.where(jnp.any(drops > 0), ERR_POOL_OVERFLOW,
                                 0).astype(state.err.dtype)
-    return state.replace(pool=pool, inbox=ib, hosts=hosts, err=err)
+    state = state.replace(pool=pool, inbox=ib, hosts=hosts, err=err)
+    if state.log is not None:
+        rows = jnp.arange(h, dtype=I32)
+        now_v = jnp.broadcast_to(state.now, (h,))
+        state = _log_append(state, drops > 0, LOG_DROP_POOL, LOG_WARNING,
+                            now_v, rows, drops)
+    return state
 
 
 def _exchange(state: SimState, params) -> SimState:
@@ -327,9 +373,38 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app):
     # CoDel can compute sojourn).
     due = (st2 == STAGE_IN_FLIGHT) & (t2 <= tick_t[:, None]) & \
         active[:, None]
+
+    # Interface receive buffer (reference <host interfacebuffer>): a
+    # bounded router backlog tail-drops the latest arrivals beyond
+    # capacity before CoDel sees them.  Rank dues within the row by
+    # (time, id) so the drop order is deterministic.  The ranking is an
+    # [H, slab, slab] comparison cube, so it only exists in the compiled
+    # step when some host actually configures a buffer bound (STATIC
+    # params.has_iface_buf; the default unbounded case traces it away).
+    k2 = ib.order_keys().reshape(h, ki)
+    if params.has_iface_buf:
+        cap = params.iface_buf_pkts
+        bounded = cap > 0
+        later = due[:, None, :] & (
+            (t2[:, None, :] < t2[:, :, None]) |
+            ((t2[:, None, :] == t2[:, :, None]) &
+             (k2[:, None, :] < k2[:, :, None])))
+        due_rank = jnp.sum(later & due[:, :, None], axis=2, dtype=I32)
+        room = jnp.maximum(cap - hosts.rx_queued, 0)
+        tail_drop = due & bounded[:, None] & (due_rank >= room[:, None])
+        due = due & ~tail_drop
+    else:
+        tail_drop = jnp.zeros_like(due)
+
     st2 = jnp.where(due, STAGE_RX_QUEUED, st2)
+    st2 = jnp.where(tail_drop, STAGE_FREE, st2)
     status = jnp.where(due.reshape(-1),
                        ib.status | PDS_ROUTER_ENQUEUED, ib.status)
+    status = jnp.where(tail_drop.reshape(-1),
+                       status | PDS_ROUTER_DROPPED, status)
+    hosts = hosts.replace(
+        pkts_dropped_router=hosts.pkts_dropped_router +
+        jnp.sum(tail_drop, axis=1))
     rx_q = hosts.rx_queued + jnp.sum(due, axis=1, dtype=I32)
 
     # Head selection: earliest (time, pkt_id) among the queued backlog --
@@ -338,7 +413,6 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app):
     qm = st2 == STAGE_RX_QUEUED
     tq = jnp.where(qm, t2, jnp.asarray(INV, I64))
     tmin = jnp.min(tq, axis=1)
-    k2 = ib.order_keys().reshape(h, ki)
     at_t = qm & (tq == tmin[:, None])
     kq = jnp.where(at_t, k2, jnp.asarray(INV, I64))
     kmin = jnp.min(kq, axis=1)
@@ -399,6 +473,19 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app):
     state = state.replace(
         inbox=ib.replace(stage=st2.reshape(-1), status=status),
         hosts=hosts)
+
+    # Event log (traced away when disabled).
+    if state.log is not None:
+        rows = jnp.arange(h, dtype=I32)
+        rows2 = jnp.broadcast_to(rows[:, None], (h, ki)).reshape(-1)
+        src_col = state.inbox.blk[:, ICOL_SRC]
+        t_flat = jnp.broadcast_to(tick_t[:, None], (h, ki)).reshape(-1)
+        state = _log_append(state, tail_drop.reshape(-1), LOG_DROP_TAIL,
+                            LOG_WARNING, t_flat, rows2, src_col)
+        state = _log_append(state, drop, LOG_DROP_ROUTER, LOG_WARNING,
+                            tick_t, rows, pkt.src)
+        state = _log_append(state, deliver, LOG_DELIVER, LOG_DEBUG,
+                            tick_t, rows, pkt.src)
 
     # Transport delivery.
     udp_mask = deliver & (pkt.proto == PROTO_UDP)
@@ -559,6 +646,14 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     def mg(cur, val2):
         return _merge_rows(cur, val2, oh, hit, (h, ko))
 
+    def mg3(cur, val3):
+        # [H,E,B] emission blocks -> [P0,B] pool blocks.
+        b = cur.shape[1]
+        v = jnp.sum(jnp.where(oh[:, :, :, None], val3[:, :, None, :], 0),
+                    axis=1, dtype=cur.dtype)          # [H,Ko,B]
+        cur2 = cur.reshape(h, ko, b)
+        return jnp.where(hit[:, :, None], v, cur2).reshape(-1, b)
+
     pool = pool.replace(
         stage=mg(pool.stage, stage_v),
         src=mg(pool.src, src2),
@@ -576,6 +671,8 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
         pkt_id=mg(pool.pkt_id, pkt_id2),
         ts=mg(pool.ts, send_t),
         ts_echo=mg(pool.ts_echo, em.ts_echo),
+        sack_lo=mg3(pool.sack_lo, em.sack_lo),
+        sack_hi=mg3(pool.sack_hi, em.sack_hi),
         payload_id=mg(pool.payload_id, em.payload_id),
         priority=mg(pool.priority, em.priority),
         status=mg(pool.status, status_v),
@@ -607,12 +704,27 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
                                 0).astype(jnp.int32)
     state = state.replace(hosts=hosts, err=err)
 
+    # Event log (traced away when disabled).
+    if state.log is not None:
+        hostf = src2.reshape(-1)
+        timef = send_t.reshape(-1)
+        dstf = em.dst.reshape(-1)
+        state = _log_append(state, dropped.reshape(-1), LOG_DROP_INET,
+                            LOG_WARNING, timef, hostf, dstf)
+        state = _log_append(state, (live & ~all_placed).reshape(-1),
+                            LOG_DROP_POOL, LOG_WARNING, timef, hostf, dstf)
+        state = _log_append(state, all_placed.reshape(-1), LOG_SEND,
+                            LOG_DEBUG, timef, hostf, dstf)
+
     # Packet capture (PCAP analog; only traced when a CaptureRing is
     # installed): record every placed emission at send time.
     if state.cap is not None:
         cap = state.cap
         c = cap.capacity
-        placedf = all_placed.reshape(-1)
+        rec = all_placed & (params.pcap_mask[:, None] |
+                            params.pcap_mask[jnp.clip(
+                                em.dst, 0, h - 1)])
+        placedf = rec.reshape(-1)
         crank = jnp.cumsum(placedf) - 1
         n_new = jnp.sum(placedf).astype(I64)
         pos = ((cap.total + crank) % c).astype(I32)
@@ -668,7 +780,11 @@ def _loopback_insert(state: SimState, em, lb, src2, ctr2, send_t):
         flags=em.flags, seq_i32=_bitcast_u32_i32(em.seq),
         ack_i32=_bitcast_u32_i32(em.ack), wnd=em.wnd, length=em.length,
         payload_id=em.payload_id, time=arr, ctr=ctr2, ts=send_t,
-        ts_echo=em.ts_echo)
+        ts_echo=em.ts_echo,
+        sack_lo_i32=[_bitcast_u32_i32(em.sack_lo[:, :, i])
+                     for i in range(3)],
+        sack_hi_i32=[_bitcast_u32_i32(em.sack_hi[:, :, i])
+                     for i in range(3)])
     vals = jnp.stack([c.astype(I32).reshape(-1) for c in cols], axis=1)
 
     pds = PDS_SND_CREATED | PDS_SND_INTERFACE_SENT | PDS_INET_SENT

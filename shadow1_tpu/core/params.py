@@ -61,6 +61,28 @@ class NetParams:
     # network_interface.c:466-540): QDISC_FIFO serves the lowest eligible
     # socket slot (creation order); QDISC_RR round-robins across them.
     qdisc: jnp.ndarray             # i32 scalar QDISC_*
+    # Per-host TCP buffer autotuning switches: explicitly configured
+    # socket buffers disable the corresponding autotune, mirroring the
+    # reference (tcp.c autotune only when not user-set).
+    autotune_snd: jnp.ndarray      # [H] bool
+    autotune_rcv: jnp.ndarray      # [H] bool
+    # Interface receive buffer in packets (reference <host
+    # interfacebuffer> bytes / MTU; network_interface.c receive-side
+    # bound): arrivals beyond this router backlog are tail-dropped
+    # before CoDel even sees them.  0 = unbounded.
+    iface_buf_pkts: jnp.ndarray    # [H] i32
+    # Per-host capture gate (reference <host logpcap>): a packet is
+    # recorded when its source OR destination host is marked.  Only
+    # consulted when a CaptureRing is installed.
+    pcap_mask: jnp.ndarray         # [H] bool
+    # Congestion-control algorithm (reference --tcp-congestion-control,
+    # tcp_cong.h hook table): STATIC -- part of the compiled step's
+    # identity, so the untaken algorithm traces away.
+    cong: str = struct.field(pytree_node=False, default="reno")
+    # STATIC: any host has a bounded interface buffer.  The tail-drop
+    # ranking costs an [H, slab, slab] comparison cube per micro-step, so
+    # it must trace away entirely for the (default) unbounded case.
+    has_iface_buf: bool = struct.field(pytree_node=False, default=False)
 
     @property
     def n_vertices(self) -> int:
@@ -128,6 +150,11 @@ def make_net_params(
                                  # negative = CPU never blocks
     cpu_precision_ns: int = 200 * simtime.SIMTIME_ONE_MICROSECOND,
     qdisc: int = QDISC_FIFO,
+    autotune_snd=None,
+    autotune_rcv=None,
+    iface_buf_pkts=None,
+    pcap_mask=None,
+    cong: str = "reno",
 ) -> NetParams:
     from . import rng
 
@@ -161,6 +188,14 @@ def make_net_params(
     h = jnp.asarray(host_vertex).shape[0]
     if cpu_ns_per_event is None:
         cpu_ns_per_event = jnp.zeros((h,), I64)
+    if autotune_snd is None:
+        autotune_snd = jnp.ones((h,), bool)
+    if autotune_rcv is None:
+        autotune_rcv = jnp.ones((h,), bool)
+    if iface_buf_pkts is None:
+        iface_buf_pkts = jnp.zeros((h,), I32)
+    if pcap_mask is None:
+        pcap_mask = jnp.ones((h,), bool)
     from .state import enc_lo, enc_hi
     rel_m = jnp.asarray(reliability, F32)
     route_blk = jnp.stack([
@@ -183,4 +218,10 @@ def make_net_params(
         cpu_threshold_ns=jnp.asarray(cpu_threshold_ns, I64),
         cpu_precision_ns=jnp.asarray(cpu_precision_ns, I64),
         qdisc=jnp.asarray(qdisc, I32),
+        autotune_snd=jnp.asarray(autotune_snd, bool),
+        autotune_rcv=jnp.asarray(autotune_rcv, bool),
+        iface_buf_pkts=jnp.asarray(iface_buf_pkts, I32),
+        pcap_mask=jnp.asarray(pcap_mask, bool),
+        cong=cong,
+        has_iface_buf=bool(jnp.any(jnp.asarray(iface_buf_pkts, I32) > 0)),
     )

@@ -154,6 +154,8 @@ class PacketPool:
     pkt_id: jnp.ndarray       # [P] i64 (src << 40) | per-src counter
     ts: jnp.ndarray           # [P] i64 TCP timestamp (send time)
     ts_echo: jnp.ndarray      # [P] i64 TCP timestamp echo
+    sack_lo: jnp.ndarray      # [P, SACK_BLOCKS] u32 advertised SACK ranges
+    sack_hi: jnp.ndarray      # [P, SACK_BLOCKS] u32 (lo == hi == 0: empty)
     payload_id: jnp.ndarray   # [P] i32 host-side arena ref, -1 = modeled
     priority: jnp.ndarray     # [P] f32 qdisc priority (reference packet.c priority)
     status: jnp.ndarray       # [P] i32 PDS_* trail
@@ -181,6 +183,8 @@ def make_packet_pool(capacity: int) -> PacketPool:
         pkt_id=_zeros((capacity,), I64),
         ts=_zeros((capacity,), I64),
         ts_echo=_zeros((capacity,), I64),
+        sack_lo=_zeros((capacity, SACK_BLOCKS), U32),
+        sack_hi=_zeros((capacity, SACK_BLOCKS), U32),
         payload_id=_full((capacity,), I32, -1),
         priority=_zeros((capacity,), F32),
         status=_zeros((capacity,), I32),
@@ -199,8 +203,15 @@ def make_packet_pool(capacity: int) -> PacketPool:
 (ICOL_SRC, ICOL_SPORT, ICOL_DPORT, ICOL_PROTO, ICOL_FLAGS, ICOL_SEQ,
  ICOL_ACK, ICOL_WND, ICOL_LEN, ICOL_PAYLOAD,
  ICOL_TIME_LO, ICOL_TIME_HI, ICOL_CTR_LO, ICOL_CTR_HI,
- ICOL_TS_LO, ICOL_TS_HI, ICOL_TSE_LO, ICOL_TSE_HI) = range(18)
-ICOLS = 18
+ ICOL_TS_LO, ICOL_TS_HI, ICOL_TSE_LO, ICOL_TSE_HI,
+ ICOL_SACK0_LO, ICOL_SACK0_HI, ICOL_SACK1_LO, ICOL_SACK1_HI,
+ ICOL_SACK2_LO, ICOL_SACK2_HI) = range(24)
+ICOLS = 24
+
+# SACK blocks carried per segment (reference packet TCP header
+# selectiveACKs list, packet.c; RFC 2018 allows 3-4 -- 3 fit the
+# timestamped header).
+SACK_BLOCKS = 3
 
 _LO_MASK = (1 << 31) - 1
 
@@ -220,7 +231,8 @@ def dec_i64(lo, hi):
 
 
 def pack_inbox_cols(*, src, sport, dport, proto, flags, seq_i32, ack_i32,
-                    wnd, length, payload_id, time, ctr, ts, ts_echo):
+                    wnd, length, payload_id, time, ctr, ts, ts_echo,
+                    sack_lo_i32, sack_hi_i32):
     """The ONE encode site for the packed inbox block: returns the list of
     ICOLS i32 column arrays in ICOL_* order (callers stack them).  Both
     the boundary exchange and the loopback insert must agree with
@@ -244,6 +256,12 @@ def pack_inbox_cols(*, src, sport, dport, proto, flags, seq_i32, ack_i32,
     cols[ICOL_TS_HI] = enc_hi(ts)
     cols[ICOL_TSE_LO] = enc_lo(ts_echo)
     cols[ICOL_TSE_HI] = enc_hi(ts_echo)
+    cols[ICOL_SACK0_LO] = sack_lo_i32[0]
+    cols[ICOL_SACK0_HI] = sack_hi_i32[0]
+    cols[ICOL_SACK1_LO] = sack_lo_i32[1]
+    cols[ICOL_SACK1_HI] = sack_hi_i32[1]
+    cols[ICOL_SACK2_LO] = sack_lo_i32[2]
+    cols[ICOL_SACK2_HI] = sack_hi_i32[2]
     return cols
 
 
@@ -316,6 +334,8 @@ def make_inbox(num_hosts: int, slab: int) -> Inbox:
 # ---------------------------------------------------------------------------
 
 SACK_RANGES = 8  # out-of-order reassembly: byte ranges held past rcv_nxt
+SSACK_RANGES = 4  # sender-side sacked-range scoreboard (smaller: holes
+                  # refill quickly and every range costs compiled-graph ops)
 UDP_RING = 8     # per-UDP-socket datagram ring entries
 
 
@@ -382,6 +402,16 @@ class SocketTable:
     # --- receive-buffer autotuning (reference tcp.c:535-561) ---
     at_bytes: jnp.ndarray     # [H,S] i64 bytes delivered since last adjust
     at_last: jnp.ndarray      # [H,S] i64 time of last adjustment
+    # --- congestion-control algorithm state (transport/cong.py): CUBIC
+    # epoch start + W_max; untouched under Reno ---
+    cub_epoch: jnp.ndarray    # [H,S] i64 congestion-avoidance epoch start
+    cub_wmax: jnp.ndarray     # [H,S] i32 window before the last reduction
+    # --- sender-side SACK scoreboard (reference tcp_retransmit_tally.cc
+    # marked-lost/sacked range arithmetic): byte ranges the peer has
+    # selectively acknowledged; retransmission skips them ---
+    ssack_lo: jnp.ndarray     # [H,S,SSACK_RANGES] u32
+    ssack_hi: jnp.ndarray     # [H,S,SSACK_RANGES] u32
+    retx_segs: jnp.ndarray    # [H,S] i32 segments retransmitted (telemetry)
 
     # --- UDP datagram ring ---
     udp_head: jnp.ndarray     # [H,S] i32
@@ -395,6 +425,13 @@ class SocketTable:
     error: jnp.ndarray        # [H,S] i32 pending socket error (errno-like)
     bytes_sent: jnp.ndarray   # [H,S] i64
     bytes_recv: jnp.ndarray   # [H,S] i64
+
+    # --- per-host socket defaults (reference <host socketsendbuffer
+    # socketrecvbuffer>, configuration.h:24-101 -> host.c:162-220): new
+    # sockets initialize their buffer caps from these, so a config
+    # override applies to every socket the host ever creates.
+    def_snd_buf: jnp.ndarray  # [H] i32
+    def_rcv_buf: jnp.ndarray  # [H] i32
 
     @property
     def num_hosts(self) -> int:
@@ -447,6 +484,11 @@ def make_socket_table(num_hosts: int, slots: int) -> SocketTable:
         delack_pending=_zeros(hs, I32),
         at_bytes=_zeros(hs, I64),
         at_last=_zeros(hs, I64),
+        cub_epoch=_zeros(hs, I64),
+        cub_wmax=_zeros(hs, I32),
+        ssack_lo=_zeros(hs + (SSACK_RANGES,), U32),
+        ssack_hi=_zeros(hs + (SSACK_RANGES,), U32),
+        retx_segs=_zeros(hs, I32),
         udp_head=_zeros(hs, I32),
         udp_count=_zeros(hs, I32),
         udp_src=_full(hs + (UDP_RING,), I32, -1),
@@ -456,6 +498,10 @@ def make_socket_table(num_hosts: int, slots: int) -> SocketTable:
         error=_zeros(hs, I32),
         bytes_sent=_zeros(hs, I64),
         bytes_recv=_zeros(hs, I64),
+        # Defaults match the reference's CONFIG_SEND/RECV_BUFFER_SIZE
+        # (definitions.h:101-164); overridden per host by assembly.
+        def_snd_buf=_full((num_hosts,), I32, 131072),
+        def_rcv_buf=_full((num_hosts,), I32, 174760),
     )
 
 
@@ -590,6 +636,57 @@ def make_capture_ring(capacity: int = 1 << 16) -> CaptureRing:
 
 
 # ---------------------------------------------------------------------------
+# Event log ring (leveled, sim-time-stamped; ShadowLogger analog)
+# ---------------------------------------------------------------------------
+
+# Log levels (reference support/logger/log_level.c): per-host gating.
+LOG_OFF = 0
+LOG_WARNING = 1   # drops, resets
+LOG_DEBUG = 2     # + deliveries and sends
+
+# Event codes drained into "[simtime] [host] message" lines (observe.py).
+LOG_DROP_INET = 1      # reliability drop on the wire
+LOG_DROP_ROUTER = 2    # CoDel drop at the destination router
+LOG_DROP_TAIL = 3      # interface-buffer tail drop
+LOG_DROP_POOL = 4      # slab-capacity drop (capacity escape hatch)
+LOG_DELIVER = 5        # packet delivered to a socket
+LOG_SEND = 6           # packet placed on the wire
+
+
+@struct.dataclass
+class LogRing:
+    """Bounded device-side event ring, drained and sim-time-sorted by the
+    host between chunks -- the two-tier design of the reference's
+    ShadowLogger (per-thread queues + helper-thread merge,
+    core/logger/shadow_logger.c:25-58) with the device as the "threads"
+    and the drain as the merge.  Present in SimState only when logging is
+    enabled, so disabled runs trace with zero cost."""
+
+    time: jnp.ndarray    # [C] i64
+    host: jnp.ndarray    # [C] i32
+    code: jnp.ndarray    # [C] i32 LOG_*
+    arg: jnp.ndarray     # [C] i32 event argument (peer, count, bytes)
+    total: jnp.ndarray   # i64 lifetime appends (records actually written)
+    lost: jnp.ndarray    # i64 records dropped because one append exceeded
+                         # the ring capacity (reported by the drain)
+
+    @property
+    def capacity(self) -> int:
+        return self.time.shape[0]
+
+
+def make_log_ring(capacity: int = 1 << 16) -> LogRing:
+    return LogRing(
+        time=_zeros((capacity,), I64),
+        host=_zeros((capacity,), I32),
+        code=_zeros((capacity,), I32),
+        arg=_zeros((capacity,), I32),
+        total=jnp.asarray(0, I64),
+        lost=jnp.asarray(0, I64),
+    )
+
+
+# ---------------------------------------------------------------------------
 # Whole-simulation state
 # ---------------------------------------------------------------------------
 
@@ -612,6 +709,9 @@ class SimState:
     app: any = struct.field(pytree_node=True, default=None)  # application-model state
     err: jnp.ndarray = struct.field(default=None)  # i32 scalar ERR_* bitmask
     cap: any = struct.field(pytree_node=True, default=None)  # CaptureRing | None
+    log: any = struct.field(pytree_node=True, default=None)  # LogRing | None
+    # Per-host log level mask (LOG_*), only consulted when log is set.
+    log_level: any = struct.field(pytree_node=True, default=None)  # [H] i32
     # Telemetry (reference scheduler built-in timers, scheduler.c:266-268):
     n_steps: jnp.ndarray = struct.field(default=None)    # i64 micro-steps
     n_windows: jnp.ndarray = struct.field(default=None)  # i64 windows run

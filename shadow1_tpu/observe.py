@@ -59,17 +59,24 @@ class Tracker:
               "pkts_sent,pkts_recv,drops_inet,drops_router,"
               "tx_queued,rx_queued\n")
 
-    def __init__(self, data_dir: str, hostnames, interval_s: int = 1):
+    def __init__(self, data_dir: str, hostnames, interval_s: int = 1,
+                 per_host_interval_s=None):
         self.dir = data_dir
         self.hostnames = list(hostnames)
         self.interval_ns = interval_s * SEC
+        h = len(self.hostnames)
+        # Per-host heartbeat frequency (reference <host
+        # heartbeatfrequency>); 0 = the global default interval.
+        per = np.zeros(h, np.int64) if per_host_interval_s is None \
+            else np.asarray(per_host_interval_s, np.int64)
+        self.per_host_ns = np.where(per > 0, per * SEC, self.interval_ns)
+        self._next_row = np.zeros(h, np.int64)
         os.makedirs(data_dir, exist_ok=True)
         self.path = os.path.join(data_dir, "heartbeat.csv")
         with open(self.path, "w") as f:
             f.write(self.HEADER)
-        self._last = {f: np.zeros(len(self.hostnames), np.int64)
-                      for f in _FIELDS}
-        self._last_t = 0
+        self._last = {f: np.zeros(h, np.int64) for f in _FIELDS}
+        self._last_t = 0  # _last rows advance per written heartbeat row
 
     def heartbeat(self, state, now_ns: int):
         # ONE device buffer, ONE transfer: per-buffer fetches each cost a
@@ -82,6 +89,9 @@ class Tracker:
         dt_s = max((now_ns - self._last_t) / SEC, 1e-9)
         with open(self.path, "a") as f:
             for i, name in enumerate(self.hostnames):
+                if now_ns < self._next_row[i]:
+                    continue
+                self._next_row[i] = now_ns + self.per_host_ns[i]
                 d = {k: int(cur[k][i] - self._last[k][i]) for k in _FIELDS}
                 f.write(f"{now_ns / SEC:.3f},{name},"
                         f"{d['bytes_sent'] / dt_s:.1f},"
@@ -89,7 +99,11 @@ class Tracker:
                         f"{d['pkts_sent']},{d['pkts_recv']},"
                         f"{d['pkts_dropped_inet']},{d['pkts_dropped_router']},"
                         f"{int(txq[i])},{int(rxq[i])}\n")
-        self._last = cur
+                # Baseline advances ONLY for written rows, so skipped
+                # hosts' deltas accumulate into their next row instead of
+                # vanishing.
+                for k in _FIELDS:
+                    self._last[k][i] = cur[k][i]
         self._last_t = now_ns
 
     def summary(self, summary: dict, state):
@@ -99,7 +113,7 @@ class Tracker:
             json.dump(summary, f, indent=2)
 
 
-def write_pcap(path: str, cap, ip_of_host=None):
+def write_pcap(path: str, cap, ip_of_host=None, host_filter=None):
     """Write a CaptureRing to a classic pcap file (LINKTYPE_RAW IPv4).
 
     The ring stores packet *metadata*; each record is synthesized as an
@@ -110,6 +124,8 @@ def write_pcap(path: str, cap, ip_of_host=None):
 
     ip_of_host: optional callable host_index -> 32-bit IP (e.g. from the
     DNS registry); defaults to 10.x.y.z derived from the index.
+    host_filter: optional host index -- keep only records whose source or
+    destination is that host (reference per-host logpcap capture).
     """
     import struct as pystruct
 
@@ -127,6 +143,9 @@ def write_pcap(path: str, cap, ip_of_host=None):
 
     src = np.asarray(cap.src)
     dst = np.asarray(cap.dst)
+    if host_filter is not None:
+        keep = (src[order] == host_filter) | (dst[order] == host_filter)
+        order = order[keep]
     sport = np.asarray(cap.sport)
     dport = np.asarray(cap.dport)
     proto = np.asarray(cap.proto)
@@ -160,7 +179,75 @@ def write_pcap(path: str, cap, ip_of_host=None):
                                   (ts_ns % 1_000_000_000) // 1000,
                                   len(rec), tot_len))
             f.write(rec)
-    return n
+    return len(order)
+
+
+_LOG_MSG = {
+    1: "packet to host {arg} dropped on the wire (reliability)",
+    2: "router dropped packet from host {arg} (CoDel)",
+    3: "router tail-dropped packet from host {arg} (interface buffer)",
+    4: "packet-pool capacity drop ({arg})",
+    5: "delivered packet from host {arg}",
+    6: "sent packet to host {arg}",
+}
+
+
+class LogDrain:
+    """Drains the device LogRing into sim-time-ordered text lines:
+
+        [  1.234567890] [hostname] message
+
+    The two-tier ShadowLogger analog (core/logger/shadow_logger.c:25-58):
+    the device ring buffers records, the host merges and writes them
+    between chunks.  Overflow (more records than ring capacity between
+    drains) is reported, not silently lost."""
+
+    def __init__(self, path, hostnames):
+        self.path = path
+        self.hostnames = list(hostnames)
+        self._last_total = 0
+        self._lost_reported = 0
+        self._f = open(path, "w")
+
+    def drain(self, state):
+        import jax
+        lg = state.log
+        if lg is None:
+            return 0
+        total = int(jax.device_get(lg.total))
+        lost = int(jax.device_get(lg.lost))
+        if lost > self._lost_reported:
+            self._f.write(f"[log] WARNING: {lost - self._lost_reported} "
+                          f"records lost inside oversized appends\n")
+            self._lost_reported = lost
+        if total == self._last_total:
+            return 0
+        t, host, code, arg = jax.device_get(
+            (lg.time, lg.host, lg.code, lg.arg))
+        c = t.shape[0]
+        new = total - self._last_total
+        if new <= 0:
+            return 0
+        if new > c:
+            self._f.write(f"[log] WARNING: {new - c} records lost "
+                          f"(ring capacity {c})\n")
+            start = total - c
+        else:
+            start = self._last_total
+        idx = np.arange(start, total) % c
+        order = np.argsort(t[idx], kind="stable")
+        for k in idx[order]:
+            name = self.hostnames[host[k]] if host[k] < len(self.hostnames) \
+                else str(host[k])
+            msg = _LOG_MSG.get(int(code[k]), f"event {code[k]}")
+            self._f.write(f"[{t[k] / SEC:13.9f}] [{name}] "
+                          + msg.format(arg=int(arg[k])) + "\n")
+        self._f.flush()
+        self._last_total = total
+        return new
+
+    def close(self):
+        self._f.close()
 
 
 def census(state) -> dict:

@@ -128,3 +128,58 @@ def build_bulk(num_hosts: int,
 def run(state, params, app, until=None):
     t = params.stop_time if until is None else until
     return engine.run_until(state, params, app, t)
+
+
+def build_onion(num_circuits: int,
+                hops: int = 3,
+                bytes_per_circuit: int = 1 << 20,
+                latency_ns: int = 20 * simtime.SIMTIME_ONE_MILLISECOND,
+                stop_time: int = 120 * simtime.SIMTIME_ONE_SECOND,
+                seed: int = 1,
+                sock_slots: int = 8,
+                pool_slab: int = 128,
+                bw_Bps: int = 1 << 27):
+    """Tor-like onion-circuit world (apps/onion.py): `num_circuits` chains
+    of client -> hops relays -> server, each circuit streaming
+    `bytes_per_circuit` through every hop.  The 1k-host ladder rung is
+    build_onion(200) = 200 circuits x 5 hosts."""
+    from .apps import onion as onion_app
+    from .transport import tcp as tcp_mod
+    import numpy as np
+
+    role, nxt = onion_app.build_circuits(num_circuits, hops, seed)
+    num_hosts = len(role)
+    v = min(num_hosts, 256)
+
+    def _build():
+        lat, rel = uniform_full_mesh(v, latency_ns)
+        params = make_net_params(
+            latency_ns=lat, reliability=rel,
+            host_vertex=jnp.arange(num_hosts) % v,
+            bw_up_Bps=jnp.full(num_hosts, bw_Bps),
+            bw_down_Bps=jnp.full(num_hosts, bw_Bps),
+            seed=seed, stop_time=stop_time)
+        state = make_sim_state(num_hosts, sock_slots=sock_slots,
+                               pool_capacity=num_hosts * pool_slab)
+        # Relays and servers listen; circuit legs arrive as children.
+        listeners = jnp.asarray((role == 1) | (role == 2))
+        state = state.replace(socks=tcp_mod.listen_v(
+            state.socks, listeners, 1, onion_app.ONION_PORT, backlog=4))
+        total = np.zeros(num_hosts, np.int64)
+        total[role == 0] = bytes_per_circuit
+        total[role == 2] = bytes_per_circuit   # server-side expectation
+        start = np.zeros(num_hosts, np.int64)
+        # Relays dial their next hop first (staggered microseconds), then
+        # clients start milliseconds later -- guarantees CLIENT_SLOT is
+        # occupied on every relay before any inbound SYN can spawn a
+        # child there.
+        start[role == 1] = simtime.SIMTIME_ONE_MICROSECOND * (
+            1 + (np.arange((role == 1).sum()) % 499))
+        start[role == 0] = simtime.SIMTIME_ONE_MILLISECOND * (
+            50 + (np.arange((role == 0).sum()) % 997))
+        state = state.replace(app=onion_app.init_state(role, nxt, total,
+                                                       start))
+        return state, params
+
+    state, params = _pkg.build_on_host(_build)
+    return state, params, onion_app.Onion()

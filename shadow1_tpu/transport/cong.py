@@ -1,0 +1,151 @@
+"""Pluggable congestion control: the reference's hook vtable, vectorized.
+
+The reference exposes a per-connection hook table {duplicate_ack_ev,
+fast_recovery, new_ack_ev, timeout_ev, ssthresh}
+(/root/reference/src/main/host/descriptor/tcp_cong.h:11-33) with Reno as
+the stock implementation (tcp_cong_reno.c:13-60) and a CLI selector
+(--tcp-congestion-control, options.c).  Here an algorithm is a set of
+masked-update hooks applied to the [H]-gathered socket registers; the
+choice is a STATIC parameter (NetParams.cong, hashed into the compiled
+step), so the untaken algorithm traces away entirely.
+
+Implemented: "reno" (NewReno, RFC 6582 -- the default, identical to the
+previous inline logic) and "cubic" (RFC 8312-style window growth with
+fast convergence; concave/convex cubic increase replaces Reno's linear
+congestion avoidance).
+
+Hook contract: every hook takes the socket view `sv` (transport.tcp._Sock)
+plus masks/registers and mutates `sv` under those masks.  All hooks are
+branchless; per-socket algorithm state lives in dedicated SocketTable
+fields (cub_epoch/cub_wmax) that non-CUBIC runs simply never touch.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.state import I32, I64, TCP_MSS
+
+ALGORITHMS = ("reno", "cubic")
+
+# CUBIC constants (RFC 8312): C = 0.4, beta = 0.7.
+_CUBIC_C = 0.4
+_CUBIC_BETA = 0.7
+
+
+def validate(name: str) -> str:
+    if name not in ALGORITHMS:
+        raise ValueError(f"unknown congestion control {name!r} "
+                         f"(available: {ALGORITHMS})")
+    return name
+
+
+# ---------------------------------------------------------------------------
+# Reno (NewReno): slow start / AIMD congestion avoidance
+# ---------------------------------------------------------------------------
+
+
+def _reno_new_ack(sv, normal, acked_bytes, tick_t):
+    ss = normal & (sv.cwnd < sv.ssthresh)
+    sv.setwhere(ss, cwnd=jnp.minimum(sv.cwnd + acked_bytes, sv.ssthresh))
+    ca = normal & ~ss
+    sv.setwhere(ca, cwnd=sv.cwnd + jnp.maximum(
+        (TCP_MSS * TCP_MSS) // jnp.maximum(sv.cwnd, 1), 1))
+
+
+def _reno_enter_recovery(sv, fr, flight, tick_t):
+    sv.setwhere(fr,
+                ssthresh=jnp.maximum(flight // 2, 2 * TCP_MSS),
+                cwnd=jnp.maximum(flight // 2, 2 * TCP_MSS) + 3 * TCP_MSS)
+
+
+def _reno_timeout(sv, est_rto, flight, tick_t):
+    sv.setwhere(est_rto,
+                ssthresh=jnp.maximum(flight // 2, 2 * TCP_MSS),
+                cwnd=TCP_MSS)
+
+
+# ---------------------------------------------------------------------------
+# CUBIC (RFC 8312)
+# ---------------------------------------------------------------------------
+
+
+def _cubic_target(sv, tick_t):
+    """W_cubic(t + RTT) in bytes: C*(t-K)^3 + Wmax, computed in f32
+    segments (deterministic elementwise math; exactness is not required
+    for congestion control, only reproducibility)."""
+    t_s = jnp.maximum(tick_t - sv.cub_epoch, 0).astype(jnp.float32) / 1e9
+    rtt_s = jnp.maximum(sv.srtt, 1).astype(jnp.float32) / 1e9
+    wmax_seg = sv.cub_wmax.astype(jnp.float32) / TCP_MSS
+    # K = cbrt(Wmax * (1-beta) / C)
+    k = jnp.cbrt(jnp.maximum(wmax_seg * (1.0 - _CUBIC_BETA) / _CUBIC_C, 0.0))
+    dt = t_s + rtt_s - k
+    w = _CUBIC_C * dt * dt * dt + wmax_seg
+    return (jnp.maximum(w, 2.0) * TCP_MSS).astype(I32)
+
+
+def _cubic_new_ack(sv, normal, acked_bytes, tick_t):
+    # Slow start below ssthresh, cubic growth above.
+    ss = normal & (sv.cwnd < sv.ssthresh)
+    sv.setwhere(ss, cwnd=jnp.minimum(sv.cwnd + acked_bytes, sv.ssthresh))
+    ca = normal & ~ss
+    # Fresh epoch starts when entering congestion avoidance with no epoch.
+    fresh = ca & (sv.cub_epoch == 0)
+    sv.setwhere(fresh, cub_epoch=tick_t,
+                cub_wmax=jnp.maximum(sv.cub_wmax, sv.cwnd))
+    target = _cubic_target(sv, tick_t)
+    # Approach the cubic target by at most 50% of cwnd per RTT worth of
+    # ACKs: per-ACK step = (target - cwnd) / (cwnd/acked) ~ scaled diff.
+    step = jnp.clip(((target - sv.cwnd).astype(I64) * acked_bytes
+                     // jnp.maximum(sv.cwnd, TCP_MSS)).astype(I32),
+                    0, jnp.maximum(acked_bytes, TCP_MSS))
+    # TCP-friendly floor: at least Reno's linear growth.
+    reno_step = jnp.maximum((TCP_MSS * TCP_MSS) //
+                            jnp.maximum(sv.cwnd, 1), 1)
+    sv.setwhere(ca, cwnd=sv.cwnd + jnp.maximum(step, reno_step))
+
+
+def _cubic_enter_recovery(sv, fr, flight, tick_t):
+    # Fast convergence: if this Wmax is below the previous one, shrink it
+    # further so released bandwidth is found quickly.
+    new_wmax = jnp.where(
+        sv.cwnd < sv.cub_wmax,
+        (sv.cwnd.astype(jnp.float32) *
+         ((1.0 + _CUBIC_BETA) / 2.0)).astype(I32),
+        sv.cwnd)
+    reduced = jnp.maximum(
+        (sv.cwnd.astype(jnp.float32) * _CUBIC_BETA).astype(I32),
+        2 * TCP_MSS)
+    sv.setwhere(fr, cub_wmax=new_wmax, ssthresh=reduced,
+                cwnd=reduced + 3 * TCP_MSS, cub_epoch=0)
+
+
+def _cubic_timeout(sv, est_rto, flight, tick_t):
+    sv.setwhere(est_rto,
+                ssthresh=jnp.maximum(
+                    (sv.cwnd.astype(jnp.float32) * _CUBIC_BETA).astype(I32),
+                    2 * TCP_MSS),
+                cwnd=TCP_MSS, cub_epoch=0,
+                cub_wmax=jnp.maximum(sv.cub_wmax, sv.cwnd))
+
+
+# ---------------------------------------------------------------------------
+# Dispatch (static selection -- the untaken algorithm never traces)
+# ---------------------------------------------------------------------------
+
+_HOOKS = {
+    "reno": (_reno_new_ack, _reno_enter_recovery, _reno_timeout),
+    "cubic": (_cubic_new_ack, _cubic_enter_recovery, _cubic_timeout),
+}
+
+
+def new_ack(alg: str, sv, normal, acked_bytes, tick_t):
+    _HOOKS[alg][0](sv, normal, acked_bytes, tick_t)
+
+
+def enter_recovery(alg: str, sv, fr, flight, tick_t):
+    _HOOKS[alg][1](sv, fr, flight, tick_t)
+
+
+def timeout(alg: str, sv, est_rto, flight, tick_t):
+    _HOOKS[alg][2](sv, est_rto, flight, tick_t)

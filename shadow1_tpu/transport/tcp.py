@@ -45,6 +45,7 @@ import jax.numpy as jnp
 
 from ..core import emit, simtime
 from ..core.params import QDISC_RR
+from . import cong
 from ..core import state as st
 from ..core.state import (ERR_SOCKET_OVERFLOW,
                           I32, I64, U32, SACK_RANGES, SOCK_FREE, SOCK_TCP,
@@ -100,6 +101,10 @@ def _seq_min(a, b):
     return jnp.where(_seq_lt(a, b), a, b)
 
 
+def _seq_max(a, b):
+    return jnp.where(_seq_lt(a, b), b, a)
+
+
 def _in_state(tcp_state, states):
     m = tcp_state == states[0]
     for s in states[1:]:
@@ -143,11 +148,11 @@ class _Sock:
         "rcv_nxt", "rcv_read", "rcv_buf_cap", "fin_seq",
         "ts_recent", "srtt", "rttvar", "rto",
         "t_rto", "t_delack", "t_tw", "t_persist", "delack_pending",
-        "at_bytes", "at_last",
+        "at_bytes", "at_last", "cub_epoch", "cub_wmax", "retx_segs",
         "error", "bytes_sent", "bytes_recv",
     ]
 
-    RANGE_FIELDS = ["sack_lo", "sack_hi"]
+    RANGE_FIELDS = ["sack_lo", "sack_hi", "ssack_lo", "ssack_hi"]
 
     def __init__(self, socks: st.SocketTable, slot):
         d = object.__setattr__
@@ -207,13 +212,13 @@ _DEFAULTS = dict(
     stype=SOCK_FREE, tcp_state=TCPS_CLOSED, local_port=0, peer_host=-1,
     peer_port=0, parent=-1, accepted=False, child_order=0, backlog=0,
     snd_una=0, snd_nxt=0, snd_end=1, snd_wnd=TCP_MSS,
-    snd_buf_cap=SND_BUF_DEFAULT, cwnd=INIT_CWND, ssthresh=SSTHRESH_INIT,
+    cwnd=INIT_CWND, ssthresh=SSTHRESH_INIT,
     dup_acks=0, recover=0, in_recovery=False, retrans_nxt=1, retrans_end=1,
     app_closed=False,
-    rcv_nxt=0, rcv_read=0, rcv_buf_cap=RCV_BUF_DEFAULT, fin_seq=0,
+    rcv_nxt=0, rcv_read=0, fin_seq=0,
     ts_recent=0, srtt=0, rttvar=0, rto=RTO_INIT,
     t_rto=INV, t_delack=INV, t_tw=INV, t_persist=INV, delack_pending=0,
-    at_bytes=0, at_last=0,
+    at_bytes=0, at_last=0, cub_epoch=0, cub_wmax=0, retx_segs=0,
     error=0, bytes_sent=0, bytes_recv=0,
 )
 
@@ -223,8 +228,10 @@ def _apply_defaults(sv: _Sock, mask):
     vectorized analog of tcp_new (reference tcp.c).  Runs inside the
     caller's _Sock round so the reset + specific setup cost one
     gather/scatter pass, not two.  UDP ring fields stay; they are ignored
-    for TCP sockets."""
-    sv.setwhere(mask, **_DEFAULTS)
+    for TCP sockets.  Buffer capacities come from the per-host defaults
+    (reference <host socketsendbuffer/socketrecvbuffer>)."""
+    sv.setwhere(mask, snd_buf_cap=sv._socks.def_snd_buf,
+                rcv_buf_cap=sv._socks.def_rcv_buf, **_DEFAULTS)
     for f in _Sock.RANGE_FIELDS:
         cur = getattr(sv, f)
         setattr(sv, f, jnp.where(mask[:, None], jnp.zeros_like(cur), cur))
@@ -331,18 +338,32 @@ def recv_window(sv: _Sock):
 
 
 def _ranges_insert(lo, hi, mask, s, e, base):
-    """Insert [s, e) into each host's range set where `mask`; merge
-    overlapping/adjacent ranges and keep them sorted by distance from
-    `base` (= rcv_nxt).  lo/hi: [H, R] u32; s/e/base: [H] u32.
+    """Insert [s, e) into each host's range set where `mask` (see
+    _ranges_insert_many)."""
+    return _ranges_insert_many(lo, hi, [mask], [s], [e], base)
 
-    If the insert would create more than R disjoint ranges, the range
-    farthest from `base` is dropped (sender retransmits it later)."""
+
+def _ranges_insert_many(lo, hi, masks, ss, es, base):
+    """Insert up to k ranges [ss[i], es[i]) per host (masked) into each
+    host's range set in ONE sort+merge pass; merge overlapping/adjacent
+    ranges and keep them sorted by distance from `base` (= rcv_nxt /
+    snd_una).  lo/hi: [H, R] u32; each ss[i]/es[i]/base: [H] u32.
+
+    One pass for k ranges costs barely more than for one -- the SACK
+    paths insert SACK_BLOCKS ranges per segment, and tripling the
+    sort+merge op chain was the difference between a fast and an
+    unusably slow compiled step.
+
+    If the insert would create more than R disjoint ranges, the ranges
+    farthest from `base` are dropped (the sender retransmits them)."""
     h, r = lo.shape
     big = jnp.int64(1) << 40
-    s = jnp.where(mask, s, 0).astype(U32)
-    e = jnp.where(mask, e, 0).astype(U32)
-    lo1 = jnp.concatenate([lo, s[:, None]], axis=1)
-    hi1 = jnp.concatenate([hi, e[:, None]], axis=1)
+    new_lo = [jnp.where(m, s_, 0).astype(U32)[:, None]
+              for m, s_ in zip(masks, ss)]
+    new_hi = [jnp.where(m, e_, 0).astype(U32)[:, None]
+              for m, e_ in zip(masks, es)]
+    lo1 = jnp.concatenate([lo] + new_lo, axis=1)
+    hi1 = jnp.concatenate([hi] + new_hi, axis=1)
     valid = lo1 != hi1
     key = jnp.where(valid, _sdiff(lo1, base[:, None]).astype(jnp.int64), big)
     order = jnp.argsort(key, axis=1)
@@ -364,7 +385,7 @@ def _ranges_insert(lo, hi, mask, s, e, base):
                 jnp.where(onehot, cur_hi[:, None], out_hi),
                 ptr + jnp.where(do, 1, 0))
 
-    for i in range(r + 1):
+    for i in range(r + len(masks)):
         li, hii, vi = lo1[:, i], hi1[:, i], valid[:, i]
         merge = vi & cur_valid & _seq_leq(li, cur_hi)
         start = vi & ~merge
@@ -554,8 +575,31 @@ def process_arrivals(state, params, em, tick_t, pkt, mask):
     # the doubling is computed in i64 to keep 2*cwnd from wrapping negative.
     snd_tgt = jnp.minimum(2 * sv.cwnd.astype(I64),
                           SND_BUF_MAX).astype(I32)
-    grow_snd = new_ack & (sv.snd_buf_cap < snd_tgt)
+    grow_snd = new_ack & (sv.snd_buf_cap < snd_tgt) & params.autotune_snd
     sv.setwhere(grow_snd, snd_buf_cap=jnp.maximum(snd_tgt, sv.snd_buf_cap))
+
+    # --- sender-side SACK (reference selectiveACKs -> remora tally,
+    # tcp.c:192-205, tcp_retransmit_tally.cc:177-285): fold the advertised
+    # blocks into the sender scoreboard; retransmission skips them.
+    sv.ssack_lo, sv.ssack_hi = _ranges_insert_many(
+        sv.ssack_lo, sv.ssack_hi,
+        [ackp & (pkt.sack_lo[:, i] != pkt.sack_hi[:, i])
+         for i in range(st.SACK_BLOCKS)],
+        [pkt.sack_lo[:, i] for i in range(st.SACK_BLOCKS)],
+        [pkt.sack_hi[:, i] for i in range(st.SACK_BLOCKS)],
+        sv.snd_una)
+    # Ranges at/below the cumulative ACK are dead.
+    dead = _seq_leq(sv.ssack_hi, p_ack[:, None]) & \
+        (sv.ssack_lo != sv.ssack_hi) & ackp[:, None]
+    sv.ssack_lo = jnp.where(dead, 0, sv.ssack_lo)
+    sv.ssack_hi = jnp.where(dead, 0, sv.ssack_hi)
+    # Highest sacked offset above (new) snd_una: fast retransmit covers
+    # every hole below it in one RTT instead of one per RTT.
+    hs_off = jnp.zeros_like(sv.snd_una, dtype=I32)
+    for _i in range(st.SSACK_RANGES):
+        ne = sv.ssack_lo[:, _i] != sv.ssack_hi[:, _i]
+        hs_off = jnp.maximum(
+            hs_off, jnp.where(ne, _sdiff(sv.ssack_hi[:, _i], p_ack), 0))
 
     # RTT sample (Karn via timestamp echo: only segments we stamped).
     _rtt_update(sv, new_ack & (p_tse > 0), tick_t - p_tse)
@@ -566,17 +610,18 @@ def process_arrivals(state, params, em, tick_t, pkt, mask):
     partial = new_ack & sv.in_recovery & ~exit_rec
     normal = new_ack & ~sv.in_recovery
 
-    ss = normal & (sv.cwnd < sv.ssthresh)
-    sv.setwhere(ss, cwnd=jnp.minimum(sv.cwnd + acked_bytes, sv.ssthresh))
-    ca = normal & ~ss
-    sv.setwhere(ca, cwnd=sv.cwnd + jnp.maximum(
-        (TCP_MSS * TCP_MSS) // jnp.maximum(sv.cwnd, 1), 1))
+    # Window growth is the pluggable congestion-control hook (reference
+    # tcp_cong.h new_ack_ev; transport/cong.py).
+    cong.new_ack(params.cong, sv, normal, acked_bytes, tick_t)
     sv.setwhere(exit_rec, cwnd=sv.ssthresh, in_recovery=False, dup_acks=0)
-    # Partial ACK: retransmit exactly the next hole (one segment, RFC 6582),
-    # deflate cwnd.
+    # Partial ACK: retransmit the next hole; with SACK information the
+    # retransmission window extends to the highest sacked byte so every
+    # hole below it fills this RTT (RFC 6675 behavior).
     sv.setwhere(partial,
                 retrans_nxt=p_ack,
-                retrans_end=(p_ack + jnp.uint32(TCP_MSS)),
+                retrans_end=_seq_max(
+                    (p_ack + jnp.uint32(TCP_MSS)),
+                    (p_ack + jnp.maximum(hs_off, 0).astype(U32))),
                 cwnd=jnp.maximum(sv.cwnd - acked_bytes + TCP_MSS, TCP_MSS))
     sv.setwhere(normal, dup_acks=0)
     sv.setwhere(new_ack, snd_una=p_ack,
@@ -594,12 +639,12 @@ def process_arrivals(state, params, em, tick_t, pkt, mask):
     # Fast retransmit resends ONE segment at the hole (snd_una); go-back-N
     # is reserved for RTO.
     fr = dup & (sv.dup_acks == 3) & ~sv.in_recovery
+    cong.enter_recovery(params.cong, sv, fr, flight, tick_t)
     sv.setwhere(fr,
-                ssthresh=jnp.maximum(flight // 2, 2 * TCP_MSS),
-                cwnd=jnp.maximum(flight // 2, 2 * TCP_MSS) + 3 * TCP_MSS,
                 in_recovery=True, recover=sv.snd_nxt,
                 retrans_nxt=sv.snd_una,
-                retrans_end=(sv.snd_una + jnp.uint32(TCP_MSS)))
+                retrans_end=(sv.snd_una + jnp.maximum(
+                    hs_off, TCP_MSS).astype(U32)))
     inflate = dup & sv.in_recovery & (sv.dup_acks > 3)
     sv.setwhere(inflate, cwnd=sv.cwnd + TCP_MSS)
 
@@ -645,7 +690,8 @@ def process_arrivals(state, params, em, tick_t, pkt, mask):
     sv.setwhere(in_adv, at_bytes=sv.at_bytes + adv + drained,
                 at_last=jnp.where(sv.at_last == 0, tick_t, sv.at_last))
     rtt_w = jnp.maximum(sv.srtt, simtime.SIMTIME_ONE_MILLISECOND)
-    adjust = in_adv & (sv.at_last > 0) & (tick_t - sv.at_last > rtt_w)
+    adjust = in_adv & (sv.at_last > 0) & (tick_t - sv.at_last > rtt_w) & \
+        params.autotune_rcv
     space = jnp.minimum(2 * sv.at_bytes, RCV_BUF_MAX).astype(I32)
     sv.setwhere(adjust, rcv_buf_cap=jnp.maximum(sv.rcv_buf_cap, space),
                 at_bytes=0, at_last=tick_t)
@@ -708,6 +754,10 @@ def process_arrivals(state, params, em, tick_t, pkt, mask):
         ack=jnp.where(orphan, (p_seq + p_len.astype(U32) + jnp.uint32(1)),
                       sv2.rcv_nxt),
         wnd=recv_window(sv2), ts_echo=jnp.where(reply, sv2.ts_recent, 0),
+        sack_lo=jnp.where(reply[:, None], sv2.sack_lo[:, :st.SACK_BLOCKS],
+                          0),
+        sack_hi=jnp.where(reply[:, None], sv2.sack_hi[:, :st.SACK_BLOCKS],
+                          0),
     )
     err = state.err | jnp.where(slot_overflow, ERR_SOCKET_OVERFLOW,
                                 0).astype(state.err.dtype)
@@ -765,12 +815,16 @@ def run_timers(state, params, em, tick_t, active):
     has_out = _sdiff(sv.snd_nxt, sv.snd_una) > 0
     est_rto = rto_f & est_like & has_out
     flight = _sdiff(sv.snd_nxt, sv.snd_una)
+    cong.timeout(params.cong, sv, est_rto, flight, tick_t)
     sv.setwhere(est_rto,
-                ssthresh=jnp.maximum(flight // 2, 2 * TCP_MSS),
-                cwnd=TCP_MSS, retrans_nxt=sv.snd_una,
+                retrans_nxt=sv.snd_una,
                 retrans_end=sv.snd_nxt,  # full go-back-N window
                 in_recovery=False, dup_acks=0,
                 rto=jnp.minimum(sv.rto * 2, RTO_MAX))
+    # Everything is presumed lost on RTO: forget the SACK scoreboard
+    # (reference clears the tally; RFC 6582 go-back-N).
+    sv.ssack_lo = jnp.where(est_rto[:, None], 0, sv.ssack_lo)
+    sv.ssack_hi = jnp.where(est_rto[:, None], 0, sv.ssack_hi)
     sv.setwhere(est_rto, t_rto=tick_t + sv.rto)
     # Stale RTO with nothing outstanding: disarm.
     sv.setwhere(rto_f & ~syn_first & ~syn_re & ~synack_re & ~est_rto & ~timed_out,
@@ -817,6 +871,10 @@ def run_timers(state, params, em, tick_t, active):
         ack=jnp.where(syn_emit & ~synack_re, jnp.uint32(0), sv2.rcv_nxt),
         wnd=recv_window(sv2),
         ts_echo=jnp.where(send_ack, sv2.ts_recent, 0),
+        sack_lo=jnp.where(send_ack[:, None],
+                          sv2.sack_lo[:, :st.SACK_BLOCKS], 0),
+        sack_hi=jnp.where(send_ack[:, None],
+                          sv2.sack_hi[:, :st.SACK_BLOCKS], 0),
     )
     return state.replace(socks=socks), em
 
@@ -902,6 +960,20 @@ def transmit(state, params, em, tick_t, active):
         retx_k, can_new_k, fin_ready_k = _eligibility(
             sv.tcp_state, sv.snd_una, sv.snd_nxt, sv.snd_end, sv.snd_wnd,
             sv.cwnd, sv.retrans_nxt, sv.retrans_end, sv.app_closed)
+        # SACK-aware retransmission: hop the cursor over every sacked
+        # range it sits in (ranges sorted by distance from snd_una, so
+        # one ascending pass suffices) -- selective repeat instead of
+        # resending bytes the peer already holds.
+        seq_sk = sv.retrans_nxt
+        for _r in range(st.SSACK_RANGES):
+            lo_r, hi_r = sv.ssack_lo[:, _r], sv.ssack_hi[:, _r]
+            inr = retx_k & (lo_r != hi_r) & _seq_leq(lo_r, seq_sk) & \
+                _seq_lt(seq_sk, hi_r)
+            seq_sk = jnp.where(inr, hi_r, seq_sk)
+        moved = have & retx_k & (seq_sk != sv.retrans_nxt)
+        sv.setwhere(moved, retrans_nxt=seq_sk)
+        retx_bound_k = _seq_min(sv.retrans_end, sv.snd_nxt)
+        retx_k = retx_k & _seq_lt(seq_sk, retx_bound_k)
         do_retx = have & retx_k
         do_new = have & ~do_retx & can_new_k
         do_fin_only = have & ~do_retx & ~do_new & fin_ready_k
@@ -933,7 +1005,8 @@ def transmit(state, params, em, tick_t, active):
             wnd=recv_window(sv), length=seg_len, ts_echo=sv.ts_recent)
 
         # Cursor updates.
-        sv.setwhere(do_retx, retrans_nxt=sv.retrans_nxt + consumed)
+        sv.setwhere(do_retx, retrans_nxt=sv.retrans_nxt + consumed,
+                    retx_segs=sv.retx_segs + 1)
         adv_new = (do_new | do_fin_only)
         sv.setwhere(adv_new, snd_nxt=seq + consumed)
         sv.setwhere(adv_new, bytes_sent=sv.bytes_sent + seg_len)

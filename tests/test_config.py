@@ -115,3 +115,132 @@ class TestEndToEnd:
                                outs[1].hosts.pkts_sent)
         assert jnp.array_equal(outs[0].socks.bytes_recv,
                                outs[1].socks.bytes_recv)
+
+
+class TestConfigAttributes:
+    """Every parsed <host> attribute is applied or loudly rejected
+    (reference configuration.h:24-101 -> host.c:162-220)."""
+
+    def _mini(self, host_attrs=""):
+        return f"""
+<shadow stoptime="10">
+  <topology><![CDATA[
+    <graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+      <key id="d0" for="edge" attr.name="latency" attr.type="double"/>
+      <graph edgedefault="directed">
+        <node id="v0"/>
+        <edge source="v0" target="v0"><data key="d0">10.0</data></edge>
+      </graph>
+    </graphml>]]></topology>
+  <plugin id="tgen" path="tgen"/>
+  <host id="server" {host_attrs}>
+    <process plugin="tgen" starttime="1" arguments="srv.graphml"/>
+  </host>
+  <host id="client">
+    <process plugin="tgen" starttime="2" arguments="cli.graphml"/>
+  </host>
+</shadow>"""
+
+    def _files(self, tmp_path):
+        (tmp_path / "srv.graphml").write_text("""
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key id="k0" for="node" attr.name="serverport" attr.type="string"/>
+  <graph edgedefault="directed">
+    <node id="start"><data key="k0">8888</data></node>
+  </graph>
+</graphml>""")
+        (tmp_path / "cli.graphml").write_text("""
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key id="k1" for="node" attr.name="peers" attr.type="string"/>
+  <key id="k2" for="node" attr.name="sendsize" attr.type="string"/>
+  <key id="k3" for="node" attr.name="recvsize" attr.type="string"/>
+  <graph edgedefault="directed">
+    <node id="start"><data key="k1">server:8888</data></node>
+    <node id="stream"><data key="k2">1 kib</data>
+      <data key="k3">4 kib</data></node>
+    <node id="end"/>
+    <edge source="start" target="stream"/>
+    <edge source="stream" target="end"/>
+  </graph>
+</graphml>""")
+
+    def _load(self, tmp_path, attrs):
+        from shadow1_tpu.config import assemble, shadowxml
+        self._files(tmp_path)
+        cfg = shadowxml.parse(self._mini(attrs))
+        cfg.base_dir = str(tmp_path)
+        return assemble.build(cfg)
+
+    def test_socket_buffers_applied(self, tmp_path):
+        asm = self._load(tmp_path,
+                         'socketrecvbuffer="8192" socketsendbuffer="4096"')
+        socks = asm.state.socks
+        assert int(socks.def_rcv_buf[0]) == 8192
+        assert int(socks.def_snd_buf[0]) == 4096
+        assert int(socks.def_rcv_buf[1]) == 174760  # untouched default
+        # autotuning disabled exactly where buffers are pinned
+        assert not bool(asm.params.autotune_rcv[0])
+        assert bool(asm.params.autotune_rcv[1])
+        # The listener created at assembly already uses the pinned cap,
+        # so every accepted child advertises a window bounded by it.
+        assert int(socks.rcv_buf_cap[0, 0]) == 8192
+
+    def test_interfacebuffer_applied(self, tmp_path):
+        asm = self._load(tmp_path, 'interfacebuffer="3000"')
+        assert int(asm.params.iface_buf_pkts[0]) == 2  # ceil(3000/1500)
+        assert int(asm.params.iface_buf_pkts[1]) == 0
+
+    def test_logpcap_and_heartbeat(self, tmp_path):
+        asm = self._load(tmp_path,
+                         'logpcap="true" heartbeatfrequency="5"')
+        assert bool(asm.pcap_mask[0]) and not bool(asm.pcap_mask[1])
+        assert bool(asm.params.pcap_mask[0])
+        assert int(asm.heartbeat_freq_s[0]) == 5
+
+    def test_unknown_attribute_warns(self, tmp_path, capsys):
+        self._load(tmp_path, 'bogusattr="1"')
+        err = capsys.readouterr().err
+        assert "unknown" in err and "bogusattr" in err
+
+    def test_pinned_rcv_buffer_caps_advertised_window(self, tmp_path):
+        # End to end: a small pinned receive buffer must cap the server's
+        # advertised window and never grow (autotune off).
+        from shadow1_tpu.core import engine
+        asm = self._load(tmp_path, 'socketrecvbuffer="4096"')
+        out = engine.run_until(asm.state, asm.params, asm.app, 10 * SEC)
+        socks = out.socks
+        import numpy as np
+        caps = np.asarray(socks.rcv_buf_cap[0])
+        live = np.asarray(socks.stype[0]) != 0
+        assert (caps[live] <= 4096).all()
+
+
+class TestTgenDivergences:
+    def test_fanout_graph_rejected(self):
+        from shadow1_tpu.apps import tgen as tgen_app
+        xml = """
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <graph edgedefault="directed">
+    <node id="start"/><node id="stream"/><node id="pause"/>
+    <edge source="start" target="stream"/>
+    <edge source="start" target="pause"/>
+  </graph>
+</graphml>"""
+        with pytest.raises(ValueError, match="multiple successors"):
+            tgen_app.parse_tgen(xml)
+
+    def test_stream_without_peers_rejected(self):
+        from shadow1_tpu.apps import tgen as tgen_app
+        xml = """
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key id="k2" for="node" attr.name="sendsize" attr.type="string"/>
+  <graph edgedefault="directed">
+    <node id="start"/>
+    <node id="stream"><data key="k2">1 kib</data></node>
+    <edge source="start" target="stream"/>
+  </graph>
+</graphml>"""
+        g = tgen_app.parse_tgen(xml)
+        with pytest.raises(ValueError, match="no peers"):
+            tgen_app.build_state(2, [g], [0, -1], [0, 0],
+                                 resolve_peer=lambda s: (0, 80))

@@ -1,0 +1,40 @@
+"""Onion-circuit (Tor-like) workload: multi-hop store-and-forward chains.
+
+The reduced-scale CI version of the benchmark ladder's Tor rung
+(BASELINE.json configs 3/5; tools/ladder.py measures the full-scale
+rungs on the chip)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow1_tpu import sim
+from shadow1_tpu.core import engine, simtime
+
+SEC = simtime.SIMTIME_ONE_SECOND
+INV = simtime.SIMTIME_INVALID
+
+
+class TestOnionCircuits:
+    def test_circuits_complete_through_all_hops(self):
+        s, p, a = sim.build_onion(num_circuits=4,
+                                  bytes_per_circuit=1 << 16,
+                                  stop_time=60 * SEC)
+        out = engine.run_until(s, p, a, 60 * SEC)
+        app = out.app
+        done = app.done_t != INV
+        assert int(done.sum()) == 4
+        assert int(out.err) == 0
+        # Every relay moved exactly the full circuit payload downstream.
+        relays = np.asarray(app.role) == 1
+        assert (np.asarray(app.forwarded)[relays] == (1 << 16)).all()
+        # Teardown cascaded: no connection left half-open at the relays.
+        assert int(out.hosts.tx_queued.sum()) == 0
+
+    def test_deterministic(self):
+        s, p, a = sim.build_onion(num_circuits=3,
+                                  bytes_per_circuit=1 << 15,
+                                  stop_time=60 * SEC, seed=11)
+        o1 = engine.run_until(s, p, a, 60 * SEC)
+        o2 = engine.run_until(s, p, a, 60 * SEC)
+        assert jnp.array_equal(o1.app.done_t, o2.app.done_t)
+        assert jnp.array_equal(o1.hosts.pkts_sent, o2.hosts.pkts_sent)

@@ -330,3 +330,49 @@ class TestThroughputShape:
         finish = int(out.app.finish_t[1]) - MS  # minus start time
         assert finish >= 2 * 2 * 10 * MS
         assert finish < 1 * SEC
+
+
+class TestSackAndCongestion:
+    """Sender-side SACK (reference tcp.c:192-205 selectiveACKs +
+    tcp_retransmit_tally.cc) and the pluggable congestion-control hook
+    table (tcp_cong.h:11-33)."""
+
+    def test_sack_retransmits_only_losses(self):
+        # On a lossy path, selective repeat keeps the retransmission count
+        # near the actual loss count -- go-back-N would resend multiples.
+        state, params, app = sim.build_bulk(
+            num_hosts=3, bytes_per_client=1 << 18,
+            latency_ns=10 * MS, reliability=0.9,
+            stop_time=60 * SEC, seed=5)
+        out = sim.run(state, params, app)
+        assert int((out.app.phase == 2).sum()) == 2
+        drops = int(out.hosts.pkts_dropped_inet.sum())
+        retx = int(out.socks.retx_segs.sum())
+        assert drops > 0
+        assert retx <= int(1.5 * drops) + 4, (retx, drops)
+
+    def test_cubic_completes_lossy_transfer(self):
+        state, params, app = sim.build_bulk(
+            num_hosts=3, bytes_per_client=1 << 18,
+            latency_ns=10 * MS, reliability=0.9,
+            stop_time=60 * SEC, seed=5)
+        params = params.replace(cong="cubic")
+        out = sim.run(state, params, app)
+        assert int((out.app.phase == 2).sum()) == 2
+        assert int(out.err) == 0
+
+    def test_cubic_deterministic(self):
+        state, params, app = sim.build_bulk(
+            num_hosts=4, bytes_per_client=1 << 17,
+            latency_ns=5 * MS, reliability=0.95,
+            stop_time=60 * SEC, seed=9)
+        params = params.replace(cong="cubic")
+        a = sim.run(state, params, app)
+        b = sim.run(state, params, app)
+        assert jnp.array_equal(a.app.finish_t, b.app.finish_t)
+        assert jnp.array_equal(a.socks.retx_segs, b.socks.retx_segs)
+
+    def test_unknown_algorithm_rejected(self):
+        from shadow1_tpu.transport import cong
+        with pytest.raises(ValueError, match="unknown congestion"):
+            cong.validate("vegas")

@@ -1,0 +1,104 @@
+"""Benchmark ladder: measure simulated-sec / wall-sec on the real chip.
+
+The BASELINE.json bring-up ladder, measured end to end (build + compile
+excluded; steady-state wall time per simulated second reported):
+
+  rung 1: 2-host tgen file transfer      (examples/tgen-2host)
+  rung 2: 100-host tgen                  (examples/tgen-100host)
+  rung 3: 1k-host Tor-like onion circuits (sim.build_onion(200))
+  rung 4: phold event-rate probe          (bench.py metric)
+  rung 5: 10k-host onion circuits         (sim.build_onion(2000))
+
+    python tools/ladder.py [rung ...]     # default: 1 2 3 5
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import shadow1_tpu  # noqa: F401
+import jax
+
+from shadow1_tpu import sim
+from shadow1_tpu.core import engine, simtime
+
+SEC = simtime.SIMTIME_ONE_SECOND
+
+
+def _measure(state, params, app, warm_s: int, span_s: int):
+    state = engine.run_until(state, params, app, warm_s * SEC)
+    s0 = int(state.n_steps)  # sync
+    t0 = time.perf_counter()
+    state = engine.run_until(state, params, app, (warm_s + span_s) * SEC)
+    steps = int(state.n_steps) - s0  # sync
+    wall = time.perf_counter() - t0
+    return {
+        "sim_seconds": span_s,
+        "wall_seconds": round(wall, 3),
+        "sim_per_wall": round(span_s / wall, 3),
+        "microsteps": steps,
+        "err": int(state.err),
+    }, state
+
+
+def rung_tgen(path: str):
+    from shadow1_tpu.config import assemble
+    asm = assemble.load(path)
+    # Measure the ACTIVE phase (tgen streams run in the first seconds;
+    # once traffic ends, windows skip and sim-per-wall becomes idle
+    # speed, which is not the number that matters).
+    return _measure(asm.state, asm.params, asm.app, 1, 15)[0]
+
+
+def rung_phold():
+    s, p, a = sim.build_phold(num_hosts=16384, msgs_per_host=4,
+                              stop_time=10 * SEC,
+                              pool_capacity=16384 * 8)
+    res, out = _measure(s, p, a, 1, 2)
+    res["events"] = int(out.app.sent.sum() + out.app.recv.sum())
+    return res
+
+
+def rung_onion(circuits: int, pool_slab: int = 128):
+    # Big enough streams that the measured span is fully busy (cwnd-paced
+    # multi-hop forwarding, ~10s+ per circuit at these rates).
+    s, p, a = sim.build_onion(num_circuits=circuits,
+                              bytes_per_circuit=1 << 24,
+                              pool_slab=pool_slab,
+                              stop_time=120 * SEC)
+    res, out = _measure(s, p, a, 1, 15)
+    res["circuits_done"] = int((out.app.done_t !=
+                                simtime.SIMTIME_INVALID).sum())
+    res["hosts"] = int(out.hosts.num_hosts)
+    return res
+
+
+def main(rungs):
+    results = {"backend": jax.default_backend()}
+
+    def record(name, fn):
+        results[name] = fn()
+        print(json.dumps({name: results[name]}), flush=True)
+
+    if "1" in rungs:
+        record("tgen_2host",
+               lambda: rung_tgen("examples/tgen-2host/shadow.config.xml"))
+    if "2" in rungs:
+        record("tgen_100host",
+               lambda: rung_tgen("examples/tgen-100host/shadow.config.xml"))
+    if "3" in rungs:
+        record("onion_1k", lambda: rung_onion(200))
+    if "4" in rungs:
+        record("phold_16k", rung_phold)
+    if "5" in rungs:
+        record("onion_10k", lambda: rung_onion(2000, pool_slab=32))
+    unknown = set(rungs) - {"1", "2", "3", "4", "5"}
+    if unknown:
+        raise SystemExit(f"unknown ladder rungs: {sorted(unknown)}")
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:] or ["1", "2", "3", "5"])
