@@ -120,8 +120,14 @@ def run_config(args) -> int:
     _LVL = {None: 0, "off": 0, "error": 1, "critical": 1, "warning": 1,
             "message": 1, "info": 2, "debug": 2, "trace": 2}
     global_lvl = _LVL[args.log_level]
-    host_lvls = [max(_LVL.get((lv or "").lower() or None, 0), global_lvl)
-                 for lv in (asm.loglevels or [None] * len(asm.hostnames))]
+    host_lvls = []
+    for lv in (asm.loglevels or [None] * len(asm.hostnames)):
+        key = (lv or "").lower() or None
+        if key not in _LVL:
+            print(f"[shadow1-tpu] WARNING: unknown loglevel {lv!r} "
+                  f"(known: {sorted(k for k in _LVL if k)}); treating as "
+                  f"'off'", file=sys.stderr)
+        host_lvls.append(max(_LVL.get(key, 0), global_lvl))
     drain = None
     if any(host_lvls):
         if not args.data_directory:
@@ -175,6 +181,8 @@ def run_config(args) -> int:
         import os as _os
         from .observe import write_pcap
         ip_of = lambda i: asm.dns.address_of(i).ip  # noqa: E731
+        import jax as _jax
+        state = state.replace(cap=_jax.device_get(state.cap))  # fetch ONCE
         if args.pcap:
             n = write_pcap(
                 _os.path.join(args.data_directory, "capture.pcap"),

@@ -71,6 +71,7 @@ class Tracker:
             else np.asarray(per_host_interval_s, np.int64)
         self.per_host_ns = np.where(per > 0, per * SEC, self.interval_ns)
         self._next_row = np.zeros(h, np.int64)
+        self._last_row_t = np.zeros(h, np.int64)
         os.makedirs(data_dir, exist_ok=True)
         self.path = os.path.join(data_dir, "heartbeat.csv")
         with open(self.path, "w") as f:
@@ -86,12 +87,15 @@ class Tracker:
         n = len(_FIELDS)
         cur = {f: packed[i] for i, f in enumerate(_FIELDS)}
         txq, rxq = packed[n], packed[n + 1]
-        dt_s = max((now_ns - self._last_t) / SEC, 1e-9)
         with open(self.path, "a") as f:
             for i, name in enumerate(self.hostnames):
                 if now_ns < self._next_row[i]:
                     continue
                 self._next_row[i] = now_ns + self.per_host_ns[i]
+                # Rates divide by the PER-HOST elapsed time (a host on a
+                # 5s cadence accumulates 5s of deltas per row).
+                dt_s = max((now_ns - self._last_row_t[i]) / SEC, 1e-9)
+                self._last_row_t[i] = now_ns
                 d = {k: int(cur[k][i] - self._last[k][i]) for k in _FIELDS}
                 f.write(f"{now_ns / SEC:.3f},{name},"
                         f"{d['bytes_sent'] / dt_s:.1f},"

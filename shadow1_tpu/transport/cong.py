@@ -81,7 +81,11 @@ def _cubic_target(sv, tick_t):
     k = jnp.cbrt(jnp.maximum(wmax_seg * (1.0 - _CUBIC_BETA) / _CUBIC_C, 0.0))
     dt = t_s + rtt_s - k
     w = _CUBIC_C * dt * dt * dt + wmax_seg
-    return (jnp.maximum(w, 2.0) * TCP_MSS).astype(I32)
+    # Clamp in f32 BEFORE the i32 cast: long epochs make 0.4*t^3 overflow
+    # int32, and out-of-range f32->i32 casts are implementation-defined
+    # in XLA (backend-dependent results would break determinism).
+    w = jnp.clip(w, 2.0, 4194304.0 / TCP_MSS)  # SND_BUF_MAX cap
+    return (w * TCP_MSS).astype(I32)
 
 
 def _cubic_new_ack(sv, normal, acked_bytes, tick_t):

@@ -61,14 +61,16 @@ def rung_phold():
     return res
 
 
-def rung_onion(circuits: int, pool_slab: int = 128):
-    # Big enough streams that the measured span is fully busy (cwnd-paced
-    # multi-hop forwarding, ~10s+ per circuit at these rates).
+def rung_onion(circuits: int, pool_slab: int = 64):
+    # 1 MiB streams keep the measured span busy.  NOTE: 16 MiB streams
+    # (multi-megabyte autotuned windows) reproducibly crash the tunnel
+    # backend's TPU worker -- keep this sizing until that is fixed
+    # (BASELINE.md "known backend issue").
     s, p, a = sim.build_onion(num_circuits=circuits,
-                              bytes_per_circuit=1 << 24,
+                              bytes_per_circuit=1 << 20,
                               pool_slab=pool_slab,
                               stop_time=120 * SEC)
-    res, out = _measure(s, p, a, 1, 15)
+    res, out = _measure(s, p, a, 1, 10)
     res["circuits_done"] = int((out.app.done_t !=
                                 simtime.SIMTIME_INVALID).sum())
     res["hosts"] = int(out.hosts.num_hosts)
@@ -76,6 +78,9 @@ def rung_onion(circuits: int, pool_slab: int = 128):
 
 
 def main(rungs):
+    unknown = set(rungs) - {"1", "2", "3", "4", "5"}
+    if unknown:
+        raise SystemExit(f"unknown ladder rungs: {sorted(unknown)}")
     results = {"backend": jax.default_backend()}
 
     def record(name, fn):
@@ -94,9 +99,6 @@ def main(rungs):
         record("phold_16k", rung_phold)
     if "5" in rungs:
         record("onion_10k", lambda: rung_onion(2000, pool_slab=32))
-    unknown = set(rungs) - {"1", "2", "3", "4", "5"}
-    if unknown:
-        raise SystemExit(f"unknown ladder rungs: {sorted(unknown)}")
     print(json.dumps(results))
 
 
