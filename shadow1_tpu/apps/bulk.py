@@ -52,6 +52,10 @@ class BulkState:
 class Bulk:
     """Static app config (hashable: jitted engine calls cache per config)."""
 
+    # Bursty TCP fan-in: deliver up to 4 queued arrivals per host per
+    # micro-step (engine rx_batch rounds).
+    rx_batch = 4
+
     def __init__(self, server_port: int = SERVER_PORT,
                  client_slot: int = CLIENT_SLOT):
         self.server_port = int(server_port)

@@ -28,6 +28,7 @@ class EchoServer:
 
     uses_tcp = True
     may_loopback = False
+    rx_batch = 4
 
     def __hash__(self):
         return hash("echo-server")

@@ -56,6 +56,8 @@ class Onion:
 
     uses_tcp = True
     may_loopback = False
+    # Relays see back-to-back cell bursts: batch arrival delivery.
+    rx_batch = 4
 
     def __hash__(self):
         return hash("onion")

@@ -234,6 +234,10 @@ class TgenState:
 class Tgen:
     """Static app marker (hashable; tables live in TgenState)."""
 
+    # Bursty TCP fan-in: deliver up to 4 queued arrivals per host per
+    # micro-step (engine rx_batch rounds).
+    rx_batch = 4
+
     def __init__(self, client_slot: int = CLIENT_SLOT):
         self.client_slot = int(client_slot)
 

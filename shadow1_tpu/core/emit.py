@@ -45,6 +45,9 @@ class Emissions:
     wnd: jnp.ndarray         # [H,E] i32
     length: jnp.ndarray      # [H,E] i32
     ts_echo: jnp.ndarray     # [H,E] i64
+    t_send: jnp.ndarray      # [H,E] i64 per-lane send instant; 0 = the
+                             # tick time (rx_batch rounds stamp replies at
+                             # the triggering arrival's own time)
     sack_lo: jnp.ndarray     # [H,E,SACK_BLOCKS] u32 advertised SACK ranges
     sack_hi: jnp.ndarray     # [H,E,SACK_BLOCKS] u32
     payload_id: jnp.ndarray  # [H,E] i32
@@ -69,6 +72,7 @@ def empty(num_hosts: int, num_slots: int = NUM_SLOTS) -> Emissions:
         wnd=jnp.zeros(he, I32),
         length=jnp.zeros(he, I32),
         ts_echo=jnp.zeros(he, I64),
+        t_send=jnp.zeros(he, I64),
         sack_lo=jnp.zeros(he + (SACK_BLOCKS,), U32),
         sack_hi=jnp.zeros(he + (SACK_BLOCKS,), U32),
         payload_id=jnp.full(he, -1, I32),
@@ -78,7 +82,7 @@ def empty(num_hosts: int, num_slots: int = NUM_SLOTS) -> Emissions:
 
 def put(em: Emissions, mask: jnp.ndarray, slot: int, *, dst, sport, dport,
         proto, flags=0, seq=0, ack=0, wnd=0, length=0, ts_echo=0,
-        sack_lo=None, sack_hi=None, payload_id=-1,
+        t_send=0, sack_lo=None, sack_hi=None, payload_id=-1,
         priority=0.0) -> Emissions:
     """Vectorized emit: for hosts where `mask` is set, stage one packet in
     `slot`.  All field arguments are scalars or [H] arrays."""
@@ -112,6 +116,7 @@ def put(em: Emissions, mask: jnp.ndarray, slot: int, *, dst, sport, dport,
         wnd=upd(em.wnd, wnd, I32),
         length=upd(em.length, length, I32),
         ts_echo=upd(em.ts_echo, ts_echo, I64),
+        t_send=upd(em.t_send, t_send, I64),
         sack_lo=upd3(em.sack_lo, sack_lo),
         sack_hi=upd3(em.sack_hi, sack_hi),
         payload_id=upd(em.payload_id, payload_id, I32),

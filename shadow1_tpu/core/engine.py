@@ -348,7 +348,8 @@ def _wire_bytes(proto, length):
                               UDP_HEADER_SIZE)
 
 
-def _rx_phase(state: SimState, params, em, tick_t, active, app):
+def _rx_phase(state: SimState, params, em, tick_t, active, app,
+              window_end):
     """Arrivals: router enqueue (stage flip), NIC token/CoDel drain of one
     packet per host, transport delivery, inbox slot free.
 
@@ -404,106 +405,160 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app):
                        status | PDS_ROUTER_DROPPED, status)
     hosts = hosts.replace(
         pkts_dropped_router=hosts.pkts_dropped_router +
-        jnp.sum(tail_drop, axis=1))
-    rx_q = hosts.rx_queued + jnp.sum(due, axis=1, dtype=I32)
+        jnp.sum(tail_drop, axis=1),
+        rx_queued=hosts.rx_queued + jnp.sum(due, axis=1, dtype=I32))
 
-    # Head selection: earliest (time, pkt_id) among the queued backlog --
-    # the deterministic FIFO order of the reference's router queue plus
-    # the event total order for ties (event.c:110-153).
-    qm = st2 == STAGE_RX_QUEUED
-    tq = jnp.where(qm, t2, jnp.asarray(INV, I64))
-    tmin = jnp.min(tq, axis=1)
-    at_t = qm & (tq == tmin[:, None])
-    kq = jnp.where(at_t, k2, jnp.asarray(INV, I64))
-    kmin = jnp.min(kq, axis=1)
-    at = at_t & (kq == kmin[:, None])
+    # -- delivery rounds -----------------------------------------------------
+    # Round 0 delivers each host's earliest queued packet at tick_t, like
+    # the reference's one-event-per-pop.  Apps that declare `rx_batch` > 1
+    # (bursty TCP fan-in) get extra rounds that may also consume arrivals
+    # slightly in the FUTURE of tick_t -- legal as long as no other event
+    # (timer, app wake, re-tick) lies between tick_t and the arrival, and
+    # bounded by a small span so timers armed during the batch cannot be
+    # outrun.  Each round uses the ARRIVAL's own time as its clock, so
+    # ACK stamps, RTT samples, and timer arms are exact per packet.
+    d_rounds = max(1, int(getattr(app, "rx_batch", 1)))
     ids = jnp.arange(ki, dtype=I32)[None, :]
-    col = jnp.min(jnp.where(at, ids, ki), axis=1)
-    have = active & (col < ki)
-    col = jnp.clip(col, 0, ki - 1)
-    flat = jnp.arange(h, dtype=I32) * ki + col
-
-    # One packed gather for every field of the chosen packet.
-    row = ib.blk[flat]                                  # [H, ICOLS]
-    time_row = jnp.where(have, tmin, 0)
-    pkt = RxPkt(row, jnp.where(have, kmin, 0), time_row)
-
-    # NIC rx: token bucket + CoDel.
+    rows = jnp.arange(h, dtype=I32)
+    boot = tick_t < params.bootstrap_end
     tokens, last = nic.refill(hosts.tokens_rx, hosts.last_refill_rx,
                               params.bw_down_Bps, tick_t, active)
-    size = _wire_bytes(pkt.proto, pkt.length).astype(I64) * nic.SCALE
-    loop = pkt.src == jnp.arange(h, dtype=I32)
-    boot = tick_t < params.bootstrap_end
-    free_pass = loop | boot
-    funded = have & (free_pass | (tokens >= size))
+    hosts = hosts.replace(last_refill_rx=last)
+    if d_rounds > 1:
+        span = simtime.SIMTIME_ONE_MILLISECOND
+        bound = jnp.minimum(_aux_times(state, params, app), tick_t + span)
+        bound = jnp.minimum(bound, window_end - 1)
+    else:
+        bound = tick_t
 
-    sojourn = tick_t - time_row
-    backlog_after = rx_q - 1
-    hosts2, drop = nic.codel_dequeue(hosts, funded & ~loop, tick_t, sojourn,
-                                     backlog_after)
-    hosts = hosts2
-    deliver = funded & ~drop
+    delivered_n = jnp.zeros((h,), I32)
+    state = state.replace(hosts=hosts)
+    for r in range(d_rounds):
+        limit = tick_t if r == 0 else bound
+        hosts = state.hosts
+        # Candidates: the queued backlog, plus (rounds > 0, unbounded
+        # interface buffers only) in-flight arrivals within the bound.
+        cand = st2 == STAGE_RX_QUEUED
+        if r > 0 and not params.has_iface_buf:
+            cand = cand | ((st2 == STAGE_IN_FLIGHT) &
+                           (t2 <= limit[:, None]))
+        cand = cand & active[:, None]
+        tq = jnp.where(cand, t2, jnp.asarray(INV, I64))
+        tmin = jnp.min(tq, axis=1)
+        at_t = cand & (tq == tmin[:, None])
+        kq = jnp.where(at_t, k2, jnp.asarray(INV, I64))
+        kmin = jnp.min(kq, axis=1)
+        at = at_t & (kq == kmin[:, None])
+        col = jnp.min(jnp.where(at, ids, ki), axis=1)
+        have = active & (col < ki) & (tmin <= limit)
+        col = jnp.clip(col, 0, ki - 1)
+        flat = rows * ki + col
+        was_queued = have & (st2.reshape(-1)[flat] == STAGE_RX_QUEUED)
+        t_eff = jnp.maximum(tick_t, jnp.where(have, tmin, 0))
 
-    tokens = tokens - jnp.where(funded & ~free_pass, size, 0)
-    hosts = hosts.replace(tokens_rx=tokens, last_refill_rx=last)
+        # One packed gather for every field of the chosen packet.
+        row = ib.blk[flat]                              # [H, ICOLS]
+        pkt = RxPkt(row, jnp.where(have, kmin, 0),
+                    jnp.where(have, tmin, 0))
 
-    # Inbox slot release + status trail for everything dequeued.
-    oh = (ids == col[:, None])
-    st2 = jnp.where(oh & funded[:, None], STAGE_FREE, st2)
-    fm = (oh & (funded & drop)[:, None]).reshape(-1)
-    status = jnp.where(fm, status | PDS_ROUTER_DROPPED, status)
-    dm = (oh & deliver[:, None]).reshape(-1)
-    status = jnp.where(dm, status | PDS_RCV_SOCKET_PROCESSED, status)
+        # NIC rx: token bucket + CoDel (at the packet's own instant --
+        # tokens accrue up to t_eff so a packet the reference would fund
+        # at its arrival time is funded here too).
+        if r > 0:
+            tokens, last = nic.refill(tokens, hosts.last_refill_rx,
+                                      params.bw_down_Bps, t_eff, have)
+            hosts = hosts.replace(last_refill_rx=last)
+        size = _wire_bytes(pkt.proto, pkt.length).astype(I64) * nic.SCALE
+        loop = pkt.src == rows
+        free_pass = loop | boot
+        funded = have & (free_pass | (tokens >= size))
 
-    hosts = hosts.replace(
-        rx_queued=rx_q - jnp.where(funded, 1, 0).astype(I32),
-        pkts_dropped_router=hosts.pkts_dropped_router +
-        jnp.where(drop, 1, 0),
-    )
+        sojourn = jnp.maximum(t_eff - pkt.time, 0)
+        rx_q_now = hosts.rx_queued
+        backlog_after = rx_q_now - jnp.where(was_queued, 1, 0)
+        hosts, drop = nic.codel_dequeue(hosts, funded & ~loop, t_eff,
+                                        sojourn, backlog_after)
+        deliver = funded & ~drop
 
-    # Wake-ups: backlog remains -> re-tick now; starved -> when tokens
-    # accrue for this packet.
-    t_tok = tick_t + nic.time_until(size - tokens, params.bw_down_Bps)
-    t_res = jnp.where(
-        have & ~funded, t_tok,
-        jnp.where(funded & (hosts.rx_queued > 0), tick_t,
-                  jnp.asarray(INV, I64)))
-    hosts = hosts.replace(t_resume=jnp.minimum(hosts.t_resume, t_res))
+        tokens = tokens - jnp.where(funded & ~free_pass, size, 0)
+        hosts = hosts.replace(tokens_rx=tokens)
 
-    state = state.replace(
-        inbox=ib.replace(stage=st2.reshape(-1), status=status),
-        hosts=hosts)
+        # Inbox slot release + status trail for everything dequeued.
+        oh = (ids == col[:, None])
+        st2 = jnp.where(oh & funded[:, None], STAGE_FREE, st2)
+        fm = (oh & (funded & drop)[:, None]).reshape(-1)
+        status = jnp.where(fm, status | PDS_ROUTER_ENQUEUED |
+                           PDS_ROUTER_DROPPED, status)
+        dm = (oh & deliver[:, None]).reshape(-1)
+        status = jnp.where(dm, status | PDS_ROUTER_ENQUEUED |
+                           PDS_RCV_SOCKET_PROCESSED, status)
 
-    # Event log (traced away when disabled).
-    if state.log is not None:
-        rows = jnp.arange(h, dtype=I32)
-        rows2 = jnp.broadcast_to(rows[:, None], (h, ki)).reshape(-1)
-        src_col = state.inbox.blk[:, ICOL_SRC]
-        t_flat = jnp.broadcast_to(tick_t[:, None], (h, ki)).reshape(-1)
-        state = _log_append(state, tail_drop.reshape(-1), LOG_DROP_TAIL,
-                            LOG_WARNING, t_flat, rows2, src_col)
-        state = _log_append(state, drop, LOG_DROP_ROUTER, LOG_WARNING,
-                            tick_t, rows, pkt.src)
-        state = _log_append(state, deliver, LOG_DELIVER, LOG_DEBUG,
-                            tick_t, rows, pkt.src)
+        hosts = hosts.replace(
+            rx_queued=rx_q_now -
+            jnp.where(funded & was_queued, 1, 0).astype(I32),
+            pkts_dropped_router=hosts.pkts_dropped_router +
+            jnp.where(drop, 1, 0),
+        )
 
-    # Transport delivery.
-    udp_mask = deliver & (pkt.proto == PROTO_UDP)
-    socks, _accepted = udp_mod.deliver(state.socks, udp_mask, pkt.src,
-                                       pkt.sport, pkt.dport, pkt.length,
-                                       pkt.payload_id)
-    state = state.replace(socks=socks)
-    if _uses_tcp(app):
-        tcp_mask = deliver & (pkt.proto == PROTO_TCP)
-        state, em = tcp_mod.process_arrivals(state, params, em, tick_t,
-                                             pkt, tcp_mask)
+        if r == d_rounds - 1:
+            # Wake-ups: backlog remains -> re-tick now; starved -> when
+            # tokens accrue for this packet.
+            t_tok = tick_t + nic.time_until(size - tokens,
+                                            params.bw_down_Bps)
+            t_res = jnp.where(
+                have & ~funded, t_tok,
+                jnp.where(funded & (hosts.rx_queued > 0), tick_t,
+                          jnp.asarray(INV, I64)))
+            hosts = hosts.replace(
+                t_resume=jnp.minimum(hosts.t_resume, t_res))
 
-    hosts = state.hosts
-    hosts = hosts.replace(
-        pkts_recv=hosts.pkts_recv + jnp.where(deliver, 1, 0),
-        bytes_recv=hosts.bytes_recv + jnp.where(deliver, pkt.length, 0),
-    )
-    return state.replace(hosts=hosts), em, deliver
+        state = state.replace(
+            inbox=ib.replace(stage=st2.reshape(-1), status=status),
+            hosts=hosts)
+        ib = state.inbox
+
+        # Event log (traced away when disabled).
+        if state.log is not None:
+            if r == 0:
+                rows2 = jnp.broadcast_to(rows[:, None], (h, ki)).reshape(-1)
+                src_col = state.inbox.blk[:, ICOL_SRC]
+                t_flat = jnp.broadcast_to(tick_t[:, None],
+                                          (h, ki)).reshape(-1)
+                state = _log_append(state, tail_drop.reshape(-1),
+                                    LOG_DROP_TAIL, LOG_WARNING, t_flat,
+                                    rows2, src_col)
+            state = _log_append(state, drop, LOG_DROP_ROUTER, LOG_WARNING,
+                                t_eff, rows, pkt.src)
+            state = _log_append(state, deliver, LOG_DELIVER, LOG_DEBUG,
+                                t_eff, rows, pkt.src)
+
+        # Transport delivery (each round stamps at the arrival's time).
+        udp_mask = deliver & (pkt.proto == PROTO_UDP)
+        socks, _accepted = udp_mod.deliver(state.socks, udp_mask, pkt.src,
+                                           pkt.sport, pkt.dport,
+                                           pkt.length, pkt.payload_id)
+        state = state.replace(socks=socks)
+        if _uses_tcp(app):
+            tcp_mask = deliver & (pkt.proto == PROTO_TCP)
+            reply_slot = emit.SLOT_RX_REPLY if r == 0 \
+                else emit.NUM_SLOTS + r - 1
+            state, em = tcp_mod.process_arrivals(state, params, em, t_eff,
+                                                 pkt, tcp_mask,
+                                                 reply_slot=reply_slot)
+
+        hosts = state.hosts
+        hosts = hosts.replace(
+            pkts_recv=hosts.pkts_recv + jnp.where(deliver, 1, 0),
+            bytes_recv=hosts.bytes_recv + jnp.where(deliver, pkt.length,
+                                                    0),
+        )
+        state = state.replace(hosts=hosts)
+        delivered_n = delivered_n + jnp.where(deliver, 1, 0)
+        if r == 0:
+            t_post = jnp.where(deliver, t_eff, tick_t)
+        else:
+            t_post = jnp.where(deliver, jnp.maximum(t_post, t_eff), t_post)
+    return state, em, delivered_n, t_post
 
 
 # ---------------------------------------------------------------------------
@@ -611,7 +666,8 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     have_slot = nl & (nl_rank >= 0) & (nl_rank < n_free[:, None])
     placed = have_slot                                  # outbox-placed
 
-    send_t = jnp.broadcast_to(tick_t[:, None], (h, e))
+    send_t = jnp.where(em.t_send > 0, em.t_send,
+                       jnp.broadcast_to(tick_t[:, None], (h, e)))
     arr_t = send_t + lat
 
     # --- NIC tx admission: direct-admit under the token budget, else park
@@ -628,8 +684,14 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     spent = jnp.sum(jnp.where(admit & ~boot2, sizes, 0), axis=1)
     tokens = tokens - spent
     parked = placed & ~admit
+    # A parked packet stamped in the future (rx_batch reply lanes) is
+    # invisible to _select_tx_slab until its send instant; arm a wake
+    # there or it strands until an unrelated event ticks the host.
+    t_park = jnp.min(jnp.where(parked, send_t, jnp.asarray(INV, I64)),
+                     axis=1)
     hosts = hosts.replace(
         tokens_tx=tokens, last_refill_tx=last,
+        t_resume=jnp.minimum(hosts.t_resume, t_park),
         tx_queued=hosts.tx_queued + jnp.sum(parked, axis=1).astype(I32))
 
     stage_v = jnp.where(admit, STAGE_IN_FLIGHT, STAGE_TX_QUEUED)
@@ -889,28 +951,40 @@ def _microstep_core(state: SimState, params, app, t_h, window_end):
         hosts=state.hosts.replace(t_resume=jnp.where(
             active, jnp.asarray(INV, I64), state.hosts.t_resume)))
 
-    n_lanes = emit.NUM_SLOTS if _uses_tcp(app) else emit.SLOT_APP + 1
+    if _uses_tcp(app):
+        # Extra reply lanes for rx_batch delivery rounds beyond the first
+        # (each round's TCP reply needs its own emission slot).
+        n_lanes = emit.NUM_SLOTS + max(0, int(getattr(app, "rx_batch", 1))
+                                       - 1)
+    else:
+        n_lanes = emit.SLOT_APP + 1
     em = emit.empty(h, n_lanes)
 
     # Phase A: arrivals through the destination slab (router queue, NIC rx
     # tokens + CoDel, transport delivery).
-    state, em, delivered = _rx_phase(state, params, em, tick_t, active, app)
+    state, em, delivered_n, t_post = _rx_phase(state, params, em, tick_t,
+                                               active, app, window_end)
 
-    # Phase B: transport timers.
+    # Phases B-D run at the POST-BATCH per-host instant: when rx_batch
+    # rounds consumed arrivals slightly after tick_t, every downstream
+    # effect (timer arming, app reaction, transmitted segments) is
+    # stamped at-or-after its cause.  The batching bound guarantees no
+    # timer/app event was due inside (tick_t, t_post], so ordering is
+    # preserved.  For rx_batch=1 apps t_post == tick_t exactly.
     if _uses_tcp(app):
-        state, em = tcp_mod.run_timers(state, params, em, tick_t, active)
+        state, em = tcp_mod.run_timers(state, params, em, t_post, active)
 
     # Phase C: application tick.
     if app is not None:
-        state, em = app.on_tick(state, params, em, tick_t, active)
+        state, em = app.on_tick(state, params, em, t_post, active)
 
     # Phase D: TCP transmission, merge staged emissions into the outbox
     # (direct-admit or park) or own inbox (loopback), then drain parked
     # packets through the tx bucket.
     if _uses_tcp(app):
-        state, em = tcp_mod.transmit(state, params, em, tick_t, active)
-    state, placed = _stage_emissions(state, params, em, tick_t, active, app)
-    state = _tx_drain(state, params, tick_t, active)
+        state, em = tcp_mod.transmit(state, params, em, t_post, active)
+    state, placed = _stage_emissions(state, params, em, t_post, active, app)
+    state = _tx_drain(state, params, t_post, active)
 
     # Virtual CPU accounting (reference cpu_updateTime + cpu_addDelay,
     # cpu.c:77-108): every delivered packet and staged emission costs
@@ -918,7 +992,7 @@ def _microstep_core(state: SimState, params, app, t_h, window_end):
     # happens where the backlog is consulted (_cpu_clamp), so per-step
     # increments smaller than the precision are never lost.
     cpu_on = params.cpu_ns_per_event > 0
-    events = jnp.where(delivered, 1, 0).astype(I64) + \
+    events = delivered_n.astype(I64) + \
         jnp.sum(em.valid, axis=1).astype(I64)
     cost = params.cpu_ns_per_event * events
     avail = jnp.maximum(state.hosts.cpu_avail, tick_t)

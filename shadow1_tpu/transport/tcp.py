@@ -438,7 +438,8 @@ def _rtt_update(sv: _Sock, mask, rtt):
 # ---------------------------------------------------------------------------
 
 
-def process_arrivals(state, params, em, tick_t, pkt, mask):
+def process_arrivals(state, params, em, tick_t, pkt, mask,
+                     reply_slot=emit.SLOT_RX_REPLY):
     """Handle <=1 inbound TCP segment per host.
 
     `pkt` carries the [H] field registers of each host's delivered packet
@@ -747,8 +748,9 @@ def process_arrivals(state, params, em, tick_t, pkt, mask):
     rst_flags = TCP_FLAG_RST | TCP_FLAG_ACK
     reply_any = reply | orphan
     em = emit.put(
-        em, reply_any, emit.SLOT_RX_REPLY,
+        em, reply_any, reply_slot,
         dst=p_src, sport=p_dport, dport=p_sport, proto=st.PROTO_TCP,
+        t_send=tick_t,
         flags=jnp.where(orphan, rst_flags, r_flags),
         seq=jnp.where(orphan, p_ack, r_seq),
         ack=jnp.where(orphan, (p_seq + p_len.astype(U32) + jnp.uint32(1)),
