@@ -77,7 +77,12 @@ from .state import (ERR_POOL_OVERFLOW, I32, I64, U32, PROTO_TCP, PROTO_UDP,
 
 INV = simtime.SIMTIME_INVALID
 
-_MASK40 = (jnp.int64(1) << 40) - 1
+# Plain Python int, NOT jnp: module-level jnp expressions run an eager device
+# op at import time and initialize the ambient JAX backend, which breaks the
+# CPU-child sandbox used by dryrun_multichip (see core/rng.py for the rule;
+# tests/test_import_hygiene.py locks it in). Weak typing makes `x & _MASK40`
+# identical for int64 x.
+_MASK40 = (1 << 40) - 1
 
 
 def _uses_tcp(app) -> bool:
