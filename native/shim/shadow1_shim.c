@@ -28,6 +28,7 @@
 #include <errno.h>
 #include <fcntl.h>
 #include <netinet/in.h>
+#include <poll.h>
 #include <stdarg.h>
 #include <stddef.h>
 #include <stdint.h>
@@ -85,6 +86,10 @@ static int g_seq_fd = -1;
 static volatile int64_t *g_time_page = NULL;
 static int g_vfd_open[MAX_VFD];
 static int g_vfd_nonblock[MAX_VFD];
+/* Pending socket error (SO_ERROR), filled from poll replies so a
+ * nonblocking connect's failure is observable the way libc callers
+ * expect: poll -> POLLERR/POLLOUT -> getsockopt(SO_ERROR). */
+static int g_vfd_soerr[MAX_VFD];
 
 static ssize_t (*real_read)(int, void *, size_t);
 static ssize_t (*real_write)(int, const void *, size_t);
@@ -162,9 +167,13 @@ int socket(int domain, int type, int protocol) {
 int connect(int fd, const struct sockaddr *addr, socklen_t alen) {
   if (is_vfd(fd) && addr && addr->sa_family == AF_INET) {
     const struct sockaddr_in *a = (const struct sockaddr_in *)addr;
+    /* Nonblock flag rides above the 16-bit port in a1; a nonblocking
+     * connect returns -1/EINPROGRESS and completes via poll. */
     req_t rq = {.op = OP_CONNECT, .fd = fd,
                 .a0 = (int64_t)ntohl(a->sin_addr.s_addr),
-                .a1 = (int64_t)ntohs(a->sin_port), .len = 0};
+                .a1 = (int64_t)ntohs(a->sin_port) |
+                      ((int64_t)(g_vfd_nonblock[fd - VFD_BASE] != 0) << 32),
+                .len = 0};
     rep_t rp;
     return (int)rpc(&rq, &rp);
   }
@@ -200,7 +209,8 @@ int listen(int fd, int backlog) {
 
 int accept(int fd, struct sockaddr *addr, socklen_t *alen) {
   if (is_vfd(fd)) {
-    req_t rq = {.op = OP_ACCEPT, .fd = fd, .len = 0};
+    req_t rq = {.op = OP_ACCEPT, .fd = fd,
+                .a0 = g_vfd_nonblock[fd - VFD_BASE], .len = 0};
     rep_t rp;
     int64_t r = rpc(&rq, &rp);
     if (r >= VFD_BASE && r < VFD_BASE + MAX_VFD) {
@@ -286,7 +296,10 @@ int getsockopt(int fd, int level, int name, void *val, socklen_t *len) {
   if (is_vfd(fd)) {
     if (level == SOL_SOCKET && name == SO_ERROR && val && len &&
         *len >= sizeof(int)) {
-      *(int *)val = 0;
+      /* Serve (and clear, like Linux) the pending error cached from the
+       * last poll reply -- the nonblocking-connect failure path. */
+      *(int *)val = g_vfd_soerr[fd - VFD_BASE];
+      g_vfd_soerr[fd - VFD_BASE] = 0;
       *len = sizeof(int);
       return 0;
     }
@@ -314,6 +327,41 @@ int fcntl(int fd, int cmd, ...) {
   static int (*real_fcntl)(int, int, ...);
   if (!real_fcntl) real_fcntl = dlsym(RTLD_NEXT, "fcntl");
   return real_fcntl(fd, cmd, arg);
+}
+
+/* poll over virtual fds: the readiness multiplexing real event-driven
+ * clients are written around (reference epoll.c:638-671 tryNotify; the
+ * sim answers with the sockets' transport-register state).  Entries for
+ * non-virtual fds are reported not-ready (revents 0) -- plugin loops
+ * under the shim only ever wait on simulated sockets.  Wire format:
+ * request data = nfds x {int32 fd, int32 events}, a0 = timeout_ms;
+ * reply data = nfds x {int32 revents, int32 soerr}, ret = #ready. */
+int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
+  int any_v = 0;
+  for (nfds_t i = 0; i < nfds; i++)
+    if (is_vfd(fds[i].fd)) any_v = 1;
+  if (g_seq_fd < 0 || !any_v || nfds > MAX_DATA / 8) {
+    static int (*real_poll)(struct pollfd *, nfds_t, int);
+    if (!real_poll) real_poll = dlsym(RTLD_NEXT, "poll");
+    return real_poll(fds, nfds, timeout);
+  }
+  req_t rq = {.op = OP_POLL, .fd = -1, .a0 = timeout, .len = (uint32_t)(nfds * 8)};
+  int32_t *w = (int32_t *)rq.data;
+  for (nfds_t i = 0; i < nfds; i++) {
+    w[2 * i] = fds[i].fd;
+    w[2 * i + 1] = fds[i].events;
+  }
+  rep_t rp;
+  int64_t r = rpc(&rq, &rp);
+  if (r < 0) return (int)r;
+  const int32_t *rv = (const int32_t *)rp.data;
+  for (nfds_t i = 0; i < nfds; i++) {
+    fds[i].revents = (short)rv[2 * i];
+    int soerr = rv[2 * i + 1];
+    if (is_vfd(fds[i].fd) && soerr)
+      g_vfd_soerr[fds[i].fd - VFD_BASE] = soerr;
+  }
+  return (int)r;
 }
 
 int shutdown(int fd, int how) {

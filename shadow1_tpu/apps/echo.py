@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from ..core import simtime
 from ..core.state import I64, SOCK_TCP, TCPS_CLOSEWAIT, TCPS_ESTABLISHED
-from ..transport.tcp import _sdiff
+from ..transport.tcp import _sdiff, data_end as tcp_data_end
 
 
 @struct.dataclass
@@ -51,7 +51,10 @@ class EchoServer:
             (socks.tcp_state == TCPS_ESTABLISHED) |
             (socks.tcp_state == TCPS_CLOSEWAIT))
 
-        avail = _sdiff(socks.rcv_nxt, socks.rcv_read)
+        # Clamp at the FIN: without it the echo appends one phantom byte
+        # to its reply before closing (tcp.data_end docstring).
+        data_end = tcp_data_end(socks)
+        avail = _sdiff(data_end, socks.rcv_read)
         used = _sdiff(socks.snd_end, socks.snd_una)
         room = jnp.maximum(socks.snd_buf_cap - used, 0)
         n = jnp.clip(jnp.minimum(avail, room), 0)
@@ -73,7 +76,7 @@ class EchoServer:
 
         # Peer closed and everything echoed: close our side too.
         done = live & (socks.tcp_state == TCPS_CLOSEWAIT) & \
-            (_sdiff(socks.rcv_nxt, socks.rcv_read) <= 0) & ~socks.app_closed
+            (_sdiff(data_end, socks.rcv_read) <= 0) & ~socks.app_closed
         socks = socks.replace(app_closed=socks.app_closed | done)
         return state.replace(socks=socks), em
 

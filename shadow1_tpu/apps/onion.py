@@ -107,10 +107,7 @@ class Onion:
         child = (socks.stype == SOCK_TCP) & (socks.parent >= 0)
         # Readable DATA bytes: the FIN consumes a sequence number too
         # (rcv_nxt passes it), but it must not be forwarded as payload.
-        data_end = jnp.where(
-            (socks.fin_seq != 0) &
-            (_sdiff(socks.fin_seq, socks.rcv_nxt) <= 0),
-            socks.fin_seq, socks.rcv_nxt)
+        data_end = tcp.data_end(socks)
         avail2 = jnp.where(child, _sdiff(data_end, socks.rcv_read), 0)
         avail2 = jnp.maximum(avail2, 0)
         in_avail = jnp.sum(avail2, axis=1)

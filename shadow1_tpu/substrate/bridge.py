@@ -20,12 +20,22 @@ with the conservative window, not an in-process scheduler, as the
 synchronization boundary.
 
 Payload bytes never touch the device: each virtual socket keeps its sent
-byte stream host-side, and inbound bytes come from a `content_provider`
-(for a modeled peer like the echo server, content derives from the
-stream; a future real-peer path reads the opposite endpoint's stream).
-The device controls *timing only* -- how many bytes are deliverable when
--- which is exactly the reference's split between Payload refcounts and
-packet events (src/main/routing/payload.c).
+byte stream host-side.  Inbound bytes resolve in priority order: (1) a
+real peer -- when both endpoints are real processes the connection is
+paired at accept() time and each side reads the OTHER side's sent
+stream at the device-dictated cursor, so bytes written by process A are
+the bytes process B reads; (2) a `content_provider` callback (modeled
+peer, e.g. the on-device echo server); (3) zeros.  The device controls
+*timing only* -- how many bytes are deliverable when -- which is exactly
+the reference's split between Payload refcounts and packet events
+(src/main/routing/payload.c:16-23, packet.c:97-100).
+
+Real servers: OP_LISTEN/OP_ACCEPT ride the modeled listener/child-socket
+machinery (SocketTable.parent/accepted/backlog, engine SYN handling) --
+accept() parks until a child slot reaches ESTABLISHED, then binds a new
+vfd to it (reference host_acceptNewPeer, tcp.c:91-115).  OP_POLL parks a
+process on a readiness SET and wakes it when any member socket's
+registers show readable/writable/error (reference epoll.c:638-671).
 """
 
 from __future__ import annotations
@@ -55,6 +65,11 @@ EMULATED_EPOCH_NS = 946_684_800 * simtime.SIMTIME_ONE_SECOND
 
 _EAGAIN = 11
 _ECONNREFUSED = 111
+_EINPROGRESS = 115
+
+# poll(2) event bits (linux asm-generic/poll.h).
+POLLIN, POLLPRI, POLLOUT, POLLERR, POLLHUP, POLLNVAL = \
+    0x001, 0x002, 0x004, 0x008, 0x010, 0x020
 
 
 class _SeqLib:
@@ -95,8 +110,20 @@ class VSocket:
     connecting: bool = False
     connected: bool = False
     closed: bool = False
+    listening: bool = False
     sent: bytearray = field(default_factory=bytearray)  # app->net stream
     recv_cursor: int = 0                                # bytes handed to app
+    # The opposite endpoint when BOTH ends are real processes (paired at
+    # accept time); recv then reads peer.sent at recv_cursor.
+    peer: "VSocket | None" = None
+    # Registry key while an active connect awaits real<->real pairing.
+    # Popped at accept-pairing ONLY: the entry must survive a client
+    # close/half-close, because the server may accept (and pair) after
+    # the client already shut down -- its bytes are still in flight.  A
+    # never-accepted connect leaves a dict entry behind (the VSocket
+    # itself lives in p.vfds either way); a same-4-tuple reconnect
+    # overwrites it.
+    conn_key: tuple | None = None
 
 
 @dataclass
@@ -139,11 +166,19 @@ class Substrate:
         self.resolve_ip = resolve_ip
         self.procs: list[RealProcess] = []
         self.sock_slot_base = sock_slot_base
-        self._next_slot: dict[int, int] = {}
         self._next_port = ephemeral_base
         self.content_provider = None   # (host, slot, vsock, n) -> bytes
         self._pending = []             # queued device ops for this sync
         self.max_slots = 1 << 30       # refined from the state at sync
+        # Connection registry for real<->real pairing:
+        # (client_host, client_port, server_host, server_port) -> client VSocket.
+        self._conns: dict[tuple, VSocket] = {}
+        # Slots handed to real processes that the device may not show
+        # non-FREE yet (reservation applies at sync end); per host.
+        self._reserved: dict[int, set] = {}
+        # Child slots already returned by accept() whose `accepted` bit
+        # the device may not show yet; per host.
+        self._accept_taken: dict[int, set] = {}
 
     # -- process management -------------------------------------------------
 
@@ -158,10 +193,30 @@ class Substrate:
         self.procs.append(p)
         return p
 
-    def _alloc_slot(self, host: int) -> int:
-        s = self._next_slot.get(host, self.sock_slot_base)
-        self._next_slot[host] = s + 1
-        return s
+    def _pick_slot(self, host: int, regs) -> int | None:
+        """Pick the lowest device-FREE slot at or above sock_slot_base that
+        this sync hasn't already handed out.  The device allocates child
+        sockets min-free-first too, so the slot is also RESERVED on device
+        at sync end ('reserve' op) -- otherwise a SYN arriving before the
+        process connects could spawn a child into the same slot."""
+        from ..core.state import SOCK_FREE
+
+        taken = set(self._reserved.setdefault(host, set()))
+        # A device-freed slot (RST / RTO teardown sets stype=SOCK_FREE
+        # immediately) may still be referenced by an OPEN vfd whose owner
+        # hasn't observed the error yet -- handing it out again would
+        # alias two VSockets onto one slot.  Exclude every slot a live
+        # vfd still holds.
+        for p in self.procs:
+            for vs in p.vfds.values():
+                if not vs.closed:
+                    taken.add(vs.slot)
+        stype = regs["stype"][host]
+        for s in range(self.sock_slot_base, self.max_slots):
+            if s not in taken and int(stype[s]) == SOCK_FREE:
+                self._reserved[host].add(s)
+                return s
+        return None
 
     def _alloc_port(self) -> int:
         self._next_port += 1
@@ -189,7 +244,8 @@ class Substrate:
         """Earliest virtual time a parked process needs (sleep expiry)."""
         wakes = [p.parked.wake_ns for p in self.procs
                  if not p.exited and p.parked is not None
-                 and p.parked.op == OP_SLEEP]
+                 and p.parked.op in (OP_SLEEP, OP_POLL)
+                 and p.parked.wake_ns >= 0]
         return min(wakes) if wakes else None
 
     def all_exited(self) -> bool:
@@ -201,9 +257,22 @@ class Substrate:
         socks = state.socks
         self.max_slots = socks.slots
         names = ("tcp_state", "rcv_nxt", "rcv_read", "snd_una", "snd_end",
-                 "snd_buf_cap", "error", "fin_seq", "stype")
+                 "snd_buf_cap", "error", "fin_seq", "stype",
+                 "parent", "accepted", "child_order",
+                 "local_port", "peer_host", "peer_port")
         vals = jax.device_get(tuple(getattr(socks, n) for n in names))
-        return dict(zip(names, vals))
+        regs = dict(zip(names, vals))
+        # Reservations/accept-marks the device has caught up on can be
+        # forgotten (keeps the sets from growing for the run's lifetime).
+        from ..core.state import SOCK_FREE
+
+        for h, taken in self._reserved.items():
+            taken.difference_update(
+                s for s in list(taken) if int(regs["stype"][h, s]) != SOCK_FREE)
+        for h, taken in self._accept_taken.items():
+            taken.difference_update(
+                s for s in list(taken) if bool(regs["accepted"][h, s]))
+        return regs
 
     def _run_until_blocked(self, p: RealProcess, regs, now_ns):
         if p.exited:
@@ -276,10 +345,10 @@ class Substrate:
         if op == OP_SOCKET:
             if p.next_vfd - VFD_BASE >= 4096:
                 return (-1, 24, b"")  # EMFILE: shim table exhausted
-            slot = self._alloc_slot(h)
-            if slot >= self.max_slots:
-                self._next_slot[h] = slot  # keep counter honest
+            slot = self._pick_slot(h, regs)
+            if slot is None:
                 return (-1, 24, b"")  # EMFILE: device socket table full
+            self._pending.append(("reserve", h, slot))
             vfd = p.next_vfd
             p.next_vfd += 1
             vs = VSocket(slot=slot, vfd=vfd)
@@ -293,6 +362,10 @@ class Substrate:
             p.parked = Parked(OP_SLEEP, wake_ns=now_ns + max(0, a0))
             return None
 
+        if op == OP_POLL:
+            return self._do_poll(p, data, timeout_ms=int(a0),
+                                 regs=regs, now_ns=now_ns)
+
         vs = p.vfds.get(fd)
         if vs is None:
             return (-1, 9, b"")  # EBADF
@@ -301,15 +374,40 @@ class Substrate:
             vs.local_port = int(a1)
             return (0, 0, b"")
 
+        if op == OP_LISTEN:
+            if not vs.local_port:
+                vs.local_port = self._alloc_port()
+            vs.listening = True
+            self._pending.append(("listen", h, vs.slot, vs.local_port,
+                                  max(1, int(a0))))
+            return (0, 0, b"")
+
+        if op == OP_ACCEPT:
+            if not vs.listening:
+                return (-1, 22, b"")  # EINVAL
+            rep = self._try_accept(p, vs, regs)
+            if rep is not None:
+                return rep
+            if a0:  # nonblocking
+                return (-1, _EAGAIN, b"")
+            p.parked = Parked(OP_ACCEPT, fd=fd)
+            return None
+
         if op == OP_CONNECT:
             dst = self.resolve_ip(int(a0))
             if dst is None:
                 return (-1, _ECONNREFUSED, b"")
+            nonblock = bool(a1 >> 32)
+            dport = int(a1) & 0xFFFF
             if not vs.local_port:
                 vs.local_port = self._alloc_port()
             vs.connecting = True
-            self._pending.append(("connect", h, vs.slot, dst, int(a1),
+            vs.conn_key = (h, vs.local_port, dst, dport)
+            self._conns[vs.conn_key] = vs
+            self._pending.append(("connect", h, vs.slot, dst, dport,
                                   vs.local_port))
+            if nonblock:
+                return (-1, _EINPROGRESS, b"")
             p.parked = Parked(OP_CONNECT, fd=fd)
             return None
 
@@ -336,11 +434,25 @@ class Substrate:
         used = (snd_end - int(regs["snd_una"][h, vs.slot])) & 0xFFFFFFFF
         return int(regs["snd_buf_cap"][h, vs.slot]) - used
 
+    @staticmethod
+    def _fin_reached(rcv_nxt: int, fin_seq: int) -> bool:
+        """True once the peer's FIN has been processed (rcv_nxt advanced to
+        or past fin_seq; the FIN consumes a sequence slot).  Scalar analog
+        of transport.tcp.data_end's clamp condition."""
+        return fin_seq != 0 and ((rcv_nxt - fin_seq) & 0xFFFFFFFF) < 0x80000000
+
     def _avail(self, p, vs, regs):
         h = p.host
         key = (h, vs.slot)
-        d = (int(regs["rcv_nxt"][h, vs.slot]) -
-             int(regs["rcv_read"][h, vs.slot])) & 0xFFFFFFFF
+        rcv_nxt = int(regs["rcv_nxt"][h, vs.slot])
+        fin_seq = int(regs["fin_seq"][h, vs.slot])
+        # Readable data ends at fin_seq, not rcv_nxt -- otherwise a
+        # read-until-EOF loop receives one fabricated byte before EOF
+        # (transport.tcp.data_end docstring).
+        data_end = fin_seq if self._fin_reached(rcv_nxt, fin_seq) else rcv_nxt
+        d = (data_end - int(regs["rcv_read"][h, vs.slot])) & 0xFFFFFFFF
+        if d >= 0x80000000:   # signed wrap guard: rcv_read never passes
+            d -= 1 << 32      # data_end, but stay safe under mod-2^32
         return d - self._local_read.get(key, 0)
 
     def _do_send(self, p, vs, data, regs, nonblock):
@@ -367,9 +479,17 @@ class Substrate:
                 # RST/timeout surfaces as a recv error, like Linux
                 # (ECONNRESET/ETIMEDOUT), not a clean EOF.
                 return (-1, err, b"")
-            # Peer closed and everything consumed -> EOF.
-            if st in (tcp.TCPS_CLOSEWAIT, tcp.TCPS_LASTACK,
-                      tcp.TCPS_CLOSED):
+            # Peer closed and everything consumed -> EOF.  The peer's FIN
+            # having been processed (rcv_nxt advanced past fin_seq) covers
+            # BOTH close orders: passive close (CLOSEWAIT/LASTACK) and
+            # active close (FINWAIT2/CLOSING/TIMEWAIT after we half-closed
+            # first) -- a state-list check alone parks an active-closing
+            # reader forever.
+            fin_done = self._fin_reached(
+                int(regs["rcv_nxt"][p.host, vs.slot]),
+                int(regs["fin_seq"][p.host, vs.slot]))
+            if fin_done or st in (tcp.TCPS_CLOSEWAIT, tcp.TCPS_LASTACK,
+                                  tcp.TCPS_CLOSED):
                 return (0, 0, b"")
             if nonblock:
                 return (-1, _EAGAIN, b"")
@@ -384,11 +504,131 @@ class Substrate:
         return (n, 0, payload)
 
     def _content(self, host, vs, n):
+        if vs.peer is not None:
+            # Real peer: the bytes ARE the opposite endpoint's sent stream.
+            out = bytes(vs.peer.sent[vs.recv_cursor:vs.recv_cursor + n])
+            assert len(out) == n, (
+                "device delivered bytes the real peer never wrote "
+                f"(cursor={vs.recv_cursor} n={n} peer_sent={len(vs.peer.sent)})")
+            return out
         if self.content_provider is None:
             return bytes(n)
         out = self.content_provider(host, vs, vs.recv_cursor, n)
         assert len(out) == n, "content provider returned wrong length"
         return out
+
+    def _find_child(self, p: RealProcess, vs: VSocket, regs) -> int | None:
+        """Lowest-child_order ESTABLISHED (or later) un-accepted child of
+        the listener at vs.slot; None if the accept queue is empty.
+        child_order is the SYN's packet id -- deterministic arrival order
+        (reference tcp.c child multiplexing orders the accept queue the
+        same way)."""
+        h = p.host
+        taken = self._accept_taken.setdefault(h, set())
+        st = regs["tcp_state"][h]
+        cand = (regs["parent"][h] == vs.slot) & ~regs["accepted"][h] & \
+            ((st == tcp.TCPS_ESTABLISHED) | (st == tcp.TCPS_CLOSEWAIT))
+        slots = np.flatnonzero(cand)
+        slots = [s for s in slots if s not in taken]
+        if not slots:
+            return None
+        order = regs["child_order"][h]
+        return int(min(slots, key=lambda s: (int(order[s]), s)))
+
+    def _try_accept(self, p: RealProcess, vs: VSocket, regs):
+        """Reply tuple for accept() if a child connection is ready."""
+        cslot = self._find_child(p, vs, regs)
+        if cslot is None:
+            return None
+        h = p.host
+        if p.next_vfd - VFD_BASE >= 4096:
+            return (-1, 24, b"")  # EMFILE
+        self._accept_taken.setdefault(h, set()).add(cslot)
+        self._pending.append(("accepted", h, cslot))
+        vfd = p.next_vfd
+        p.next_vfd += 1
+        child = VSocket(slot=cslot, vfd=vfd, local_port=vs.local_port,
+                        connected=True)
+        p.vfds[vfd] = child
+        # Real<->real pairing: the child's device registers carry the
+        # remote (host, port); if that endpoint is a real process it
+        # registered itself at connect time.
+        key = (int(regs["peer_host"][h, cslot]),
+               int(regs["peer_port"][h, cslot]), h, vs.local_port)
+        mate = self._conns.pop(key, None)  # pairing consumes the entry
+        if mate is not None:
+            child.peer = mate
+            mate.peer = child
+        return (vfd, 0, b"")
+
+    def _poll_check(self, p: RealProcess, entries, regs):
+        """Compute (nready, payload) for a poll entry list [(fd, events)].
+        Payload wire format matches the shim: per entry int32 revents,
+        int32 soerr."""
+        h = p.host
+        out = np.zeros(2 * len(entries), dtype=np.int32)
+        nready = 0
+        for i, (fd, events) in enumerate(entries):
+            vs = p.vfds.get(fd)
+            rev = 0
+            soerr = 0
+            if vs is None:
+                # Shim contract: non-virtual fds in a mixed set report
+                # not-ready (revents 0); only a DANGLING virtual fd (in
+                # the vfd range but unknown) is POLLNVAL.
+                if fd >= VFD_BASE:
+                    rev = POLLNVAL
+            elif vs.listening:
+                if self._find_child(p, vs, regs) is not None:
+                    rev |= POLLIN
+            else:
+                st = int(regs["tcp_state"][h, vs.slot])
+                err = int(regs["error"][h, vs.slot])
+                if vs.connecting:
+                    if st == tcp.TCPS_ESTABLISHED:
+                        vs.connecting = False
+                        vs.connected = True
+                    elif err != 0:
+                        vs.connecting = False
+                        rev |= POLLERR
+                        soerr = err
+                if not vs.connecting and not (rev & POLLERR):
+                    avail = self._avail(p, vs, regs)
+                    fin_done = self._fin_reached(
+                        int(regs["rcv_nxt"][h, vs.slot]),
+                        int(regs["fin_seq"][h, vs.slot]))
+                    if avail > 0 or (fin_done and avail <= 0) or \
+                            st in (tcp.TCPS_CLOSEWAIT, tcp.TCPS_LASTACK,
+                                   tcp.TCPS_CLOSED):
+                        rev |= POLLIN
+                    if err != 0:
+                        rev |= POLLERR
+                        soerr = err
+                    elif (vs.connected or st == tcp.TCPS_ESTABLISHED or
+                          st == tcp.TCPS_CLOSEWAIT) and not vs.closed and \
+                            self._room(p, vs, regs) > 0:
+                        rev |= POLLOUT
+            rev &= (events | POLLERR | POLLHUP | POLLNVAL)
+            if rev:
+                nready += 1
+            out[2 * i] = rev
+            out[2 * i + 1] = soerr
+        return nready, out.tobytes()
+
+    def _do_poll(self, p: RealProcess, data: bytes, timeout_ms: int,
+                 regs, now_ns: int):
+        arr = np.frombuffer(data, dtype=np.int32)
+        entries = [(int(arr[2 * i]), int(arr[2 * i + 1]))
+                   for i in range(len(arr) // 2)]
+        nready, payload = self._poll_check(p, entries, regs)
+        if nready > 0 or timeout_ms == 0:
+            return (nready, 0, payload)
+        pk = Parked(OP_POLL)
+        pk.entries = entries  # type: ignore[attr-defined]
+        if timeout_ms > 0:
+            pk.wake_ns = now_ns + timeout_ms * 1_000_000
+        p.parked = pk
+        return None
 
     def _try_unpark(self, p: RealProcess, regs, now_ns):
         """If the parked syscall's condition now holds, produce its reply."""
@@ -396,10 +636,20 @@ class Substrate:
         pk = p.parked
         if pk.op == OP_SLEEP:
             return (0, 0, b"") if now_ns >= pk.wake_ns else None
+        if pk.op == OP_POLL:
+            entries = getattr(pk, "entries", [])
+            nready, payload = self._poll_check(p, entries, regs)
+            if nready > 0:
+                return (nready, 0, payload)
+            if pk.wake_ns >= 0 and now_ns >= pk.wake_ns:
+                return (0, 0, payload)  # timeout: all revents zero
+            return None
         vs = p.vfds.get(pk.fd)
         if vs is None:
             return (-1, 9, b"")
         h = p.host
+        if pk.op == OP_ACCEPT:
+            return self._try_accept(p, vs, regs)  # None = still parked
         if pk.op == OP_CONNECT:
             st = int(regs["tcp_state"][h, vs.slot])
             err = int(regs["error"][h, vs.slot])
@@ -440,7 +690,25 @@ class Substrate:
 
         for op in self._pending:
             kind = op[0]
-            if kind == "connect":
+            if kind == "reserve":
+                # Mark the slot taken (stype SOCK_TCP, state CLOSED) so the
+                # device's min-free child allocation can never collide with
+                # a socket the process created but hasn't connected yet.
+                from ..core.state import SOCK_TCP
+                _, h, slot = op
+                socks = socks.replace(
+                    stype=socks.stype.at[h, slot].set(SOCK_TCP))
+            elif kind == "listen":
+                _, h, slot, port, backlog = op
+                mask = np.zeros(hN, bool)
+                mask[h] = True
+                socks = tcp.listen_v(socks, jnp.asarray(mask), slot, port,
+                                     backlog)
+            elif kind == "accepted":
+                _, h, slot = op
+                socks = socks.replace(
+                    accepted=socks.accepted.at[h, slot].set(True))
+            elif kind == "connect":
                 _, h, slot, dst, dport, lport = op
                 mask = np.zeros(hN, bool)
                 mask[h] = True

@@ -242,6 +242,16 @@ def _apply_defaults(sv: _Sock, mask):
 # ---------------------------------------------------------------------------
 
 
+def data_end(socks: st.SocketTable):
+    """[H,S] u32: the sequence where readable DATA ends.  Once the peer's
+    FIN is processed rcv_nxt advances one PAST fin_seq (the FIN consumes a
+    sequence slot); stream readers must clamp at fin_seq or they hand the
+    application one phantom byte before EOF."""
+    return jnp.where(
+        (socks.fin_seq != 0) & (_sdiff(socks.fin_seq, socks.rcv_nxt) <= 0),
+        socks.fin_seq, socks.rcv_nxt)
+
+
 def listen(socks: st.SocketTable, host: int, slot: int, port: int,
            backlog: int = 64) -> st.SocketTable:
     """Setup-time: make (host, slot) a TCP listener on `port`."""

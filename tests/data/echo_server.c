@@ -1,0 +1,53 @@
+/* A real TCP echo server run INSIDE the simulation (tests/test_substrate.py).
+ *
+ * Counterpart of tests/data/eof_client.c: socket/bind/listen/accept served
+ * by the simulator's modeled listener + child-socket machinery, read/write
+ * timed by the device TCP stack.  With a real client on the other host the
+ * bytes it reads are the bytes that client actually sent (real<->real
+ * payload streams).  Exits 0 after serving `nconns` connections to EOF.
+ */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc < 2) return 2;
+  int port = atoi(argv[1]);
+  int nconns = argc > 2 ? atoi(argv[2]) : 1;
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) return 3;
+  struct sockaddr_in a = {0};
+  a.sin_family = AF_INET;
+  a.sin_addr.s_addr = htonl(INADDR_ANY);
+  a.sin_port = htons(port);
+  if (bind(lfd, (struct sockaddr *)&a, sizeof a) != 0) return 4;
+  if (listen(lfd, 8) != 0) return 5;
+
+  long long served = 0;
+  for (int c = 0; c < nconns; c++) {
+    int fd = accept(lfd, NULL, NULL);
+    if (fd < 0) return 6;
+    char buf[1024];
+    for (;;) {
+      ssize_t n = recv(fd, buf, sizeof buf, 0);
+      if (n < 0) return 7;
+      if (n == 0) break; /* client EOF */
+      ssize_t off = 0;
+      while (off < n) {
+        ssize_t w = send(fd, buf + off, n - off, 0);
+        if (w <= 0) return 8;
+        off += w;
+      }
+      served += n;
+    }
+    close(fd);
+  }
+  close(lfd);
+  printf("echo_server ok conns=%d bytes=%lld\n", nconns, served);
+  return 0;
+}

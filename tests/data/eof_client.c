@@ -1,0 +1,57 @@
+/* Half-close + read-until-EOF client (tests/test_substrate.py).
+ *
+ * Sends a patterned stream, shutdown(SHUT_WR), then reads until EOF and
+ * verifies the echo byte-for-byte.  Regression shape for the FIN
+ * off-by-one: counting the FIN's sequence slot as readable data makes
+ * this client observe one phantom byte before EOF (exit 8/9 below).
+ */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc < 4) return 2;
+  const char *ip = argv[1];
+  int port = atoi(argv[2]);
+  int total = atoi(argv[3]);
+
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 3;
+  struct sockaddr_in a = {0};
+  a.sin_family = AF_INET;
+  a.sin_port = htons(port);
+  if (inet_pton(AF_INET, ip, &a.sin_addr) != 1) return 4;
+  if (connect(fd, (struct sockaddr *)&a, sizeof a) != 0) return 5;
+
+  char buf[512];
+  int sent = 0;
+  while (sent < total) {
+    int chunk = total - sent;
+    if (chunk > (int)sizeof buf) chunk = (int)sizeof buf;
+    for (int i = 0; i < chunk; i++) buf[i] = (char)('A' + ((sent + i) % 23));
+    ssize_t n = send(fd, buf, chunk, 0);
+    if (n <= 0) return 6;
+    sent += (int)n;
+  }
+  if (shutdown(fd, SHUT_WR) != 0) return 7;
+
+  int got = 0;
+  for (;;) {
+    ssize_t n = recv(fd, buf, sizeof buf, 0);
+    if (n < 0) return 8;
+    if (n == 0) break; /* EOF */
+    for (int i = 0; i < (int)n; i++)
+      if (buf[i] != (char)('A' + ((got + i) % 23))) return 9;
+    got += (int)n;
+    if (got > total) return 10; /* phantom bytes past the stream end */
+  }
+  if (got != total) return 11;
+
+  printf("eof_client ok bytes=%d\n", got);
+  close(fd);
+  return 0;
+}

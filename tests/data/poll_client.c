@@ -1,0 +1,99 @@
+/* Event-driven poll(2) client multiplexing several concurrent streams
+ * (tests/test_substrate.py).  The shape real tgen/Tor-style plugins are
+ * written in: nonblocking connect -> EINPROGRESS -> poll for writability
+ * -> getsockopt(SO_ERROR) -> interleaved nonblocking send/recv driven by
+ * one poll loop.  Exercises OP_POLL readiness-set parking in the bridge.
+ * Exits 0 iff every stream's echo comes back byte-exact.
+ */
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#define MAXS 16
+
+static char pat(int stream, int off) { return (char)('a' + (off * 7 + stream) % 26); }
+
+int main(int argc, char **argv) {
+  if (argc < 5) return 2;
+  const char *ip = argv[1];
+  int port = atoi(argv[2]);
+  int ns = atoi(argv[3]);
+  int total = atoi(argv[4]);
+  if (ns > MAXS) return 2;
+
+  struct sockaddr_in a = {0};
+  a.sin_family = AF_INET;
+  a.sin_port = htons(port);
+  if (inet_pton(AF_INET, ip, &a.sin_addr) != 1) return 3;
+
+  int fd[MAXS], sent[MAXS], got[MAXS], connected[MAXS], done[MAXS];
+  for (int i = 0; i < ns; i++) {
+    fd[i] = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd[i] < 0) return 4;
+    if (fcntl(fd[i], F_SETFL, O_NONBLOCK) != 0) return 5;
+    int r = connect(fd[i], (struct sockaddr *)&a, sizeof a);
+    if (r != 0 && errno != EINPROGRESS) return 6;
+    connected[i] = (r == 0);
+    sent[i] = got[i] = done[i] = 0;
+  }
+
+  int ndone = 0, rounds = 0;
+  while (ndone < ns && rounds++ < 100000) {
+    struct pollfd pf[MAXS];
+    int np = 0, map[MAXS];
+    for (int i = 0; i < ns; i++) {
+      if (done[i]) continue;
+      pf[np].fd = fd[i];
+      pf[np].events = POLLIN;
+      if (!connected[i] || sent[i] < total) pf[np].events |= POLLOUT;
+      pf[np].revents = 0;
+      map[np++] = i;
+    }
+    int pr = poll(pf, np, 5000);
+    if (pr < 0) return 7;
+    for (int k = 0; k < np; k++) {
+      int i = map[k];
+      if (pf[k].revents & (POLLERR | POLLNVAL)) return 8;
+      if (!connected[i] && (pf[k].revents & POLLOUT)) {
+        int err = -1;
+        socklen_t el = sizeof err;
+        if (getsockopt(fd[i], SOL_SOCKET, SO_ERROR, &err, &el) != 0) return 9;
+        if (err != 0) return 10;
+        connected[i] = 1;
+      }
+      if (connected[i] && sent[i] < total && (pf[k].revents & POLLOUT)) {
+        char buf[256];
+        int chunk = total - sent[i];
+        if (chunk > (int)sizeof buf) chunk = (int)sizeof buf;
+        for (int j = 0; j < chunk; j++) buf[j] = pat(i, sent[i] + j);
+        ssize_t n = send(fd[i], buf, chunk, 0);
+        if (n < 0 && errno != EAGAIN) return 11;
+        if (n > 0) sent[i] += (int)n;
+      }
+      if (pf[k].revents & POLLIN) {
+        char buf[256];
+        ssize_t n = recv(fd[i], buf, sizeof buf, 0);
+        if (n < 0 && errno != EAGAIN) return 12;
+        for (int j = 0; j < (int)n; j++)
+          if (buf[j] != pat(i, got[i] + j)) return 13;
+        if (n > 0) got[i] += (int)n;
+        if (got[i] > total) return 14;
+        if (got[i] == total) {
+          close(fd[i]);
+          done[i] = 1;
+          ndone++;
+        }
+      }
+    }
+  }
+  if (ndone != ns) return 15;
+  printf("poll_client ok streams=%d bytes=%d\n", ns, ns * total);
+  return 0;
+}
