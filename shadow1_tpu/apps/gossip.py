@@ -1,0 +1,291 @@
+"""Bitcoin-style P2P gossip: inv/getdata flood over a many-peer overlay.
+
+The missing traffic shape from the measured ladder (BASELINE config 4, a
+~500-node Bitcoin network): every node keeps 8-16 peers; a node that
+originates or learns of an item announces it (`inv`, small message) to its
+peers; a peer that hasn't seen the item requests it (`getdata`) from the
+announcer, which replies with the item body; receipt triggers the
+receiver's own announcement round.  Fan-out floods of small messages --
+nothing like tgen streams (few long TCP flows) or onion chains (relay
+pipelines).
+
+TPU-first shape: the whole protocol is a per-(host, item) state machine in
+dense [H, ITEMS] arrays advanced by masked vector ops inside the engine
+micro-step.  Each host emits ONE datagram per paced tick (the engine's
+deterministic SLOT_APP lane); an announcement round to D peers therefore
+spreads over D ticks, which is also how a real node serializes onto its
+uplink.  Message identity rides the UDP source port (type + item id), so
+no payload bytes are needed on device.
+
+Reference analog: the workload class of BASELINE.json configs[3]; the
+per-connection version-handshake/inv/getdata exchange a Bitcoin plugin
+performs over the reference's TCP stack is modeled at the gossip layer
+(message counts, sizes, and fan-out degree), not the wire layer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from flax import struct
+import jax.numpy as jnp
+
+from ..core import emit, simtime
+from ..core.state import I32, I64, U32
+from ..transport import udp
+
+GOSSIP_PORT = 8333          # where every node's wildcard socket binds
+SPORT_BASE = 30000          # sport = SPORT_BASE + item * 3 + msg type
+
+# Message types (encoded in sport).
+MSG_INV, MSG_GETDATA, MSG_ITEM = 0, 1, 2
+INV_BYTES = 61              # inv/getdata wire sizes (24B header + payload)
+GETDATA_BYTES = 61
+ITEM_BYTES = 512            # a transaction-sized item body
+
+# Per-(host, item) phases.
+PH_UNKNOWN, PH_WANT, PH_REQUESTED, PH_HAVE = 0, 1, 2, 3
+
+
+@struct.dataclass
+class GossipState:
+    # -- static overlay + schedule (constant for the run) --
+    peers: jnp.ndarray      # [H, D] i32 peer host ids, valid entries packed
+                            # left, -1 padding
+    deg: jnp.ndarray        # [H] i32 number of valid peers
+    origin: jnp.ndarray     # [ITEMS] i32 originating host per item
+    birth: jnp.ndarray      # [ITEMS] i64 origination time per item
+    # -- protocol state --
+    phase: jnp.ndarray      # [H, ITEMS] i32 PH_*
+    src: jnp.ndarray        # [H, ITEMS] i32 who announced it to us / -1
+    inv_ptr: jnp.ndarray    # [H, ITEMS] i32 next peer index to announce to
+    req_mask: jnp.ndarray   # [H, ITEMS] u32 bitmask of peer indices whose
+                            # getdata we still owe an item body
+    next_t: jnp.ndarray     # [H] i64 next paced send slot
+    # -- counters --
+    msgs_sent: jnp.ndarray  # [H] i64
+    msgs_recv: jnp.ndarray  # [H] i64
+
+
+class Gossip:
+    """Static app config; hashable so jitted engine calls cache per config."""
+
+    uses_tcp = False
+    may_loopback = False
+    rx_batch = 4
+
+    def __init__(self, pace_ns: int = 50 * simtime.SIMTIME_ONE_MICROSECOND):
+        self.pace_ns = int(pace_ns)
+
+    def __hash__(self):
+        return hash(("gossip", self.pace_ns))
+
+    def __eq__(self, other):
+        return isinstance(other, Gossip) and other.pace_ns == self.pace_ns
+
+    # -- engine hooks -------------------------------------------------------
+
+    def _pending(self, a):
+        """[H, ITEMS] per-type pending-work masks."""
+        owe_item = a.req_mask != 0
+        want = a.phase == PH_WANT
+        announce = (a.phase == PH_HAVE) & (a.inv_ptr < a.deg[:, None])
+        return owe_item, want, announce
+
+    def next_time(self, state):
+        a = state.app
+        owe_item, want, announce = self._pending(a)
+        has_work = (owe_item | want | announce).any(axis=1)
+        t = jnp.where(has_work, a.next_t,
+                      jnp.asarray(simtime.SIMTIME_INVALID, I64))
+        # Unborn items wake their origin at birth.
+        h = a.next_t.shape[0]
+        mine = (a.origin[None, :] == jnp.arange(h, dtype=I32)[:, None]) & \
+            (a.phase == PH_UNKNOWN)
+        birth_t = jnp.min(jnp.where(mine, a.birth[None, :],
+                                    jnp.asarray(simtime.SIMTIME_INVALID, I64)),
+                          axis=1)
+        return jnp.minimum(t, birth_t)
+
+    def on_tick(self, state, params, em, tick_t, active):
+        a = state.app
+        socks = state.socks
+        h, items = a.phase.shape
+        rows = jnp.arange(h, dtype=I32)
+        slot = jnp.zeros((h,), I32)
+
+        # ---- birth: originate due items (content appears from thin air) --
+        mine = (a.origin[None, :] == rows[:, None]) & \
+            (a.phase == PH_UNKNOWN) & (a.birth[None, :] <= tick_t[:, None]) & \
+            active[:, None]
+        a = a.replace(
+            phase=jnp.where(mine, PH_HAVE, a.phase),
+            inv_ptr=jnp.where(mine, 0, a.inv_ptr),
+            src=jnp.where(mine, -1, a.src))
+
+        # ---- receive: drain up to rx_batch datagrams ----------------------
+        for _ in range(self.rx_batch):
+            socks, got, src, sport, _len, _pid = udp.pop_ring(
+                socks, active, slot)
+            code = sport - SPORT_BASE
+            item = jnp.clip(code // 3, 0, items - 1)
+            mtype = code % 3
+            onehot = (jnp.arange(items, dtype=I32)[None, :] == item[:, None])
+
+            ph_i = jnp.take_along_axis(a.phase, item[:, None], 1)[:, 0]
+
+            # inv: unknown -> want(src)
+            inv_new = got & (mtype == MSG_INV) & (ph_i == PH_UNKNOWN)
+            a = a.replace(
+                phase=jnp.where(inv_new[:, None] & onehot, PH_WANT, a.phase),
+                src=jnp.where(inv_new[:, None] & onehot, src[:, None], a.src))
+
+            # getdata: mark the requesting peer's bit (requester must be a
+            # peer -- it got our inv); unknown requesters are dropped.
+            k = jnp.argmax(a.peers == src[:, None], axis=1).astype(I32)
+            k_ok = jnp.take_along_axis(a.peers, k[:, None], 1)[:, 0] == src
+            gd = got & (mtype == MSG_GETDATA) & k_ok & (ph_i == PH_HAVE)
+            bit = (jnp.uint32(1) << k.astype(U32))
+            a = a.replace(req_mask=jnp.where(
+                gd[:, None] & onehot, a.req_mask | bit[:, None], a.req_mask))
+
+            # item body: want/requested -> have, start announcing.
+            it = got & (mtype == MSG_ITEM) & \
+                ((ph_i == PH_WANT) | (ph_i == PH_REQUESTED))
+            a = a.replace(
+                phase=jnp.where(it[:, None] & onehot, PH_HAVE, a.phase),
+                inv_ptr=jnp.where(it[:, None] & onehot, 0, a.inv_ptr))
+
+            a = a.replace(msgs_recv=a.msgs_recv + got.astype(I64))
+
+        # ---- send: one paced message per host, deterministic priority ----
+        # item replies first (latency of the flood), then getdata, then inv;
+        # within a type, lowest item id.
+        owe_item, want, announce = self._pending(a)
+        due = active & (a.next_t <= tick_t)
+
+        def first_item(mask):
+            idx = jnp.argmax(mask, axis=1).astype(I32)
+            return idx, jnp.take_along_axis(mask, idx[:, None], 1)[:, 0]
+
+        it_i, it_ok = first_item(owe_item)
+        gd_i, gd_ok = first_item(want)
+        inv_i, inv_ok = first_item(announce)
+
+        choice = jnp.where(it_ok, 0, jnp.where(gd_ok, 1,
+                                               jnp.where(inv_ok, 2, 3)))
+        sel_item = jnp.where(choice == 0, it_i,
+                             jnp.where(choice == 1, gd_i, inv_i))
+        sel_oh = (jnp.arange(items, dtype=I32)[None, :] == sel_item[:, None])
+
+        # item reply: lowest requester bit.
+        rm = jnp.take_along_axis(a.req_mask, sel_item[:, None], 1)[:, 0]
+        low_k = _ctz32(rm)
+        dst_item = _peer_at(a.peers, low_k)
+        # getdata: to the announcer.
+        dst_gd = jnp.take_along_axis(a.src, sel_item[:, None], 1)[:, 0]
+        # inv: to peer[inv_ptr], skipping whoever gave us the item.
+        ptr = jnp.take_along_axis(a.inv_ptr, sel_item[:, None], 1)[:, 0]
+        dst_inv = _peer_at(a.peers, ptr)
+        skip_inv = dst_inv == jnp.take_along_axis(
+            a.src, sel_item[:, None], 1)[:, 0]
+
+        send = due & (choice < 3)
+        dst = jnp.where(choice == 0, dst_item,
+                        jnp.where(choice == 1, dst_gd, dst_inv))
+        mtype_out = jnp.where(choice == 0, MSG_ITEM,
+                              jnp.where(choice == 1, MSG_GETDATA, MSG_INV))
+        length = jnp.where(choice == 0, ITEM_BYTES,
+                           jnp.where(choice == 1, GETDATA_BYTES, INV_BYTES))
+        emit_ok = send & (dst >= 0) & ~((choice == 2) & skip_inv)
+
+        em = emit.put(
+            em, emit_ok, emit.SLOT_APP,
+            dst=dst, sport=SPORT_BASE + sel_item * 3 + mtype_out,
+            dport=GOSSIP_PORT, proto=17, length=length)
+
+        # consume the action
+        sent1 = send[:, None] & sel_oh
+        a = a.replace(
+            req_mask=jnp.where(sent1 & (choice == 0)[:, None],
+                               a.req_mask & ~(jnp.uint32(1) <<
+                                              low_k.astype(U32))[:, None],
+                               a.req_mask),
+            phase=jnp.where(sent1 & (choice == 1)[:, None], PH_REQUESTED,
+                            a.phase),
+            inv_ptr=jnp.where(sent1 & (choice == 2)[:, None],
+                              a.inv_ptr + 1, a.inv_ptr),
+            next_t=jnp.where(send, tick_t + self.pace_ns, a.next_t),
+            msgs_sent=a.msgs_sent + emit_ok.astype(I64))
+
+        return state.replace(app=a, socks=socks), em
+
+
+def _ctz32(x):
+    """Count trailing zeros of a u32 (index of lowest set bit; 32 if 0).
+    A 5-step shift ladder over the isolated lowest bit -- exact for u32."""
+    low = x & (~x + jnp.uint32(1))
+    n = jnp.zeros_like(x, I32)
+    for shift in (16, 8, 4, 2, 1):
+        big = (low >> shift) != 0
+        n = n + jnp.where(big, shift, 0)
+        low = jnp.where(big, low >> shift, low)
+    return jnp.where(x == 0, 32, n)
+
+
+def _peer_at(peers, k):
+    kk = jnp.clip(k, 0, peers.shape[1] - 1)
+    return jnp.take_along_axis(peers, kk[:, None], 1)[:, 0]
+
+
+def build_overlay(num_hosts: int, degree: int, seed: int):
+    """Symmetric overlay: ring (connectivity) + random chords to ~degree.
+    Returns (peers [H,D] i32 packed-left -1-padded, deg [H] i32)."""
+    if degree + 2 > 32:
+        # req_mask is a u32 bitmask over peer indices; build_overlay can
+        # exceed `degree` by up to 2 while symmetrizing.
+        raise ValueError(f"gossip degree {degree} too large: peer count "
+                         f"must stay <= 32 (u32 request bitmask)")
+    rng = np.random.default_rng((seed, 0xB17C0))
+    adj = [set() for _ in range(num_hosts)]
+    for i in range(num_hosts):
+        adj[i].add((i + 1) % num_hosts)
+        adj[(i + 1) % num_hosts].add(i)
+    for i in range(num_hosts):
+        tries = 0
+        while len(adj[i]) < degree and tries < 64:
+            j = int(rng.integers(0, num_hosts))
+            tries += 1
+            if j == i or j in adj[i] or len(adj[j]) >= degree + 2:
+                continue
+            adj[i].add(j)
+            adj[j].add(i)
+    d = max(len(s) for s in adj)
+    peers = np.full((num_hosts, d), -1, np.int32)
+    deg = np.zeros(num_hosts, np.int32)
+    for i, s in enumerate(adj):
+        lst = sorted(s)
+        peers[i, :len(lst)] = lst
+        deg[i] = len(lst)
+    return peers, deg
+
+
+def init_state(num_hosts: int, degree: int, num_items: int,
+               item_interval_ns: int, seed: int,
+               first_birth_ns: int = simtime.SIMTIME_ONE_MILLISECOND):
+    peers, deg = build_overlay(num_hosts, degree, seed)
+    rng = np.random.default_rng((seed, 0xB17C1))
+    origin = rng.integers(0, num_hosts, num_items).astype(np.int32)
+    birth = (first_birth_ns +
+             np.arange(num_items, dtype=np.int64) * item_interval_ns)
+    h, items = num_hosts, num_items
+    return GossipState(
+        peers=jnp.asarray(peers), deg=jnp.asarray(deg),
+        origin=jnp.asarray(origin), birth=jnp.asarray(birth),
+        phase=jnp.zeros((h, items), I32),
+        src=jnp.full((h, items), -1, I32),
+        inv_ptr=jnp.zeros((h, items), I32),
+        req_mask=jnp.zeros((h, items), U32),
+        next_t=jnp.zeros((h,), I64),
+        msgs_sent=jnp.zeros((h,), I64),
+        msgs_recv=jnp.zeros((h,), I64),
+    )

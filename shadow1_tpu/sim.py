@@ -125,6 +125,44 @@ def build_bulk(num_hosts: int,
     return state, params, app
 
 
+def build_gossip(num_hosts: int = 500,
+                 degree: int = 12,
+                 num_items: int = 32,
+                 item_interval_ns: int = 200 * simtime.SIMTIME_ONE_MILLISECOND,
+                 latency_ns: int = 40 * simtime.SIMTIME_ONE_MILLISECOND,
+                 reliability: float = 1.0,
+                 stop_time: int = 30 * simtime.SIMTIME_ONE_SECOND,
+                 seed: int = 1,
+                 pool_slab: int = 64,
+                 bw_Bps: int = 1 << 27):
+    """Bitcoin-style gossip world (apps/gossip.py): `num_hosts` nodes on a
+    `degree`-peer overlay flooding `num_items` inv/getdata/item exchanges.
+    The 500-node rung of the measured ladder (BASELINE config 4)."""
+    from .apps import gossip as gossip_app
+
+    v = min(num_hosts, 256)
+
+    def _build():
+        lat, rel = uniform_full_mesh(v, latency_ns, reliability)
+        params = make_net_params(
+            latency_ns=lat, reliability=rel,
+            host_vertex=jnp.arange(num_hosts) % v,
+            bw_up_Bps=jnp.full(num_hosts, bw_Bps),
+            bw_down_Bps=jnp.full(num_hosts, bw_Bps),
+            seed=seed, stop_time=stop_time)
+        state = make_sim_state(num_hosts, sock_slots=2,
+                               pool_capacity=num_hosts * pool_slab)
+        state = state.replace(
+            socks=udp.open_bind_all(state.socks, slot=0,
+                                    port=gossip_app.GOSSIP_PORT))
+        state = state.replace(app=gossip_app.init_state(
+            num_hosts, degree, num_items, item_interval_ns, seed))
+        return state, params
+
+    state, params = _pkg.build_on_host(_build)
+    return state, params, gossip_app.Gossip()
+
+
 def run(state, params, app, until=None):
     t = params.stop_time if until is None else until
     return engine.run_until(state, params, app, t)

@@ -8,8 +8,9 @@ excluded; steady-state wall time per simulated second reported):
   rung 3: 1k-host Tor-like onion circuits (sim.build_onion(200))
   rung 4: phold event-rate probe          (bench.py metric)
   rung 5: 10k-host onion circuits         (sim.build_onion(2000))
+  rung 6: 500-node Bitcoin gossip flood   (sim.build_gossip(500))
 
-    python tools/ladder.py [rung ...]     # default: 1 2 3 5
+    python tools/ladder.py [rung ...]     # default: 1 2 3 5 6
 """
 
 from __future__ import annotations
@@ -77,8 +78,21 @@ def rung_onion(circuits: int, pool_slab: int = 64):
     return res
 
 
+def rung_gossip():
+    # BASELINE config 4's workload class: 500 nodes, 12 peers each,
+    # inv/getdata/item floods every 200 ms.
+    s, p, a = sim.build_gossip(num_hosts=500, degree=12, num_items=64,
+                               stop_time=30 * SEC)
+    res, out = _measure(s, p, a, 1, 10)
+    from shadow1_tpu.apps import gossip as _g
+    res["items_fully_flooded"] = int(
+        (out.app.phase == _g.PH_HAVE).all(axis=0).sum())
+    res["msgs"] = int(out.app.msgs_sent.sum())
+    return res
+
+
 def main(rungs):
-    unknown = set(rungs) - {"1", "2", "3", "4", "5"}
+    unknown = set(rungs) - {"1", "2", "3", "4", "5", "6"}
     if unknown:
         raise SystemExit(f"unknown ladder rungs: {sorted(unknown)}")
     results = {"backend": jax.default_backend()}
@@ -99,8 +113,10 @@ def main(rungs):
         record("phold_16k", rung_phold)
     if "5" in rungs:
         record("onion_10k", lambda: rung_onion(2000, pool_slab=32))
+    if "6" in rungs:
+        record("gossip_500", rung_gossip)
     print(json.dumps(results))
 
 
 if __name__ == "__main__":
-    main(sys.argv[1:] or ["1", "2", "3", "5"])
+    main(sys.argv[1:] or ["1", "2", "3", "5", "6"])
