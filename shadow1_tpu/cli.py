@@ -148,12 +148,13 @@ def run_config(args) -> int:
     while t < stop:
         # Advance one heartbeat interval (or to the end) per outer step so
         # the tracker samples between bounded device launches.
-        t_next = min(t + (tracker.interval_ns if tracker else stop), stop)
+        t_next = min(t + (tracker.sample_interval_ns if tracker else stop),
+                     stop)
         state = engine.run_chunked(state, params, app, t_next)
         t = t_next
         if tracker is not None and t >= hb_next:
             tracker.heartbeat(state, t)
-            hb_next = t + tracker.interval_ns
+            hb_next = t + tracker.sample_interval_ns
         if drain is not None:
             drain.drain(state)
     jax.block_until_ready(state)

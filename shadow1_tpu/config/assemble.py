@@ -175,6 +175,22 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
     else:
         params = _routing_and_params()
 
+    # --- connectivity validation (reference topology.c:371-560: a
+    # disconnected graph fails at load, not as silent INF latencies at
+    # send time).  Only vertices hosts actually attach to must be
+    # mutually routable.
+    used = np.unique(np.asarray(host_vertex))
+    routable = np.asarray(
+        apsp.is_routable(params.latency_ns)[jnp.asarray(used)][:, jnp.asarray(used)])
+    if not routable.all():
+        bad = np.argwhere(~routable)
+        vi, vj = used[bad[0][0]], used[bad[0][1]]
+        raise ValueError(
+            f"topology is not connected: no route between attached "
+            f"vertices {topo.names[vi]!r} and {topo.names[vj]!r} "
+            f"({len(bad)} unroutable attached-vertex pairs); every pair "
+            f"of vertices that hosts attach to must be connected")
+
     # --- processes -> modeled apps ---------------------------------------
     # Each distinct tgen arguments file is one parsed action graph; a
     # host's process points it at that graph.

@@ -389,6 +389,17 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
     # params.has_iface_buf; the default unbounded case traces it away).
     k2 = ib.order_keys().reshape(h, ki)
     if params.has_iface_buf:
+        # The deterministic tail-drop ranking materializes an [H, ki, ki]
+        # comparison cube per micro-step.  That is affordable only for
+        # modest inbox slabs; fail loudly at trace time instead of
+        # letting one configured host OOM/compile-explode a large world
+        # (tools/opbench.py economics; ADVICE r3).
+        if h * ki * ki > (1 << 28):
+            raise ValueError(
+                f"<host interfacebuffer> needs an [H={h}, k={ki}, k={ki}] "
+                f"ranking cube (> 2^28 elements) in the compiled step; "
+                f"shrink the inbox slab (--pool-slab) or drop the "
+                f"interfacebuffer bound for worlds this large")
         cap = params.iface_buf_pkts
         bounded = cap > 0
         later = due[:, None, :] & (

@@ -70,6 +70,12 @@ class Tracker:
         per = np.zeros(h, np.int64) if per_host_interval_s is None \
             else np.asarray(per_host_interval_s, np.int64)
         self.per_host_ns = np.where(per > 0, per * SEC, self.interval_ns)
+        # The cadence the RUN LOOP must sample at: the finest interval any
+        # host configured (else a host asking for finer-than-global rows
+        # silently got the coarser global cadence; ADVICE r3).
+        self.sample_interval_ns = int(min(self.interval_ns,
+                                          self.per_host_ns.min())) \
+            if h else self.interval_ns
         self._next_row = np.zeros(h, np.int64)
         self._last_row_t = np.zeros(h, np.int64)
         os.makedirs(data_dir, exist_ok=True)

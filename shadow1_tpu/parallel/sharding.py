@@ -31,7 +31,6 @@ from __future__ import annotations
 import functools
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import engine
@@ -47,21 +46,17 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (HOST_AXIS,))
 
 
-def _spec_for(path: str, leaf) -> P:
-    """Partition spec for one state leaf by its role."""
-    if not hasattr(leaf, "ndim") or leaf.ndim == 0:
-        return P()  # scalars replicate
-    return P(HOST_AXIS)  # leading axis is hosts (tables) or pool (packets)
-
-
 def shard_state(state, mesh: Mesh):
-    """Place a SimState onto the mesh per the layout policy."""
+    """Place a SimState onto the mesh: every array's leading axis is hosts
+    (tables) or pool (packets) and shards; scalars replicate.  Uniform by
+    design -- SimState's layout invariant is exactly 'leading axis is the
+    parallel axis' (core/state.py)."""
 
     def place(path, leaf):
         if leaf is None:
             return leaf
-        name = "/".join(str(p) for p in path)
-        spec = _spec_for(name, leaf)
+        spec = P() if (not hasattr(leaf, "ndim") or leaf.ndim == 0) \
+            else P(HOST_AXIS)
         if hasattr(leaf, "ndim") and leaf.ndim >= 1 and \
                 leaf.shape[0] % mesh.devices.size != 0:
             spec = P()  # non-divisible axes replicate (tiny test shapes)
@@ -70,20 +65,58 @@ def shard_state(state, mesh: Mesh):
     return jax.tree_util.tree_map_with_path(place, state)
 
 
+# Explicit per-leaf placement for NetParams.  Every leaf MUST appear here:
+# an unknown leaf is an error, not a guess -- a dtype/shape heuristic
+# silently misplacing a future field is the failure mode this table
+# exists to prevent.  P(HOST_AXIS) shards the leading axis ([H] vectors;
+# route_blk's [V*V] row axis); P() replicates (scalars, the PRNG key).
+PARAM_SPECS: dict[str, P] = {
+    "route_blk": P(HOST_AXIS),
+    "host_vertex": P(HOST_AXIS),
+    "bw_up_Bps": P(HOST_AXIS),
+    "bw_down_Bps": P(HOST_AXIS),
+    "cpu_ns_per_event": P(HOST_AXIS),
+    "autotune_snd": P(HOST_AXIS),
+    "autotune_rcv": P(HOST_AXIS),
+    "iface_buf_pkts": P(HOST_AXIS),
+    "pcap_mask": P(HOST_AXIS),
+    "seed_key": P(),
+    "min_latency_ns": P(),
+    "stop_time": P(),
+    "bootstrap_end": P(),
+    "cpu_threshold_ns": P(),
+    "cpu_precision_ns": P(),
+    "qdisc": P(),
+}
+
+
+def _leaf_name(path) -> str:
+    k = path[-1]
+    name = getattr(k, "name", None)
+    if name is None:
+        name = getattr(k, "key", None)
+    return str(name if name is not None else k)
+
+
 def shard_params(params, mesh: Mesh):
-    """Place NetParams: [V,V] matrices shard rows, [H] vectors shard,
-    scalars + key replicate."""
+    """Place NetParams onto the mesh via the explicit PARAM_SPECS table."""
     n = mesh.devices.size
 
     def place(path, leaf):
         if leaf is None:
             return leaf
-        if not hasattr(leaf, "ndim") or leaf.ndim == 0:
-            return jax.device_put(leaf, NamedSharding(mesh, P()))
-        if jnp.issubdtype(leaf.dtype, jnp.unsignedinteger) and leaf.ndim == 1:
-            # PRNG key data: replicate.
-            return jax.device_put(leaf, NamedSharding(mesh, P()))
-        spec = P(HOST_AXIS) if leaf.shape[0] % n == 0 else P()
+        name = _leaf_name(path)
+        try:
+            spec = PARAM_SPECS[name]
+        except KeyError:
+            raise ValueError(
+                f"NetParams leaf {name!r} has no entry in "
+                f"parallel.sharding.PARAM_SPECS; add an explicit "
+                f"placement for it (P(HOST_AXIS) to shard the leading "
+                f"axis, P() to replicate)") from None
+        if spec != P() and hasattr(leaf, "ndim") and (
+                leaf.ndim == 0 or leaf.shape[0] % n != 0):
+            spec = P()  # non-divisible axes replicate (tiny test shapes)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(place, params)

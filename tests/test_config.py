@@ -197,6 +197,17 @@ class TestConfigAttributes:
         assert bool(asm.params.pcap_mask[0])
         assert int(asm.heartbeat_freq_s[0]) == 5
 
+    def test_heartbeat_finer_than_global_drives_sampling(self, tmp_path):
+        # A host with heartbeatfrequency finer than the global interval
+        # must tighten the run loop's sampling cadence (not silently get
+        # the coarser global rows).
+        from shadow1_tpu.observe import Tracker
+        tr = Tracker(str(tmp_path / "hb"), ["a", "b"], interval_s=5,
+                     per_host_interval_s=[1, 0])
+        assert tr.sample_interval_ns == 1 * SEC
+        assert tr.per_host_ns[0] == 1 * SEC
+        assert tr.per_host_ns[1] == 5 * SEC  # default = global
+
     def test_unknown_attribute_warns(self, tmp_path, capsys):
         self._load(tmp_path, 'bogusattr="1"')
         err = capsys.readouterr().err
@@ -216,6 +227,36 @@ class TestConfigAttributes:
 
 
 class TestTgenDivergences:
+    def test_disconnected_topology_rejected(self, tmp_path):
+        # Reference behavior: a disconnected GraphML fails at LOAD
+        # (topology.c:371-560), not as silent INF latencies at send time.
+        cfg_path = tmp_path / "shadow.config.xml"
+        cfg_path.write_text("""<shadow stoptime="10">
+  <topology><![CDATA[<?xml version="1.0" encoding="utf-8"?>
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="ip" attr.type="string" for="node" id="d0" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d4" />
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d5" />
+  <graph edgedefault="undirected">
+    <node id="netA"><data key="d0">10.1.0.0</data></node>
+    <node id="netB"><data key="d0">10.2.0.0</data></node>
+    <edge source="netA" target="netA">
+      <data key="d4">10.0</data><data key="d5">0.0</data>
+    </edge>
+    <edge source="netB" target="netB">
+      <data key="d4">10.0</data><data key="d5">0.0</data>
+    </edge>
+  </graph>
+</graphml>
+]]></topology>
+  <host id="alpha" iphint="10.1.0.0"/>
+  <host id="beta" iphint="10.2.0.0"/>
+</shadow>""")
+        cfg = shadowxml.parse(str(cfg_path))
+        cfg.base_dir = str(tmp_path)
+        with pytest.raises(ValueError, match="not connected"):
+            assemble.build(cfg)
+
     def test_fanout_graph_rejected(self):
         from shadow1_tpu.apps import tgen as tgen_app
         xml = """

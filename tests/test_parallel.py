@@ -62,6 +62,35 @@ class TestShardedDeterminism:
         _assert_trees_equal(single, jax.device_get(sharded))
 
 
+class TestParamSpecs:
+    def test_every_netparams_leaf_has_explicit_spec(self):
+        # Placement is a name table, not a dtype heuristic: every leaf of
+        # a real NetParams must resolve, [H] vectors shard, scalars + the
+        # PRNG key replicate.
+        from jax.sharding import PartitionSpec as P
+        from shadow1_tpu.parallel import sharding as sh
+
+        mesh = make_mesh(jax.devices("cpu")[:8])
+        _, params, _ = sim.build_phold(
+            num_hosts=16, msgs_per_host=1,
+            stop_time=simtime.SIMTIME_ONE_SECOND)
+        placed = sh.shard_params(params, mesh)
+        hspec = P(sh.HOST_AXIS)
+        assert placed.host_vertex.sharding.spec == hspec
+        assert placed.bw_up_Bps.sharding.spec == hspec
+        assert placed.seed_key.sharding.spec == P()
+        assert placed.stop_time.sharding.spec == P()
+
+    def test_unknown_leaf_is_an_error_not_a_guess(self):
+        from shadow1_tpu.parallel import sharding as sh
+
+        mesh = make_mesh(jax.devices("cpu")[:8])
+        fake = {"host_vertex": jnp.zeros(16, jnp.int32),
+                "mystery_field": jnp.zeros(16, jnp.uint32)}
+        with pytest.raises(ValueError, match="mystery_field"):
+            sh.shard_params(fake, mesh)
+
+
 class TestDryrunEntry:
     def test_dryrun_multichip_self_provisions(self):
         # The driver imports and calls this directly; it must work even

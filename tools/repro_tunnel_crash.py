@@ -1,0 +1,64 @@
+"""Minimal repro for the 16 MiB-stream tunnel-backend crash.
+
+BASELINE.md known issue (round 3): the 10k-host onion world with 16 MiB
+streams -- i.e. receive-buffer autotune opening multi-megabyte windows --
+reproducibly crashes the TPU tunnel backend's worker ("kernel fault").
+The 1 MiB sizing is stable at every scale tried.
+
+This script bisects the trigger: it runs the SAME world shape at a small
+host count first (so a crash, if scale-independent, reproduces in
+seconds), then steps up.  Run it on the real chip ONLY when you are
+prepared for the tunnel worker to die (it wedges in-flight runs; the pool
+restarts workers, but give it a minute).  CPU backends run it safely --
+no crash has ever reproduced off-tunnel, which points at the tunnel
+backend, not XLA semantics.
+
+    PYTHONPATH=/root/.axon_site:. python tools/repro_tunnel_crash.py [max_circuits]
+
+Findings log (update as bisection narrows):
+  - r3: build_onion(2000, 16 MiB) crash on tunnel; 1 MiB ok.
+
+WORKAROUND (until the backend bug is isolated): autotune growth is
+already capped by transport/tcp.py SND_BUF_MAX/RCV_BUF_MAX (4/6 MiB);
+worlds that hit the crash can pin <host socketsendbuffer/
+socketrecvbuffer> in the config (disables autotune entirely, bounded
+windows) or lower those module caps.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import shadow1_tpu  # noqa: F401
+import jax
+
+from shadow1_tpu import sim
+from shadow1_tpu.core import engine, simtime
+
+SEC = simtime.SIMTIME_ONE_SECOND
+
+
+def attempt(circuits: int, mib: int, span_s: int = 5):
+    print(f"--- build_onion({circuits}, {mib} MiB): running {span_s} sim-s "
+          f"on {jax.default_backend()} ...", flush=True)
+    s, p, a = sim.build_onion(num_circuits=circuits,
+                              bytes_per_circuit=mib << 20,
+                              pool_slab=32, stop_time=120 * SEC)
+    t0 = time.perf_counter()
+    s = engine.run_until(s, p, a, span_s * SEC)
+    jax.block_until_ready(s)
+    print(f"    ok: wall={time.perf_counter() - t0:.1f}s "
+          f"err={int(s.err)} steps={int(s.n_steps)}", flush=True)
+
+
+def main(max_circuits: int):
+    for circuits in (50, 200, 1000, 2000):
+        if circuits > max_circuits:
+            break
+        attempt(circuits, 16)
+    print("no crash reproduced at this scale/backend")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 2000)

@@ -1,0 +1,152 @@
+"""Phase-level profile of the engine micro-step on the 10k-host onion
+world (ladder rung 5) -- the world the north star measures.
+
+Times while-loops of increasing phase subsets at a busy state (slope
+method, 50 vs 200 iterations) to attribute per-micro-step cost across
+rx / TCP timers / app / TCP transmit / staging / tx-drain.
+
+    PYTHONPATH=. python tools/stepprof_onion.py [num_circuits]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import shadow1_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from shadow1_tpu import sim
+from shadow1_tpu.core import emit, engine, simtime
+from shadow1_tpu.transport import tcp as tcp_mod
+
+I32, I64 = jnp.int32, jnp.int64
+SEC = simtime.SIMTIME_ONE_SECOND
+
+
+def timeloop(name, state0, params, app, body):
+    res = {}
+    for iters in (50, 200):
+        def run(st, th):
+            def cond(c):
+                return c[0] < iters
+
+            def b(c):
+                i, s, t = c
+                s, t = body(s, t)
+                return i + 1, s, t
+
+            return jax.lax.while_loop(cond, b,
+                                      (jnp.asarray(0, I32), st, th))
+
+        jf = jax.jit(run)
+        th0, _ = engine._scan_all(state0, params, app)
+        out = jf(state0, th0)
+        np.asarray(out[1].now)
+        ts = []
+        for trial in range(2):
+            st2 = state0.replace(now=state0.now + trial)
+            t0 = time.perf_counter()
+            out = jf(st2, th0)
+            np.asarray(out[1].now)
+            ts.append(time.perf_counter() - t0)
+        res[iters] = min(ts)
+    slope = (res[200] - res[50]) / 150 * 1e3
+    print(f"{name:48s} {slope:8.3f} ms/iter", flush=True)
+    return slope
+
+
+def main(circuits: int):
+    state, params, app = sim.build_onion(
+        num_circuits=circuits, bytes_per_circuit=1 << 20,
+        pool_slab=32, stop_time=120 * SEC)
+    # Into the busy phase: clients started, streams flowing.
+    state = engine.run_until(state, params, app, 2 * SEC)
+    jax.block_until_ready(state)
+    print(f"hosts={state.hosts.num_hosts} steps_so_far={int(state.n_steps)}")
+    we = jnp.asarray(120 * SEC, I64)
+    h = state.hosts.num_hosts
+    n_lanes = emit.NUM_SLOTS + max(0, int(getattr(app, "rx_batch", 1)) - 1)
+
+    def scan(s):
+        return engine._scan_all(s, params, app)
+
+    def base(s, th):
+        active = th < we
+        tick = jnp.where(active, th, we)
+        em = emit.empty(h, n_lanes)
+        return s, em, tick, active
+
+    def v_scan(s, th):
+        s = s.replace(hosts=s.hosts.replace(
+            t_resume=jnp.minimum(s.hosts.t_resume, th)))
+        th2, _ = scan(s)
+        return s, th2
+
+    def v_rx(s, th):
+        s, em, tick, active = base(s, th)
+        s, em, _d, _tp = engine._rx_phase(s, params, em, tick, active, app,
+                                          we)
+        th2, _ = scan(s)
+        return s, th2
+
+    def v_timers(s, th):
+        s, em, tick, active = base(s, th)
+        s, em, _d, tp = engine._rx_phase(s, params, em, tick, active, app,
+                                         we)
+        s, em = tcp_mod.run_timers(s, params, em, tp, active)
+        th2, _ = scan(s)
+        return s, th2
+
+    def v_app(s, th):
+        s, em, tick, active = base(s, th)
+        s, em, _d, tp = engine._rx_phase(s, params, em, tick, active, app,
+                                         we)
+        s, em = tcp_mod.run_timers(s, params, em, tp, active)
+        s, em = app.on_tick(s, params, em, tp, active)
+        th2, _ = scan(s)
+        return s, th2
+
+    def v_transmit(s, th):
+        s, em, tick, active = base(s, th)
+        s, em, _d, tp = engine._rx_phase(s, params, em, tick, active, app,
+                                         we)
+        s, em = tcp_mod.run_timers(s, params, em, tp, active)
+        s, em = app.on_tick(s, params, em, tp, active)
+        s, em = tcp_mod.transmit(s, params, em, tp, active)
+        th2, _ = scan(s)
+        return s, th2
+
+    def v_stage(s, th):
+        s, em, tick, active = base(s, th)
+        s, em, _d, tp = engine._rx_phase(s, params, em, tick, active, app,
+                                         we)
+        s, em = tcp_mod.run_timers(s, params, em, tp, active)
+        s, em = app.on_tick(s, params, em, tp, active)
+        s, em = tcp_mod.transmit(s, params, em, tp, active)
+        s, _p = engine._stage_emissions(s, params, em, tp, active, app)
+        th2, _ = scan(s)
+        return s, th2
+
+    def v_full(s, th):
+        s = engine._microstep_core(s, params, app, th, we)
+        th2, _ = scan(s)
+        return s, th2
+
+    t = {}
+    t["scan"] = timeloop("scan only", state, params, app, v_scan)
+    t["rx"] = timeloop("+ rx_phase", state, params, app, v_rx)
+    t["timers"] = timeloop("+ tcp timers", state, params, app, v_timers)
+    t["app"] = timeloop("+ app on_tick", state, params, app, v_app)
+    t["tx"] = timeloop("+ tcp transmit", state, params, app, v_transmit)
+    t["stage"] = timeloop("+ stage_emissions", state, params, app, v_stage)
+    t["full"] = timeloop("full microstep (+tx_drain)", state, params, app,
+                         v_full)
+    print("deltas:", {k: round(v, 2) for k, v in t.items()})
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 2000)
