@@ -180,10 +180,14 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
     # send time).  Only vertices hosts actually attach to must be
     # mutually routable.
     used = np.unique(np.asarray(host_vertex))
-    routable = np.asarray(
+    routable = np.array(  # writable copy: the diagonal is cleared below
         apsp.is_routable(params.latency_ns)[jnp.asarray(used)][:, jnp.asarray(used)])
+    # Diagonal excluded: same-host loopback never consults the latency
+    # matrix, so an isolated single-attached vertex is fine.
+    np.fill_diagonal(routable, True)
     if not routable.all():
         bad = np.argwhere(~routable)
+        bad = bad[bad[:, 0] < bad[:, 1]]  # symmetric: count each pair once
         vi, vj = used[bad[0][0]], used[bad[0][1]]
         raise ValueError(
             f"topology is not connected: no route between attached "

@@ -592,13 +592,28 @@ def process_arrivals(state, params, em, tick_t, pkt, mask,
     # --- sender-side SACK (reference selectiveACKs -> remora tally,
     # tcp.c:192-205, tcp_retransmit_tally.cc:177-285): fold the advertised
     # blocks into the sender scoreboard; retransmission skips them.
-    sv.ssack_lo, sv.ssack_hi = _ranges_insert_many(
-        sv.ssack_lo, sv.ssack_hi,
-        [ackp & (pkt.sack_lo[:, i] != pkt.sack_hi[:, i])
-         for i in range(st.SACK_BLOCKS)],
-        [pkt.sack_lo[:, i] for i in range(st.SACK_BLOCKS)],
-        [pkt.sack_hi[:, i] for i in range(st.SACK_BLOCKS)],
-        sv.snd_una)
+    # HEADER-PREDICTION GATE: the insert's sort+merge pass is ~1.3-2ms at
+    # 10k hosts (tools/stepprof_onion.py round-4 profile: the two
+    # scoreboard inserts were ~all of the 13.7ms rx phase), while segments
+    # actually CARRYING SACK blocks only exist after loss.  Skip the whole
+    # pass unless some arrival advertises a block; the skip is exact --
+    # with no insertions the pass only re-packs/re-sorts entries, which
+    # every consumer is indifferent to (valid entries keep relative
+    # order; the hop loop and drain skip empties).
+    sack_masks = [ackp & (pkt.sack_lo[:, i] != pkt.sack_hi[:, i])
+                  for i in range(st.SACK_BLOCKS)]
+
+    def _ins_ss(args):
+        lo, hi = args
+        return _ranges_insert_many(
+            lo, hi, sack_masks,
+            [pkt.sack_lo[:, i] for i in range(st.SACK_BLOCKS)],
+            [pkt.sack_hi[:, i] for i in range(st.SACK_BLOCKS)],
+            sv.snd_una)
+
+    sv.ssack_lo, sv.ssack_hi = jax.lax.cond(
+        jnp.any(jnp.stack(sack_masks, axis=1)), _ins_ss, lambda a: a,
+        (sv.ssack_lo, sv.ssack_hi))
     # Ranges at/below the cumulative ACK are dead.
     dead = _seq_leq(sv.ssack_hi, p_ack[:, None]) & \
         (sv.ssack_lo != sv.ssack_hi) & ackp[:, None]
@@ -683,15 +698,30 @@ def process_arrivals(state, params, em, tick_t, pkt, mask,
     old_data = can_rcv & (new_bytes <= 0)
     ooo_ok = can_rcv & (off > 0) & fits
 
-    sv.sack_lo, sv.sack_hi = _ranges_insert(
-        sv.sack_lo, sv.sack_hi, ooo_ok, p_seq, end_seq, sv.rcv_nxt)
+    # OOO insert + drain gated like the sender scoreboard above: both
+    # only do work when segments arrive out of order (loss/reordering),
+    # and both cost a sort/shift cascade that dominates the in-order
+    # fast path if run unconditionally.
+    def _ins_rx(args):
+        lo, hi = args
+        return _ranges_insert(lo, hi, ooo_ok, p_seq, end_seq, sv.rcv_nxt)
+
+    sv.sack_lo, sv.sack_hi = jax.lax.cond(
+        jnp.any(ooo_ok), _ins_rx, lambda a: a, (sv.sack_lo, sv.sack_hi))
     sv.setwhere(in_adv, ts_recent=p_ts)
     adv = jnp.where(in_adv, new_bytes, 0)
     sv.setwhere(in_adv, rcv_nxt=(sv.rcv_nxt + adv.astype(U32)))
+
     # Drain any scoreboard ranges the advance reached (the cumulative-ACK
     # jump after a hole fills).
-    sv.sack_lo, sv.sack_hi, new_nxt, drained = _ranges_drain(
-        sv.sack_lo, sv.sack_hi, sv.rcv_nxt, in_adv)
+    def _drain(args):
+        lo, hi, nxt = args
+        return _ranges_drain(lo, hi, nxt, in_adv)
+
+    sv.sack_lo, sv.sack_hi, new_nxt, drained = jax.lax.cond(
+        jnp.any((sv.sack_lo != sv.sack_hi) & in_adv[:, None]), _drain,
+        lambda a: (a[0], a[1], a[2], jnp.zeros(a[2].shape, I32)),
+        (sv.sack_lo, sv.sack_hi, sv.rcv_nxt))
     sv.setwhere(in_adv, rcv_nxt=new_nxt,
                 bytes_recv=sv.bytes_recv + adv + drained)
 

@@ -52,7 +52,8 @@ def timeloop(name, state0, params, app, body):
             ts.append(time.perf_counter() - t0)
         res[iters] = min(ts)
     slope = (res[200] - res[50]) / 150 * 1e3
-    print(f"{name:48s} {slope:8.3f} ms/iter")
+    print(f"{name:48s} {slope:8.3f} ms/iter", flush=True)
+    return slope
 
 
 def main():

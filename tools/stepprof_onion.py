@@ -10,10 +10,10 @@ rx / TCP timers / app / TCP transmit / staging / tx-drain.
 
 from __future__ import annotations
 
+import os
 import sys
-import time
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import shadow1_tpu  # noqa: F401
 import jax
@@ -22,41 +22,10 @@ import jax.numpy as jnp
 from shadow1_tpu import sim
 from shadow1_tpu.core import emit, engine, simtime
 from shadow1_tpu.transport import tcp as tcp_mod
+from stepprof import timeloop  # shared slope-timing harness
 
 I32, I64 = jnp.int32, jnp.int64
 SEC = simtime.SIMTIME_ONE_SECOND
-
-
-def timeloop(name, state0, params, app, body):
-    res = {}
-    for iters in (50, 200):
-        def run(st, th):
-            def cond(c):
-                return c[0] < iters
-
-            def b(c):
-                i, s, t = c
-                s, t = body(s, t)
-                return i + 1, s, t
-
-            return jax.lax.while_loop(cond, b,
-                                      (jnp.asarray(0, I32), st, th))
-
-        jf = jax.jit(run)
-        th0, _ = engine._scan_all(state0, params, app)
-        out = jf(state0, th0)
-        np.asarray(out[1].now)
-        ts = []
-        for trial in range(2):
-            st2 = state0.replace(now=state0.now + trial)
-            t0 = time.perf_counter()
-            out = jf(st2, th0)
-            np.asarray(out[1].now)
-            ts.append(time.perf_counter() - t0)
-        res[iters] = min(ts)
-    slope = (res[200] - res[50]) / 150 * 1e3
-    print(f"{name:48s} {slope:8.3f} ms/iter", flush=True)
-    return slope
 
 
 def main(circuits: int):
