@@ -24,12 +24,15 @@
  */
 
 #define _GNU_SOURCE
+#include <arpa/inet.h>
 #include <dlfcn.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <poll.h>
 #include <stdarg.h>
+#include <sys/epoll.h>
 #include <stddef.h>
 #include <stdint.h>
 #include <stdio.h>
@@ -46,6 +49,15 @@
 #define MAX_VFD 4096
 #define MAX_DATA 65536
 
+/* epoll instances are shim-local objects (no sequencer round trip to
+ * create one); epoll_wait lowers onto the same OP_POLL readiness RPC
+ * poll() uses, so the simulator has ONE readiness model (reference
+ * epoll.c:638-671 is likewise the one notify mechanism).  Level-
+ * triggered only; EPOLLET is refused at epoll_ctl time. */
+#define EPFD_BASE (VFD_BASE + MAX_VFD)
+#define MAX_EPFD 64
+#define MAX_WATCH 256
+
 /* ---- wire protocol (must match native/sequencer.cc + substrate) ---- */
 enum {
   OP_SOCKET = 1,
@@ -60,6 +72,10 @@ enum {
   OP_ACCEPT = 10,
   OP_POLL = 11,
   OP_EXIT = 12,
+  OP_PIPE = 13,
+  OP_SENDTO = 14,
+  OP_RECVFROM = 15,
+  OP_RESOLVE = 16,
 };
 
 typedef struct {
@@ -90,6 +106,16 @@ static int g_vfd_nonblock[MAX_VFD];
  * nonblocking connect's failure is observable the way libc callers
  * expect: poll -> POLLERR/POLLOUT -> getsockopt(SO_ERROR). */
 static int g_vfd_soerr[MAX_VFD];
+
+typedef struct {
+  int used;
+  int nwatch;
+  int wfd[MAX_WATCH];
+  uint32_t wevents[MAX_WATCH];
+  epoll_data_t wdata[MAX_WATCH];
+} epoll_inst_t;
+
+static epoll_inst_t g_ep[MAX_EPFD];
 
 static ssize_t (*real_read)(int, void *, size_t);
 static ssize_t (*real_write)(int, const void *, size_t);
@@ -258,6 +284,64 @@ ssize_t send(int fd, const void *buf, size_t n, int flags) {
   return real_send(fd, buf, n, flags);
 }
 
+ssize_t sendto(int fd, const void *buf, size_t n, int flags,
+               const struct sockaddr *addr, socklen_t alen) {
+  if (is_vfd(fd)) {
+    if (!addr || addr->sa_family != AF_INET)
+      return vsend(fd, buf, n, flags);  /* connected-style send */
+    const struct sockaddr_in *a = (const struct sockaddr_in *)addr;
+    size_t chunk = n > MAX_DATA ? MAX_DATA : n;
+    req_t rq = {.op = OP_SENDTO, .fd = fd,
+                .a0 = (int64_t)ntohl(a->sin_addr.s_addr),
+                .a1 = (int64_t)ntohs(a->sin_port) |
+                      ((int64_t)(g_vfd_nonblock[fd - VFD_BASE] != 0) << 32),
+                .len = (uint32_t)chunk};
+    memcpy(rq.data, buf, chunk);
+    rep_t rp;
+    return (ssize_t)rpc(&rq, &rp);
+  }
+  static ssize_t (*real_sendto)(int, const void *, size_t, int,
+                                const struct sockaddr *, socklen_t);
+  if (!real_sendto) real_sendto = dlsym(RTLD_NEXT, "sendto");
+  return real_sendto(fd, buf, n, flags, addr, alen);
+}
+
+/* Reply payload: {u32 src_ip, u32 src_port} header + datagram bytes. */
+ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
+                 struct sockaddr *addr, socklen_t *alen) {
+  if (is_vfd(fd)) {
+    size_t chunk = n > MAX_DATA - 8 ? MAX_DATA - 8 : n;
+    req_t rq = {.op = OP_RECVFROM, .fd = fd, .a0 = (int64_t)chunk,
+                .a1 = (int64_t)flags |
+                      (g_vfd_nonblock[fd - VFD_BASE] ? (1 << 30) : 0),
+                .len = 0};
+    rep_t rp;
+    int64_t r = rpc(&rq, &rp);
+    if (r < 0) return (ssize_t)r;
+    uint32_t ip = 0, port = 0;
+    if (rp.len >= 8) {
+      memcpy(&ip, rp.data, 4);
+      memcpy(&port, rp.data + 4, 4);
+    }
+    size_t got = rp.len >= 8 ? rp.len - 8 : 0;
+    if (got > n) got = n;
+    memcpy(buf, rp.data + 8, got);
+    if (addr && alen && *alen >= sizeof(struct sockaddr_in)) {
+      struct sockaddr_in a = {0};
+      a.sin_family = AF_INET;
+      a.sin_addr.s_addr = htonl(ip);
+      a.sin_port = htons((uint16_t)port);
+      memcpy(addr, &a, sizeof a);
+      *alen = sizeof(a);
+    }
+    return (ssize_t)got;
+  }
+  static ssize_t (*real_recvfrom)(int, void *, size_t, int,
+                                  struct sockaddr *, socklen_t *);
+  if (!real_recvfrom) real_recvfrom = dlsym(RTLD_NEXT, "recvfrom");
+  return real_recvfrom(fd, buf, n, flags, addr, alen);
+}
+
 ssize_t recv(int fd, void *buf, size_t n, int flags) {
   if (is_vfd(fd)) return vrecv(fd, buf, n, flags);
   static ssize_t (*real_recv)(int, void *, size_t, int);
@@ -281,6 +365,10 @@ int close(int fd) {
     req_t rq = {.op = OP_CLOSE, .fd = fd, .len = 0};
     rep_t rp;
     return (int)rpc(&rq, &rp);
+  }
+  if (fd >= EPFD_BASE && fd < EPFD_BASE + MAX_EPFD) {
+    g_ep[fd - EPFD_BASE].used = 0;  /* epoll instance is shim-local */
+    return 0;
   }
   return real_close(fd);
 }
@@ -340,6 +428,20 @@ int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
   int any_v = 0;
   for (nfds_t i = 0; i < nfds; i++)
     if (is_vfd(fds[i].fd)) any_v = 1;
+  if (g_seq_fd >= 0 && !any_v && timeout != 0) {
+    /* No simulated fds but a wait was requested: sleeping must consume
+     * VIRTUAL time (a real sleep here stops the virtual clock and trips
+     * the sequencer's wedge watchdog).  Infinite timeout parks forever
+     * in sim time (the process is permanently idle). */
+    req_t rq = {.op = OP_SLEEP, .fd = -1,
+                .a0 = timeout < 0 ? (int64_t)1 << 62
+                                  : (int64_t)timeout * 1000000LL,
+                .len = 0};
+    rep_t rp;
+    rpc(&rq, &rp);
+    for (nfds_t i = 0; i < nfds; i++) fds[i].revents = 0;
+    return 0;
+  }
   if (g_seq_fd < 0 || !any_v || nfds > MAX_DATA / 8) {
     static int (*real_poll)(struct pollfd *, nfds_t, int);
     if (!real_poll) real_poll = dlsym(RTLD_NEXT, "poll");
@@ -374,6 +476,202 @@ int shutdown(int fd, int how) {
   static int (*real_shutdown)(int, int);
   if (!real_shutdown) real_shutdown = dlsym(RTLD_NEXT, "shutdown");
   return real_shutdown(fd, how);
+}
+
+/* ---- name resolution against the simulator's DNS registry ---- */
+
+int getaddrinfo(const char *node, const char *service,
+                const struct addrinfo *hints, struct addrinfo **res) {
+  if (g_seq_fd < 0) {
+    static int (*real_gai)(const char *, const char *,
+                           const struct addrinfo *, struct addrinfo **);
+    if (!real_gai) real_gai = dlsym(RTLD_NEXT, "getaddrinfo");
+    return real_gai(node, service, hints, res);
+  }
+  uint32_t ip = 0;
+  struct in_addr lit;
+  if (node && inet_pton(AF_INET, node, &lit) == 1) {
+    ip = ntohl(lit.s_addr);
+  } else if (node) {
+    req_t rq = {.op = OP_RESOLVE, .fd = -1,
+                .len = (uint32_t)strlen(node)};
+    if (rq.len >= MAX_DATA) return EAI_NONAME;
+    memcpy(rq.data, node, rq.len);
+    rep_t rp;
+    if (rpc(&rq, &rp) < 0 || rp.len < 4) return EAI_NONAME;
+    memcpy(&ip, rp.data, 4);
+  }
+  int port = service ? atoi(service) : 0;
+  int socktype = hints ? hints->ai_socktype : SOCK_STREAM;
+  /* One malloc for addrinfo + sockaddr; freeaddrinfo (ours) frees it. */
+  struct addrinfo *ai = calloc(1, sizeof(struct addrinfo) +
+                               sizeof(struct sockaddr_in));
+  if (!ai) return EAI_MEMORY;
+  struct sockaddr_in *sa = (struct sockaddr_in *)(ai + 1);
+  sa->sin_family = AF_INET;
+  sa->sin_addr.s_addr = htonl(ip);
+  sa->sin_port = htons((uint16_t)port);
+  ai->ai_family = AF_INET;
+  ai->ai_socktype = socktype ? socktype : SOCK_STREAM;
+  ai->ai_protocol = (ai->ai_socktype == SOCK_DGRAM) ? IPPROTO_UDP
+                                                    : IPPROTO_TCP;
+  ai->ai_addrlen = sizeof(struct sockaddr_in);
+  ai->ai_addr = (struct sockaddr *)sa;
+  *res = ai;
+  return 0;
+}
+
+void freeaddrinfo(struct addrinfo *res) {
+  if (g_seq_fd >= 0) {
+    free(res);  /* always ours: getaddrinfo above owns all results */
+    return;
+  }
+  static void (*real_fai)(struct addrinfo *);
+  if (!real_fai) real_fai = dlsym(RTLD_NEXT, "freeaddrinfo");
+  real_fai(res);
+}
+
+/* ---- pipes (host-side byte queues; reference channel.c:22-33) ---- */
+
+int pipe(int fds[2]) {
+  if (g_seq_fd < 0) {
+    static int (*real_pipe)(int[2]);
+    if (!real_pipe) real_pipe = dlsym(RTLD_NEXT, "pipe");
+    return real_pipe(fds);
+  }
+  req_t rq = {.op = OP_PIPE, .fd = -1, .len = 0};
+  rep_t rp;
+  int64_t r = rpc(&rq, &rp);
+  if (r < 0 || rp.len < sizeof(int32_t)) return -1;
+  int32_t wfd;
+  memcpy(&wfd, rp.data, sizeof wfd);
+  fds[0] = (int)r;
+  fds[1] = wfd;
+  if (fds[0] >= VFD_BASE && fds[0] < VFD_BASE + MAX_VFD)
+    g_vfd_open[fds[0] - VFD_BASE] = 1;
+  if (fds[1] >= VFD_BASE && fds[1] < VFD_BASE + MAX_VFD)
+    g_vfd_open[fds[1] - VFD_BASE] = 1;
+  return 0;
+}
+
+int pipe2(int fds[2], int flags) {
+  int r = pipe(fds);
+  if (r == 0 && g_seq_fd >= 0 && (flags & O_NONBLOCK)) {
+    g_vfd_nonblock[fds[0] - VFD_BASE] = 1;
+    g_vfd_nonblock[fds[1] - VFD_BASE] = 1;
+  }
+  return r;
+}
+
+/* ---- epoll (shim-local instances over the OP_POLL readiness RPC) ---- */
+
+static int is_epfd(int fd) {
+  return fd >= EPFD_BASE && fd < EPFD_BASE + MAX_EPFD && g_ep[fd - EPFD_BASE].used;
+}
+
+int epoll_create1(int flags) {
+  (void)flags;
+  if (g_seq_fd < 0) {
+    static int (*real_ec1)(int);
+    if (!real_ec1) real_ec1 = dlsym(RTLD_NEXT, "epoll_create1");
+    return real_ec1(flags);
+  }
+  for (int i = 0; i < MAX_EPFD; i++) {
+    if (!g_ep[i].used) {
+      g_ep[i].used = 1;
+      g_ep[i].nwatch = 0;
+      return EPFD_BASE + i;
+    }
+  }
+  errno = EMFILE;
+  return -1;
+}
+
+int epoll_create(int size) {
+  (void)size;
+  return epoll_create1(0);
+}
+
+int epoll_ctl(int epfd, int op, int fd, struct epoll_event *ev) {
+  if (!is_epfd(epfd)) {
+    static int (*real_ctl)(int, int, int, struct epoll_event *);
+    if (!real_ctl) real_ctl = dlsym(RTLD_NEXT, "epoll_ctl");
+    return real_ctl(epfd, op, fd, ev);
+  }
+  epoll_inst_t *e = &g_ep[epfd - EPFD_BASE];
+  int at = -1;
+  for (int i = 0; i < e->nwatch; i++)
+    if (e->wfd[i] == fd) at = i;
+  if (op == EPOLL_CTL_DEL) {
+    if (at < 0) { errno = ENOENT; return -1; }
+    e->nwatch--;
+    e->wfd[at] = e->wfd[e->nwatch];
+    e->wevents[at] = e->wevents[e->nwatch];
+    e->wdata[at] = e->wdata[e->nwatch];
+    return 0;
+  }
+  if (!ev) { errno = EFAULT; return -1; }
+  if (ev->events & EPOLLET) { errno = EINVAL; return -1; /* LT only */ }
+  if (op == EPOLL_CTL_ADD) {
+    if (at >= 0) { errno = EEXIST; return -1; }
+    if (e->nwatch >= MAX_WATCH) { errno = ENOSPC; return -1; }
+    at = e->nwatch++;
+    e->wfd[at] = fd;
+  } else if (op == EPOLL_CTL_MOD) {
+    if (at < 0) { errno = ENOENT; return -1; }
+  } else {
+    errno = EINVAL;
+    return -1;
+  }
+  e->wevents[at] = ev->events;
+  e->wdata[at] = ev->data;
+  return 0;
+}
+
+int epoll_wait(int epfd, struct epoll_event *events, int maxevents,
+               int timeout) {
+  if (!is_epfd(epfd)) {
+    static int (*real_wait)(int, struct epoll_event *, int, int);
+    if (!real_wait) real_wait = dlsym(RTLD_NEXT, "epoll_wait");
+    return real_wait(epfd, events, maxevents, timeout);
+  }
+  epoll_inst_t *e = &g_ep[epfd - EPFD_BASE];
+  if (maxevents <= 0) { errno = EINVAL; return -1; }
+  struct pollfd pf[MAX_WATCH];
+  for (int i = 0; i < e->nwatch; i++) {
+    pf[i].fd = e->wfd[i];
+    pf[i].events = 0;
+    if (e->wevents[i] & EPOLLIN) pf[i].events |= POLLIN;
+    if (e->wevents[i] & EPOLLOUT) pf[i].events |= POLLOUT;
+    if (e->wevents[i] & EPOLLPRI) pf[i].events |= POLLPRI;
+    pf[i].revents = 0;
+  }
+  int r = poll(pf, e->nwatch, timeout);
+  if (r <= 0) return r;
+  int n = 0;
+  for (int i = 0; i < e->nwatch && n < maxevents; i++) {
+    if (!pf[i].revents) continue;
+    uint32_t rev = 0;
+    if (pf[i].revents & POLLIN) rev |= EPOLLIN;
+    if (pf[i].revents & POLLOUT) rev |= EPOLLOUT;
+    if (pf[i].revents & POLLPRI) rev |= EPOLLPRI;
+    if (pf[i].revents & POLLERR) rev |= EPOLLERR;
+    if (pf[i].revents & POLLHUP) rev |= EPOLLHUP;
+    events[n].events = rev;
+    events[n].data = e->wdata[i];
+    n++;
+  }
+  return n;
+}
+
+int epoll_pwait(int epfd, struct epoll_event *events, int maxevents,
+                int timeout, const sigset_t *sig) {
+  (void)sig;
+  if (is_epfd(epfd)) return epoll_wait(epfd, events, maxevents, timeout);
+  static int (*real_pwait)(int, struct epoll_event *, int, int,
+                           const sigset_t *);
+  if (!real_pwait) real_pwait = dlsym(RTLD_NEXT, "epoll_pwait");
+  return real_pwait(epfd, events, maxevents, timeout, sig);
 }
 
 /* ---- time ---- */

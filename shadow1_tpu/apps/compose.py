@@ -1,0 +1,61 @@
+"""App composition: run several on-device application models in one world.
+
+The engine takes ONE app object; `Stacked` lets a world carry several
+(e.g. the substrate's outbound-datagram ring next to a modeled echo
+server).  App state becomes a tuple, one element per sub-app, and each
+sub-app sees the SimState with `app` rebound to its own element.
+
+Constraint: at most one stacked app may emit on a given emission lane
+per tick (emit.SLOT_APP in particular) -- lanes are fixed columns, and a
+second writer would overwrite the first.  The compositions used here
+(SubstrateTx + a modeled TCP server) satisfy this by construction: TCP
+apps emit through the transmitter, not SLOT_APP.
+
+Reference analog: a reference host runs multiple processes
+(slave_addNewVirtualProcess); here multiple vectorized models advance in
+one compiled step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+
+
+class Stacked:
+    def __init__(self, *apps):
+        self.apps = tuple(apps)
+
+    # Static capability flags: the union of the sub-apps'.
+    @property
+    def uses_tcp(self):
+        return any(getattr(a, "uses_tcp", True) for a in self.apps)
+
+    @property
+    def may_loopback(self):
+        return any(getattr(a, "may_loopback", True) for a in self.apps)
+
+    @property
+    def rx_batch(self):
+        return max(int(getattr(a, "rx_batch", 1)) for a in self.apps)
+
+    def __hash__(self):
+        return hash(("stacked",) + self.apps)
+
+    def __eq__(self, other):
+        return isinstance(other, Stacked) and other.apps == self.apps
+
+    def next_time(self, state):
+        times = [a.next_time(state.replace(app=state.app[i]))
+                 for i, a in enumerate(self.apps)]
+        return functools.reduce(jnp.minimum, times)
+
+    def on_tick(self, state, params, em, tick_t, active):
+        subs = list(state.app)
+        for i, a in enumerate(self.apps):
+            sub_state = state.replace(app=subs[i])
+            sub_state, em = a.on_tick(sub_state, params, em, tick_t, active)
+            subs[i] = sub_state.app
+            state = sub_state
+        return state.replace(app=tuple(subs)), em

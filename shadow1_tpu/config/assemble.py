@@ -186,14 +186,16 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
     # matrix, so an isolated single-attached vertex is fine.
     np.fill_diagonal(routable, True)
     if not routable.all():
-        bad = np.argwhere(~routable)
-        bad = bad[bad[:, 0] < bad[:, 1]]  # symmetric: count each pair once
-        vi, vj = used[bad[0][0]], used[bad[0][1]]
+        # Normalize to unordered pairs (a one-directional hole on a
+        # directed topology must still report, not IndexError).
+        pairs = sorted({(min(i, j), max(i, j))
+                        for i, j in np.argwhere(~routable)})
+        vi, vj = used[pairs[0][0]], used[pairs[0][1]]
         raise ValueError(
             f"topology is not connected: no route between attached "
             f"vertices {topo.names[vi]!r} and {topo.names[vj]!r} "
-            f"({len(bad)} unroutable attached-vertex pairs); every pair "
-            f"of vertices that hosts attach to must be connected")
+            f"({len(pairs)} unroutable attached-vertex pairs); every "
+            f"pair of vertices that hosts attach to must be connected")
 
     # --- processes -> modeled apps ---------------------------------------
     # Each distinct tgen arguments file is one parsed action graph; a

@@ -53,8 +53,11 @@ from ..transport import tcp
 from . import buildlib
 
 # Wire protocol (matches native/shim/shadow1_shim.c + sequencer.cc).
-OP_SOCKET, OP_CONNECT, OP_SEND, OP_RECV, OP_CLOSE, OP_SLEEP, OP_GETTIME, \
-    OP_BIND, OP_LISTEN, OP_ACCEPT, OP_POLL, OP_EXIT = range(1, 13)
+(OP_SOCKET, OP_CONNECT, OP_SEND, OP_RECV, OP_CLOSE, OP_SLEEP, OP_GETTIME,
+ OP_BIND, OP_LISTEN, OP_ACCEPT, OP_POLL, OP_EXIT, OP_PIPE, OP_SENDTO,
+ OP_RECVFROM, OP_RESOLVE) = range(1, 17)
+
+SOCK_DGRAM = 2  # linux asm-generic socket type
 
 VFD_BASE = 1 << 20
 MAX_DATA = 65536
@@ -101,11 +104,25 @@ class _SeqLib:
 
 
 @dataclass
+class VPipe:
+    """Host-side byte queue behind a real process's pipe(2) pair --
+    descriptor plumbing with no network presence (reference
+    channel.c:22-33: a buffered descriptor pair internal to the host)."""
+
+    buf: bytearray = field(default_factory=bytearray)
+    read_open: bool = True
+    write_open: bool = True
+    CAP = 65536
+
+
+@dataclass
 class VSocket:
     """Host-side view of one simulated socket owned by a real process."""
 
     slot: int
     vfd: int
+    kind: str = "tcp"          # tcp | udp | pipe_r | pipe_w
+    pipe: "VPipe | None" = None
     local_port: int = 0
     connecting: bool = False
     connected: bool = False
@@ -116,6 +133,9 @@ class VSocket:
     # The opposite endpoint when BOTH ends are real processes (paired at
     # accept time); recv then reads peer.sent at recv_cursor.
     peer: "VSocket | None" = None
+    # Connected-UDP default peer (ip, port) set by connect() on a
+    # SOCK_DGRAM socket; send()/recv() then behave like sendto/recvfrom.
+    udp_peer: "tuple | None" = None
     # Registry key while an active connect awaits real<->real pairing.
     # Popped at accept-pairing ONLY: the entry must survive a client
     # close/half-close, because the server may accept (and pair) after
@@ -154,8 +174,12 @@ class Substrate:
     """Owns the sequencer, all real processes, and the device bridge."""
 
     def __init__(self, resolve_ip, workdir: str, sock_slot_base: int = 0,
-                 ephemeral_base: int = 40000):
-        """resolve_ip: callable(int ipv4) -> host index (DNS analog)."""
+                 ephemeral_base: int = 40000, resolve_name=None,
+                 host_ip=None):
+        """resolve_ip: callable(int ipv4) -> host index (DNS analog).
+        resolve_name: callable(str) -> int ipv4 for getaddrinfo
+        (OP_RESOLVE); host_ip: callable(host index) -> int ipv4 used to
+        fill recvfrom()'s source address."""
         self._lib = _SeqLib().lib
         os.makedirs(workdir, exist_ok=True)
         self.workdir = workdir
@@ -179,6 +203,20 @@ class Substrate:
         # Child slots already returned by accept() whose `accepted` bit
         # the device may not show yet; per host.
         self._accept_taken: dict[int, set] = {}
+        self.resolve_name = resolve_name
+        self.host_ip = host_ip
+        # Datagram payload bytes (native refcounted arena); ids ride the
+        # device packet metadata.
+        from ..payload import PayloadArena
+        self.arena = PayloadArena()
+        # Device payload_id fields are i32; arena handles are u64 with a
+        # generation in the high bits.  Small ids index this map.
+        self._pid_map: dict[int, int] = {}
+        self._next_pid = 1
+        # Device TX-ring occupancy the device hasn't caught up on, and
+        # per-sync UDP ring pops (host, slot) -> count.
+        self._tx_inflight: dict[int, int] = {}
+        self._local_pops: dict[tuple, int] = {}
 
     # -- process management -------------------------------------------------
 
@@ -234,6 +272,7 @@ class Substrate:
         # other's effects before the device does.
         self._local_written: dict[tuple, int] = {}
         self._local_read: dict[tuple, int] = {}
+        self._local_pops = {}
 
         for p in self.procs:          # deterministic order: spawn order
             self._run_until_blocked(p, regs, now_ns)
@@ -259,9 +298,19 @@ class Substrate:
         names = ("tcp_state", "rcv_nxt", "rcv_read", "snd_una", "snd_end",
                  "snd_buf_cap", "error", "fin_seq", "stype",
                  "parent", "accepted", "child_order",
-                 "local_port", "peer_host", "peer_port")
+                 "local_port", "peer_host", "peer_port",
+                 "udp_head", "udp_count", "udp_src", "udp_sport",
+                 "udp_len", "udp_payload")
         vals = jax.device_get(tuple(getattr(socks, n) for n in names))
         regs = dict(zip(names, vals))
+        tx = self._find_tx(state)
+        self._has_tx = tx is not None
+        if tx is not None:
+            counts, heads = jax.device_get((tx.count, tx.head))
+            self._tx_inflight = {h: int(c) for h, c in enumerate(counts)}
+            self._tx_base = dict(self._tx_inflight)  # count at fetch time
+            self._tx_head = {h: int(v) for h, v in enumerate(heads)}
+            self._tx_appended = {}  # per-sync appends already applied
         # Reservations/accept-marks the device has caught up on can be
         # forgotten (keeps the sets from growing for the run's lifetime).
         from ..core.state import SOCK_FREE
@@ -351,9 +400,27 @@ class Substrate:
             self._pending.append(("reserve", h, slot))
             vfd = p.next_vfd
             p.next_vfd += 1
-            vs = VSocket(slot=slot, vfd=vfd)
+            kind = "udp" if (int(a0) & 0xF) == SOCK_DGRAM else "tcp"
+            vs = VSocket(slot=slot, vfd=vfd, kind=kind)
             p.vfds[vfd] = vs
             return (vfd, 0, b"")
+
+        if op == OP_PIPE:
+            if p.next_vfd - VFD_BASE >= 4095:
+                return (-1, 24, b"")
+            pipe = VPipe()
+            rfd, wfd = p.next_vfd, p.next_vfd + 1
+            p.next_vfd += 2
+            p.vfds[rfd] = VSocket(slot=-1, vfd=rfd, kind="pipe_r", pipe=pipe)
+            p.vfds[wfd] = VSocket(slot=-1, vfd=wfd, kind="pipe_w", pipe=pipe)
+            return (rfd, 0, np.asarray([wfd], np.int32).tobytes())
+
+        if op == OP_RESOLVE:
+            name = data.decode("utf-8", "replace")
+            ip = self.resolve_name(name) if self.resolve_name else None
+            if ip is None:
+                return (-1, 2, b"")  # ENOENT -> EAI_NONAME shim-side
+            return (0, 0, np.asarray([ip], np.uint32).tobytes())
 
         if op == OP_GETTIME:
             return (0, 0, b"")
@@ -372,7 +439,39 @@ class Substrate:
 
         if op == OP_BIND:
             vs.local_port = int(a1)
+            if vs.kind == "udp":
+                self._pending.append(("udp_open", h, vs.slot, vs.local_port))
             return (0, 0, b"")
+
+        if op == OP_SENDTO:
+            rep = self._do_sendto(p, vs, data, regs, dst_ip=int(a0),
+                                  dport=int(a1) & 0xFFFF)
+            if rep is not None and rep == ("ring_full",):
+                if a1 >> 32:  # nonblocking
+                    return (-1, _EAGAIN, b"")
+                pk = Parked(OP_SENDTO, fd=fd, a0=int(a0),
+                            a1=int(a1) & 0xFFFF)
+                pk.data = data  # type: ignore[attr-defined]
+                p.parked = pk
+                return None
+            return rep
+
+        if op == OP_RECVFROM:
+            nonblock = bool(a1 & (1 << 30))
+            if vs.kind != "udp":
+                # recvfrom() on a stream socket/pipe == recv() with a
+                # zeroed source address.
+                rep = self._do_recv(p, vs, int(a0), regs, nonblock)
+                if rep is None:
+                    p.parked = Parked(OP_RECVFROM, fd=fd, a0=int(a0))
+                return self._wrap_rf(rep)
+            rep = self._try_recvfrom(p, vs, int(a0), regs)
+            if rep is not None:
+                return rep
+            if nonblock:
+                return (-1, _EAGAIN, b"")
+            p.parked = Parked(OP_RECVFROM, fd=fd, a0=int(a0))
+            return None
 
         if op == OP_LISTEN:
             if not vs.local_port:
@@ -394,6 +493,12 @@ class Substrate:
             return None
 
         if op == OP_CONNECT:
+            if vs.kind == "udp":
+                # Connected UDP: record the default peer; succeeds
+                # instantly like Linux (no handshake).
+                vs.udp_peer = (int(a0), int(a1) & 0xFFFF)
+                vs.connected = True
+                return (0, 0, b"")
             dst = self.resolve_ip(int(a0))
             if dst is None:
                 return (-1, _ECONNREFUSED, b"")
@@ -421,7 +526,15 @@ class Substrate:
         if op == OP_CLOSE:
             if not vs.closed:
                 vs.closed = True
-                self._pending.append(("close", p.host, vs.slot))
+                if vs.pipe is not None:
+                    if vs.kind == "pipe_r":
+                        vs.pipe.read_open = False
+                    else:
+                        vs.pipe.write_open = False
+                elif vs.kind == "udp":
+                    self._pending.append(("udp_close", p.host, vs.slot))
+                else:
+                    self._pending.append(("close", p.host, vs.slot))
             return (0, 0, b"")
 
         return (-1, 38, b"")  # ENOSYS
@@ -433,6 +546,33 @@ class Substrate:
             self._local_written.get(key, 0)
         used = (snd_end - int(regs["snd_una"][h, vs.slot])) & 0xFFFFFFFF
         return int(regs["snd_buf_cap"][h, vs.slot]) - used
+
+    @staticmethod
+    def _find_tx(state):
+        """Locate the SubstrateTx ring state: state.app directly, or an
+        element of a Stacked app tuple; None if the world has none (then
+        real-process UDP sends are unavailable)."""
+        from .devapp import SubTxState
+
+        app = state.app
+        if isinstance(app, SubTxState):
+            return app
+        if isinstance(app, tuple):
+            for s in app:
+                if isinstance(s, SubTxState):
+                    return s
+        return None
+
+    @staticmethod
+    def _replace_tx(state, new_tx):
+        from .devapp import SubTxState
+
+        app = state.app
+        if isinstance(app, SubTxState):
+            return state.replace(app=new_tx)
+        subs = tuple(new_tx if isinstance(s, SubTxState) else s
+                     for s in app)
+        return state.replace(app=subs)
 
     @staticmethod
     def _fin_reached(rcv_nxt: int, fin_seq: int) -> bool:
@@ -455,7 +595,143 @@ class Substrate:
             d -= 1 << 32      # data_end, but stay safe under mod-2^32
         return d - self._local_read.get(key, 0)
 
+    # --- pipes ---------------------------------------------------------------
+
+    def _pipe_send(self, p, vs, data, nonblock):
+        pipe = vs.pipe
+        if vs.kind != "pipe_w":
+            return (-1, 9, b"")  # EBADF: read end
+        if not pipe.read_open:
+            return (-1, 32, b"")  # EPIPE
+        room = VPipe.CAP - len(pipe.buf)
+        if room <= 0:
+            if nonblock:
+                return (-1, _EAGAIN, b"")
+            pk = Parked(OP_SEND, fd=vs.vfd)
+            pk.data = data  # type: ignore[attr-defined]
+            p.parked = pk
+            return None
+        n = min(len(data), room)
+        pipe.buf.extend(data[:n])
+        return (n, 0, b"")
+
+    def _pipe_recv(self, p, vs, maxlen, nonblock):
+        pipe = vs.pipe
+        if vs.kind != "pipe_r":
+            return (-1, 9, b"")
+        if pipe.buf:
+            n = min(maxlen, len(pipe.buf), MAX_DATA)
+            out = bytes(pipe.buf[:n])
+            del pipe.buf[:n]
+            return (n, 0, out)
+        if not pipe.write_open:
+            return (0, 0, b"")  # EOF
+        if nonblock:
+            return (-1, _EAGAIN, b"")
+        p.parked = Parked(OP_RECV, fd=vs.vfd, a0=maxlen)
+        return None
+
+    # --- UDP datagrams -------------------------------------------------------
+
+    @staticmethod
+    def _wrap_rf(rep):
+        """Adapt a recv()-shaped reply to recvfrom()'s wire format
+        ({u32 ip, u32 port} header, zeroed for stream sockets)."""
+        if rep is None:
+            return None
+        ret, err, payload = rep
+        if ret > 0:
+            return (ret, err, bytes(8) + payload)
+        return rep
+
+    def _do_sendto(self, p, vs, data, regs, dst_ip, dport):
+        if vs.kind != "udp" or not getattr(self, "_has_tx", False):
+            return (-1, 95, b"")  # EOPNOTSUPP (no SubstrateTx ring app)
+        h = p.host
+        dst = self.resolve_ip(dst_ip)
+        if dst is None:
+            return (-1, 101, b"")  # ENETUNREACH
+        if not vs.local_port:
+            vs.local_port = self._alloc_port()
+            self._pending.append(("udp_open", h, vs.slot, vs.local_port))
+        from .devapp import RING
+        if self._tx_inflight.get(h, 0) >= RING:
+            # Device TX ring full: the caller parks (blocking) or gets
+            # EAGAIN (nonblocking) -- decided by the OP_SENDTO handler.
+            return ("ring_full",)
+        if data:
+            # Entries normally release at the receiver's recvfrom; a
+            # datagram dropped in the network (reliability draw, ring
+            # overflow) never pops, so bound the map: evict the OLDEST
+            # entries past the cap (their content degrades to zeros if
+            # such a datagram were still delivered -- it is overwhelmingly
+            # already dead).  Python dicts iterate in insertion order.
+            if len(self._pid_map) >= 8192:
+                import sys
+                for old in list(self._pid_map)[:1024]:
+                    self.arena.unref(self._pid_map.pop(old))
+                print("substrate: evicted 1024 oldest datagram payloads "
+                      "(drop-leak bound)", file=sys.stderr)
+            handle = self.arena.put(bytes(data))
+            pid = self._next_pid
+            self._next_pid += 1
+            assert pid < (1 << 31), "payload id space exhausted"
+            self._pid_map[pid] = handle
+        else:
+            pid = -1
+        self._tx_inflight[h] = self._tx_inflight.get(h, 0) + 1
+        self._pending.append(("udp_tx", h, dst, dport, vs.local_port,
+                              len(data), pid))
+        return (len(data), 0, b"")
+
+    def _try_recvfrom(self, p, vs, maxlen, regs):
+        """Reply for recvfrom() if a datagram is queued, else None.
+        Payload wire format: {u32 src_ip, u32 src_port} + bytes."""
+        if vs.kind != "udp":
+            return (-1, 95, b"")
+        h, s = p.host, vs.slot
+        key = (h, s)
+        pops = self._local_pops.get(key, 0)
+        if int(regs["udp_count"][h, s]) - pops <= 0:
+            return None
+        ring = regs["udp_src"].shape[2]
+        at = (int(regs["udp_head"][h, s]) + pops) % ring
+        src = int(regs["udp_src"][h, s, at])
+        sport = int(regs["udp_sport"][h, s, at])
+        length = int(regs["udp_len"][h, s, at])
+        pid = int(regs["udp_payload"][h, s, at])
+        handle = self._pid_map.pop(pid, None) if pid > 0 else None
+        if handle is not None:
+            content = self.arena.get(handle)[:length]
+            self.arena.unref(handle)
+        else:
+            content = bytes(length)
+        n = min(maxlen, len(content))
+        self._local_pops[key] = pops + 1
+        self._pending.append(("udp_pop", h, s))
+        src_ip = self.host_ip(src) if self.host_ip else 0
+        hdr = np.asarray([src_ip & 0xFFFFFFFF, sport],
+                         np.uint32).tobytes()
+        return (n, 0, hdr + content[:n])
+
     def _do_send(self, p, vs, data, regs, nonblock):
+        if vs.pipe is not None:
+            return self._pipe_send(p, vs, data, nonblock)
+        if vs.kind == "udp":
+            if vs.udp_peer is None:
+                return (-1, 89, b"")  # EDESTADDRREQ
+            rep = self._do_sendto(p, vs, data, regs,
+                                  dst_ip=vs.udp_peer[0],
+                                  dport=vs.udp_peer[1])
+            if rep == ("ring_full",):
+                if nonblock:
+                    return (-1, _EAGAIN, b"")
+                pk = Parked(OP_SENDTO, fd=vs.vfd, a0=vs.udp_peer[0],
+                            a1=vs.udp_peer[1])
+                pk.data = data  # type: ignore[attr-defined]
+                p.parked = pk
+                return None
+            return rep
         room = self._room(p, vs, regs)
         if room <= 0:
             if nonblock:
@@ -471,6 +747,18 @@ class Substrate:
         return (n, 0, b"")
 
     def _do_recv(self, p, vs, maxlen, regs, nonblock):
+        if vs.pipe is not None:
+            return self._pipe_recv(p, vs, maxlen, nonblock)
+        if vs.kind == "udp":
+            rep = self._try_recvfrom(p, vs, maxlen, regs)
+            if rep is not None:
+                # recv() drops the address header.
+                ret, err, payload = rep
+                return (ret, err, payload[8:] if payload else payload)
+            if nonblock:
+                return (-1, _EAGAIN, b"")
+            p.parked = Parked(OP_RECV, fd=vs.vfd, a0=maxlen)
+            return None
         avail = self._avail(p, vs, regs)
         if avail <= 0:
             st = int(regs["tcp_state"][p.host, vs.slot])
@@ -578,6 +866,25 @@ class Substrate:
                 # the vfd range but unknown) is POLLNVAL.
                 if fd >= VFD_BASE:
                     rev = POLLNVAL
+            elif vs.pipe is not None:
+                if vs.kind == "pipe_r":
+                    if vs.pipe.buf or not vs.pipe.write_open:
+                        rev |= POLLIN
+                    if not vs.pipe.write_open:
+                        rev |= POLLHUP
+                else:
+                    if not vs.pipe.read_open:
+                        rev |= POLLERR
+                    elif len(vs.pipe.buf) < VPipe.CAP:
+                        rev |= POLLOUT
+            elif vs.kind == "udp":
+                key = (h, vs.slot)
+                if int(regs["udp_count"][h, vs.slot]) - \
+                        self._local_pops.get(key, 0) > 0:
+                    rev |= POLLIN
+                from .devapp import RING
+                if self._tx_inflight.get(h, 0) < RING:
+                    rev |= POLLOUT
             elif vs.listening:
                 if self._find_child(p, vs, regs) is not None:
                     rev |= POLLIN
@@ -650,6 +957,19 @@ class Substrate:
         h = p.host
         if pk.op == OP_ACCEPT:
             return self._try_accept(p, vs, regs)  # None = still parked
+        if pk.op == OP_RECVFROM:
+            if vs.kind != "udp":
+                rep = self._do_recv(p, vs, pk.a0, regs, nonblock=False)
+                if rep is None:
+                    p.parked = pk
+                return self._wrap_rf(rep)
+            return self._try_recvfrom(p, vs, pk.a0, regs)
+        if pk.op == OP_SENDTO:
+            rep = self._do_sendto(p, vs, getattr(pk, "data", b""), regs,
+                                  dst_ip=pk.a0, dport=pk.a1)
+            if rep == ("ring_full",):
+                return None  # still parked
+            return rep
         if pk.op == OP_CONNECT:
             st = int(regs["tcp_state"][h, vs.slot])
             err = int(regs["error"][h, vs.slot])
@@ -708,6 +1028,52 @@ class Substrate:
                 _, h, slot = op
                 socks = socks.replace(
                     accepted=socks.accepted.at[h, slot].set(True))
+            elif kind == "udp_open":
+                from ..core.state import SOCK_UDP
+                _, h, slot = op[:3]
+                port = op[3]
+                socks = socks.replace(
+                    stype=socks.stype.at[h, slot].set(SOCK_UDP),
+                    local_port=socks.local_port.at[h, slot].set(port),
+                    peer_host=socks.peer_host.at[h, slot].set(-1),
+                    peer_port=socks.peer_port.at[h, slot].set(0))
+            elif kind == "udp_close":
+                from ..core.state import SOCK_FREE
+                _, h, slot = op
+                socks = socks.replace(
+                    stype=socks.stype.at[h, slot].set(SOCK_FREE),
+                    local_port=socks.local_port.at[h, slot].set(0))
+            elif kind == "udp_pop":
+                from ..transport import udp as udp_mod
+                _, h, slot = op
+                mask = np.zeros(hN, bool)
+                mask[h] = True
+                slot_v = np.zeros(hN, np.int32)
+                slot_v[h] = slot
+                socks, _g, _s, _p2, _l, _pid = udp_mod.pop_ring(
+                    socks, jnp.asarray(mask), jnp.asarray(slot_v))
+            elif kind == "udp_tx":
+                _, h, dst, dport, sport, length, pid = op
+                tx = self._find_tx(state)
+                assert tx is not None, (
+                    "real-process UDP needs a SubstrateTx app in the "
+                    "world (substrate.devapp; compose with apps.compose."
+                    "Stacked)")
+                from .devapp import RING
+                # Ring position from host-side snapshots (head/count at
+                # fetch + appends this sync) -- no device round trips.
+                k = self._tx_appended.get(h, 0)
+                self._tx_appended[h] = k + 1
+                pos = (self._tx_head[h] + self._tx_base[h] + k) % RING
+                tx = tx.replace(
+                    dst=tx.dst.at[h, pos].set(dst),
+                    dport=tx.dport.at[h, pos].set(dport),
+                    sport=tx.sport.at[h, pos].set(sport),
+                    length=tx.length.at[h, pos].set(length),
+                    payload=tx.payload.at[h, pos].set(pid),
+                    count=tx.count.at[h].add(1))
+                state = self._replace_tx(state, tx)
+                wake[h] = True
             elif kind == "connect":
                 _, h, slot, dst, dport, lport = op
                 mask = np.zeros(hN, bool)

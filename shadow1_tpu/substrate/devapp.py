@@ -1,0 +1,87 @@
+"""Device-side outbound-datagram ring for real processes.
+
+A real process's sendto() cannot create a packet directly -- packets are
+born in the engine's emission staging, on device.  The bridge instead
+appends (dst, ports, length, payload_id) to this per-host ring at sync
+time and wakes the host; `SubstrateTx.on_tick` drains one entry per tick
+through the normal emission path, so real-process datagrams get the same
+routing, token buckets, reliability draws, and deterministic pkt_ids as
+modeled traffic (reference: process syscalls land in the same
+worker_sendPacket path as everything else, worker.c:243-304).
+
+Payload bytes live host-side in the native arena keyed by payload_id;
+the id rides the packet metadata and the receiving bridge resolves it
+back to bytes at recvfrom() (reference packet.c:97-100 payload split).
+"""
+
+from __future__ import annotations
+
+from flax import struct
+import jax.numpy as jnp
+
+from ..core import emit, simtime
+from ..core.state import I32, I64
+
+RING = 32  # per-host pending outbound datagrams
+
+
+@struct.dataclass
+class SubTxState:
+    dst: jnp.ndarray      # [H, RING] i32 destination host
+    dport: jnp.ndarray    # [H, RING] i32
+    sport: jnp.ndarray    # [H, RING] i32
+    length: jnp.ndarray   # [H, RING] i32
+    payload: jnp.ndarray  # [H, RING] i32 arena id (-1 = none)
+    head: jnp.ndarray     # [H] i32
+    count: jnp.ndarray    # [H] i32
+
+
+class SubstrateTx:
+    """Drain one queued real-process datagram per host per tick."""
+
+    uses_tcp = True       # real processes also run TCP
+    may_loopback = True   # a process may sendto its own host
+    rx_batch = 4
+
+    def __hash__(self):
+        return hash("substrate-tx")
+
+    def __eq__(self, other):
+        return isinstance(other, SubstrateTx)
+
+    def next_time(self, state):
+        a = state.app
+        # Queued datagrams are due immediately (the bridge stamps
+        # t_resume at append time; 0 clamps to `now` in the window loop).
+        return jnp.where(a.count > 0, jnp.zeros_like(a.head, I64),
+                         jnp.asarray(simtime.SIMTIME_INVALID, I64))
+
+    def on_tick(self, state, params, em, tick_t, active):
+        a = state.app
+        h = a.head.shape[0]
+        do = active & (a.count > 0)
+        idx = a.head[:, None]
+        col = jnp.arange(RING, dtype=I32)[None, :] == idx
+
+        def at_head(tab):
+            return jnp.sum(jnp.where(col, tab, 0), axis=1, dtype=tab.dtype)
+
+        em = emit.put(
+            em, do, emit.SLOT_APP,
+            dst=at_head(a.dst), sport=at_head(a.sport),
+            dport=at_head(a.dport), proto=17,
+            length=at_head(a.length), payload_id=at_head(a.payload))
+        a = a.replace(
+            head=jnp.where(do, (a.head + 1) % RING, a.head),
+            count=jnp.where(do, a.count - 1, a.count))
+        return state.replace(app=a), em
+
+
+def init_state(num_hosts: int) -> SubTxState:
+    hq = (num_hosts, RING)
+    return SubTxState(
+        dst=jnp.zeros(hq, I32), dport=jnp.zeros(hq, I32),
+        sport=jnp.zeros(hq, I32), length=jnp.zeros(hq, I32),
+        payload=jnp.full(hq, -1, I32),
+        head=jnp.zeros((num_hosts,), I32),
+        count=jnp.zeros((num_hosts,), I32))

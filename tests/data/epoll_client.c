@@ -1,0 +1,122 @@
+/* epoll(7) client: self-pipe readiness + 2 TCP streams through one
+ * epoll loop (tests/test_substrate.py).  The epoll surface is shim-local
+ * (epoll_wait lowers onto the simulator's poll readiness RPC), so this
+ * verifies the full create1/ctl/wait/data.u32 round trip plus pipes.
+ */
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+static char pat(int stream, int off) { return (char)('A' + (off * 5 + stream) % 29); }
+
+int main(int argc, char **argv) {
+  if (argc < 5) return 2;
+  const char *ip = argv[1];
+  int port = atoi(argv[2]);
+  int ns = atoi(argv[3]);
+  int total = atoi(argv[4]);
+  if (ns > 8) return 2;
+
+  /* --- pipe + epoll readiness smoke -------------------------------- */
+  int pfd[2];
+  if (pipe(pfd) != 0) return 20;
+  int ep0 = epoll_create1(0);
+  if (ep0 < 0) return 21;
+  struct epoll_event pe = {.events = EPOLLIN, .data = {.u32 = 77}};
+  if (epoll_ctl(ep0, EPOLL_CTL_ADD, pfd[0], &pe) != 0) return 22;
+  struct epoll_event got[4];
+  if (epoll_wait(ep0, got, 4, 0) != 0) return 23; /* empty: not ready */
+  if (write(pfd[1], "xyz", 3) != 3) return 24;
+  if (epoll_wait(ep0, got, 4, 1000) != 1) return 25;
+  if (got[0].data.u32 != 77 || !(got[0].events & EPOLLIN)) return 26;
+  char pbuf[8];
+  if (read(pfd[0], pbuf, sizeof pbuf) != 3 || memcmp(pbuf, "xyz", 3)) return 27;
+  close(pfd[1]);
+  if (epoll_wait(ep0, got, 4, 1000) != 1) return 28; /* EOF readable */
+  if (read(pfd[0], pbuf, sizeof pbuf) != 0) return 29; /* EOF */
+  close(pfd[0]);
+  close(ep0);
+
+  /* --- TCP streams through one epoll loop -------------------------- */
+  struct sockaddr_in a = {0};
+  a.sin_family = AF_INET;
+  a.sin_port = htons(port);
+  if (inet_pton(AF_INET, ip, &a.sin_addr) != 1) return 3;
+
+  int ep = epoll_create1(0);
+  if (ep < 0) return 4;
+  int fd[8], sent[8], got_n[8], connected[8], done[8];
+  for (int i = 0; i < ns; i++) {
+    fd[i] = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd[i] < 0) return 5;
+    if (fcntl(fd[i], F_SETFL, O_NONBLOCK) != 0) return 6;
+    int r = connect(fd[i], (struct sockaddr *)&a, sizeof a);
+    if (r != 0 && errno != EINPROGRESS) return 7;
+    connected[i] = (r == 0);
+    sent[i] = got_n[i] = done[i] = 0;
+    struct epoll_event ev = {.events = EPOLLIN | EPOLLOUT,
+                             .data = {.u32 = (uint32_t)i}};
+    if (epoll_ctl(ep, EPOLL_CTL_ADD, fd[i], &ev) != 0) return 8;
+  }
+
+  int ndone = 0, rounds = 0;
+  while (ndone < ns && rounds++ < 100000) {
+    struct epoll_event evs[8];
+    int n = epoll_wait(ep, evs, 8, 5000);
+    if (n < 0) return 9;
+    for (int k = 0; k < n; k++) {
+      int i = (int)evs[k].data.u32;
+      if (done[i]) continue;
+      if (evs[k].events & EPOLLERR) return 10;
+      if (!connected[i] && (evs[k].events & EPOLLOUT)) {
+        int err = -1;
+        socklen_t el = sizeof err;
+        if (getsockopt(fd[i], SOL_SOCKET, SO_ERROR, &err, &el) != 0 || err)
+          return 11;
+        connected[i] = 1;
+      }
+      if (connected[i] && sent[i] < total && (evs[k].events & EPOLLOUT)) {
+        char buf[256];
+        int chunk = total - sent[i];
+        if (chunk > (int)sizeof buf) chunk = (int)sizeof buf;
+        for (int j = 0; j < chunk; j++) buf[j] = pat(i, sent[i] + j);
+        ssize_t w = send(fd[i], buf, chunk, 0);
+        if (w < 0 && errno != EAGAIN) return 12;
+        if (w > 0) {
+          sent[i] += (int)w;
+          if (sent[i] == total) {
+            /* stop asking for writability once the stream is sent */
+            struct epoll_event ev = {.events = EPOLLIN,
+                                     .data = {.u32 = (uint32_t)i}};
+            if (epoll_ctl(ep, EPOLL_CTL_MOD, fd[i], &ev) != 0) return 13;
+          }
+        }
+      }
+      if (evs[k].events & EPOLLIN) {
+        char buf[256];
+        ssize_t r = recv(fd[i], buf, sizeof buf, 0);
+        if (r < 0 && errno != EAGAIN) return 14;
+        for (int j = 0; j < (int)r; j++)
+          if (buf[j] != pat(i, got_n[i] + j)) return 15;
+        if (r > 0) got_n[i] += (int)r;
+        if (got_n[i] > total) return 16;
+        if (got_n[i] == total) {
+          if (epoll_ctl(ep, EPOLL_CTL_DEL, fd[i], NULL) != 0) return 17;
+          close(fd[i]);
+          done[i] = 1;
+          ndone++;
+        }
+      }
+    }
+  }
+  if (ndone != ns) return 18;
+  printf("epoll_client ok streams=%d bytes=%d\n", ns, ns * total);
+  return 0;
+}

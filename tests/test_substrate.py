@@ -201,6 +201,71 @@ class TestRealProcess:
         assert int(out1.now) == int(out2.now)
         assert jnp.array_equal(out1.socks.bytes_recv, out2.socks.bytes_recv)
 
+    def test_epoll_client_with_pipe(self, tmp_path):
+        # epoll_create1/ctl/wait (shim-local, lowered onto OP_POLL) drive
+        # a self-pipe readiness check and 2 concurrent TCP streams.
+        state, params, app = _world(seed=17)
+        sub = Substrate(resolve_ip={_ip_int(SERVER_IP): 0}.get,
+                        workdir=str(tmp_path / "ep"))
+
+        def echo_content(host, vs, offset, n):
+            return bytes(vs.sent[offset:offset + n])
+
+        sub.content_provider = echo_content
+        src = pathlib.Path(__file__).parent / "data" / "epoll_client.c"
+        p = sub.spawn(1, [buildlib.build_binary(src, "epoll_client"),
+                          SERVER_IP, str(SERVER_PORT), "2", "1500"])
+        out = bridge.run(sub, state, params, app, 30 * SEC)
+        stdout = (pathlib.Path(sub.workdir) / "proc-0.stdout").read_text()
+        assert p.exited and p.exit_code == 0, \
+            f"rc={p.exit_code} stdout={stdout!r}"
+        assert "epoll_client ok streams=2 bytes=3000" in stdout
+        assert int(out.err) == 0
+
+    def test_udp_pingpong_real_to_real(self, tmp_path):
+        # Real UDP server + real UDP client: getaddrinfo against the DNS
+        # registry, sendto/recvfrom datagrams carried by the payload
+        # arena, timing by the engine (SubstrateTx ring -> emissions).
+        from shadow1_tpu.substrate import devapp
+
+        def _build():
+            lat, rel = uniform_full_mesh(2, 5 * MS)
+            params = make_net_params(
+                latency_ns=lat, reliability=rel,
+                host_vertex=jnp.arange(2),
+                bw_up_Bps=jnp.full(2, 1 << 30),
+                bw_down_Bps=jnp.full(2, 1 << 30),
+                seed=23, stop_time=30 * SEC)
+            state = make_sim_state(2, sock_slots=8, pool_capacity=1 << 10)
+            state = state.replace(app=devapp.init_state(2))
+            return state, params
+
+        state, params = shadow1_tpu.build_on_host(_build)
+        server_ip = _ip_int(SERVER_IP)
+        client_ip = _ip_int("10.0.0.2")
+        sub = Substrate(
+            resolve_ip={server_ip: 0, client_ip: 1}.get,
+            workdir=str(tmp_path / "udp"),
+            resolve_name={"server": server_ip}.get,
+            host_ip={0: server_ip, 1: client_ip}.get)
+        src = pathlib.Path(__file__).parent / "data" / "udp_pingpong.c"
+        binp = buildlib.build_binary(src, "udp_pingpong")
+        rounds = 6
+        ps = sub.spawn(0, [binp, "server", "5353", str(rounds)])
+        pc = sub.spawn(1, [binp, "client", "5353", str(rounds), "server"])
+        out = bridge.run(sub, state, params, devapp.SubstrateTx(), 30 * SEC)
+        srv_out = (pathlib.Path(sub.workdir) / "proc-0.stdout").read_text()
+        cli_out = (pathlib.Path(sub.workdir) / "proc-1.stdout").read_text()
+        assert ps.exited and ps.exit_code == 0, \
+            f"server rc={ps.exit_code} out={srv_out!r}"
+        assert pc.exited and pc.exit_code == 0, \
+            f"client rc={pc.exit_code} out={cli_out!r}"
+        assert f"udp_server ok rounds={rounds} bytes={rounds * 600}" in srv_out
+        assert f"udp_client ok rounds={rounds} bytes={rounds * 600}" in cli_out
+        assert int(out.err) == 0
+        # Arena hygiene: every delivered datagram's bytes were released.
+        assert sub.arena.stats()["live"] == 0
+
     def test_client_blocks_in_virtual_time(self, tmp_path):
         # usleep(2000) x 3 and ~ROUNDS round trips at 5ms one-way latency:
         # the client's virtual clock must advance by at least the network
