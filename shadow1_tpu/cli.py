@@ -59,16 +59,26 @@ def _parser():
     r.add_argument("--pcap", action="store_true",
                    help="capture sent packets and write capture.pcap to "
                         "the data directory (reference logpcap)")
-    r.add_argument("--pcap-ring", type=int, default=1 << 16,
-                   help="capture ring capacity (older records overwritten)")
+    r.add_argument("--pcap-ring", type=int, default=1 << 17,
+                   help="capture ring capacity; older records are "
+                        "silently overwritten on wrap (each packet now "
+                        "costs up to two records: send + receive "
+                        "direction, hence the doubled default)")
     r.add_argument("--heartbeat-frequency", type=int, default=1,
                    help="heartbeat interval in sim seconds (0 = off)")
     r.add_argument("--log-level", choices=("off", "warning", "debug"),
                    default="off",
                    help="simulation event log level (reference --log-level); "
-                        "writes shadow.log to the data directory")
-    r.add_argument("--log-ring", type=int, default=1 << 16,
-                   help="event-log ring capacity")
+                        "writes shadow.log to the data directory.  NOTE: "
+                        "debug logs EVERY send/deliver -- for large worlds "
+                        "scope it to hosts of interest via <host "
+                        "loglevel=\"debug\"> in the config, or the ring "
+                        "overflows between drains (lost records are "
+                        "counted and reported)")
+    r.add_argument("--log-ring", type=int, default=0,
+                   help="event-log ring capacity (0 = auto: 64k, grown to "
+                        "1M under global debug so a full drain interval "
+                        "fits)")
     r.add_argument("--quiet", action="store_true")
     return p
 
@@ -137,8 +147,14 @@ def run_config(args) -> int:
         import jax.numpy as jnp_
         from .core.state import make_log_ring
         from .observe import LogDrain
+        ring = args.log_ring
+        if ring <= 0:
+            # Debug level (global OR per-host) logs ~2 records per
+            # delivered packet; a 64k ring loses most of a busy drain
+            # interval.  Auto-grow.
+            ring = (1 << 20) if max(host_lvls) >= 2 else (1 << 16)
         state = state.replace(
-            log=make_log_ring(args.log_ring),
+            log=make_log_ring(ring),
             log_level=jnp_.asarray(host_lvls, jnp_.int32))
         drain = LogDrain(
             __import__("os").path.join(args.data_directory, "shadow.log"),

@@ -135,6 +135,46 @@ class RxPkt:
         self.pkt_id = keys_row
 
 
+def _cap_append(state: SimState, mask, *, time_v, src, dst, sport, dport,
+                proto, flags, length, seq, ack, kind) -> SimState:
+    """Append masked flat records to the capture ring (both traffic
+    directions route through here; traced away when capture is off).
+
+    One batch larger than the ring would wrap onto itself and make the
+    surviving record per slot scatter-order-dependent; keep the first
+    `c` records of such a batch instead (deterministic) -- size the ring
+    above the per-step record volume to never hit this.  `total` must
+    then advance by what was *written*, not staged, or the writer would
+    treat never-written slots as valid records."""
+    cap = state.cap
+    c = cap.capacity
+    crank = jnp.cumsum(mask) - 1
+    n_new = jnp.minimum(jnp.sum(mask).astype(I64), c)
+    pos = ((cap.total + crank) % c).astype(I32)
+    idx = jnp.where(mask & (crank < c), pos, c)  # c = dropped write
+
+    def cw(a, val, dtype=None):
+        v = val.reshape(-1) if hasattr(val, "reshape") else val
+        if dtype is not None:
+            v = v.astype(dtype)
+        return a.at[idx].set(v, mode="drop")
+
+    return state.replace(cap=cap.replace(
+        time=cw(cap.time, time_v),
+        src=cw(cap.src, src),
+        dst=cw(cap.dst, dst),
+        sport=cw(cap.sport, sport),
+        dport=cw(cap.dport, dport),
+        proto=cw(cap.proto, proto),
+        flags=cw(cap.flags, flags),
+        length=cw(cap.length, length),
+        seq=cw(cap.seq, seq),
+        ack=cw(cap.ack, ack),
+        kind=cap.kind.at[idx].set(kind, mode="drop"),
+        total=cap.total + n_new,
+    ))
+
+
 def _log_append(state: SimState, mask, code: int, level: int, time_v,
                 host_v, arg_v):
     """Append one event per set mask element into the log ring (traced
@@ -442,6 +482,20 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
     hosts = hosts.replace(last_refill_rx=last)
     if d_rounds > 1:
         span = simtime.SIMTIME_ONE_MILLISECOND
+        # Ordering invariant for future-delivery rounds: the bound uses
+        # _aux_times evaluated at batch START, so any timer ARMED DURING
+        # the batch must not be able to fire inside the remaining span --
+        # i.e. every armable timer delay must exceed `span`.  A future
+        # sub-ms timer (e.g. pacing) would silently reorder events; this
+        # trace-time check turns that into a loud failure.
+        from ..transport import tcp as _tcp_c
+        _min_timer = min(_tcp_c.RTO_MIN, _tcp_c.DELACK_DELAY,
+                         _tcp_c.TIMEWAIT_DELAY)
+        assert _min_timer > span, (
+            f"rx_batch future-delivery span ({span} ns) must stay below "
+            f"every armable TCP timer delay (min {_min_timer} ns); a "
+            f"timer armed mid-batch could otherwise fire inside the "
+            f"batch and be outrun")
         bound = jnp.minimum(_aux_times(state, params, app), tick_t + span)
         bound = jnp.minimum(bound, window_end - 1)
     else:
@@ -547,6 +601,20 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
                                 t_eff, rows, pkt.src)
             state = _log_append(state, deliver, LOG_DELIVER, LOG_DEBUG,
                                 t_eff, rows, pkt.src)
+
+        # Receive-direction capture (reference captures both directions
+        # per interface, network_interface.c:337-373,415-418): delivered
+        # packets AND received-but-router-dropped ones, at the receive
+        # instant.
+        if state.cap is not None:
+            from .state import CAP_DELIVER, CAP_RDROP
+            rec_rx = (deliver | drop) & params.pcap_mask
+            state = _cap_append(
+                state, rec_rx, time_v=t_eff, src=pkt.src, dst=rows,
+                sport=pkt.sport, dport=pkt.dport, proto=pkt.proto,
+                flags=pkt.flags, length=pkt.length, seq=pkt.seq,
+                ack=pkt.ack,
+                kind=jnp.where(drop, CAP_RDROP, CAP_DELIVER))
 
         # Transport delivery (each round stamps at the arrival's time).
         udp_mask = deliver & (pkt.proto == PROTO_UDP)
@@ -797,43 +865,16 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     # Packet capture (PCAP analog; only traced when a CaptureRing is
     # installed): record every placed emission at send time.
     if state.cap is not None:
-        cap = state.cap
-        c = cap.capacity
-        rec = all_placed & (params.pcap_mask[:, None] |
-                            params.pcap_mask[jnp.clip(
-                                em.dst, 0, h - 1)])
-        placedf = rec.reshape(-1)
-        crank = jnp.cumsum(placedf) - 1
-        n_new = jnp.sum(placedf).astype(I64)
-        pos = ((cap.total + crank) % c).astype(I32)
-        # One batch larger than the ring would wrap onto itself and make
-        # the surviving record per slot scatter-order-dependent; keep the
-        # first `c` records of such a batch instead (deterministic) --
-        # size the ring above H*NUM_SLOTS to never hit this.  `total` must
-        # then also advance by what was *written*, not what was staged, or
-        # the writer would treat never-written slots as valid records.
-        idx = jnp.where(placedf & (crank < c), pos, c)  # c = dropped write
-        n_new = jnp.minimum(n_new, c)
-
-        def cw(a, val, dtype=None):
-            v = val.reshape(-1) if hasattr(val, "reshape") else val
-            if dtype is not None:
-                v = v.astype(dtype)
-            return a.at[idx].set(v, mode="drop")
-
-        state = state.replace(cap=cap.replace(
-            time=cw(cap.time, send_t),
-            src=cw(cap.src, src2),
-            dst=cw(cap.dst, em.dst),
-            sport=cw(cap.sport, em.sport),
-            dport=cw(cap.dport, em.dport),
-            proto=cw(cap.proto, em.proto),
-            flags=cw(cap.flags, em.flags),
-            length=cw(cap.length, em.length),
-            seq=cw(cap.seq, em.seq),
-            ack=cw(cap.ack, em.ack),
-            total=cap.total + n_new,
-        ))
+        from .state import CAP_SEND
+        # Send direction records for marked SOURCES only; a marked
+        # destination's inbound view is the CAP_DELIVER/CAP_RDROP records
+        # written at delivery (_rx_phase) -- a dst-gated send record here
+        # would never be exported and only pressure the ring.
+        rec = all_placed & params.pcap_mask[:, None]
+        state = _cap_append(
+            state, rec.reshape(-1), time_v=send_t, src=src2, dst=em.dst,
+            sport=em.sport, dport=em.dport, proto=em.proto, flags=em.flags,
+            length=em.length, seq=em.seq, ack=em.ack, kind=CAP_SEND)
     return state, all_placed
 
 

@@ -612,11 +612,21 @@ class CaptureRing:
     length: jnp.ndarray  # [C] i32 payload bytes
     seq: jnp.ndarray     # [C] u32
     ack: jnp.ndarray     # [C] u32
+    kind: jnp.ndarray    # [C] i32 CAP_* direction/disposition
     total: jnp.ndarray   # i64 scalar: lifetime records appended
 
     @property
     def capacity(self) -> int:
         return self.time.shape[0]
+
+
+# Capture record kinds: the send direction (recorded at the source
+# interface) vs the receive direction (recorded at the destination when
+# delivered / when the router dropped it) -- the two per-interface views
+# the reference's capture produces (network_interface.c:337-373,415-418).
+CAP_SEND = 0
+CAP_DELIVER = 1
+CAP_RDROP = 2
 
 
 def make_capture_ring(capacity: int = 1 << 16) -> CaptureRing:
@@ -631,6 +641,7 @@ def make_capture_ring(capacity: int = 1 << 16) -> CaptureRing:
         length=_zeros((capacity,), I32),
         seq=_zeros((capacity,), U32),
         ack=_zeros((capacity,), U32),
+        kind=_zeros((capacity,), I32),
         total=jnp.asarray(0, I64),
     )
 

@@ -134,10 +134,16 @@ def write_pcap(path: str, cap, ip_of_host=None, host_filter=None):
 
     ip_of_host: optional callable host_index -> 32-bit IP (e.g. from the
     DNS registry); defaults to 10.x.y.z derived from the index.
-    host_filter: optional host index -- keep only records whose source or
-    destination is that host (reference per-host logpcap capture).
+    host_filter: optional host index -- that host's per-interface view:
+    its SENT records plus its RECEIVE-direction records (deliveries and
+    router drops), like the reference's per-host logpcap capture which
+    records both directions (network_interface.c:337-373,415-418).
+    Without a filter, only send-direction records are kept so the global
+    wire view lists each packet once.
     """
     import struct as pystruct
+
+    from .core.state import CAP_SEND
 
     if ip_of_host is None:
         def ip_of_host(i):
@@ -153,9 +159,13 @@ def write_pcap(path: str, cap, ip_of_host=None, host_filter=None):
 
     src = np.asarray(cap.src)
     dst = np.asarray(cap.dst)
+    kind = np.asarray(cap.kind)
     if host_filter is not None:
-        keep = (src[order] == host_filter) | (dst[order] == host_filter)
+        keep = ((src[order] == host_filter) & (kind[order] == CAP_SEND)) | \
+            ((dst[order] == host_filter) & (kind[order] != CAP_SEND))
         order = order[keep]
+    else:
+        order = order[kind[order] == CAP_SEND]
     sport = np.asarray(cap.sport)
     dport = np.asarray(cap.dport)
     proto = np.asarray(cap.proto)

@@ -30,6 +30,43 @@ class TestOnionCircuits:
         # Teardown cascaded: no connection left half-open at the relays.
         assert int(out.hosts.tx_queued.sum()) == 0
 
+    def test_rx_batch_equivalence(self):
+        # Future-delivery batching (rx_batch=4) must reproduce the
+        # rx_batch=1 trajectory's APPLICATION-VISIBLE outcomes exactly:
+        # each batched arrival is processed at its own timestamp, so
+        # completion times, forwarded bytes, and per-socket stream state
+        # must match bit-for-bit.  Pins the ordering argument in
+        # engine._rx_phase (a regression here means an event slipped
+        # between a batched arrival and its effects).  Known benign
+        # difference NOT asserted: total packet counts -- each batch
+        # round may emit its own delayed-ACK-threshold ACK, so batching
+        # sends slightly more (pure) ACKs than one-arrival-per-step.
+        from shadow1_tpu.apps.onion import Onion
+
+        class Onion1(Onion):
+            rx_batch = 1
+
+            def __hash__(self):
+                return hash("onion-rx1")
+
+            def __eq__(self, other):
+                return isinstance(other, Onion1)
+
+        s, p, a4 = sim.build_onion(num_circuits=2,
+                                   bytes_per_circuit=1 << 14,
+                                   stop_time=60 * SEC, seed=5)
+        o_batched = engine.run_until(s, p, a4, 60 * SEC)
+        o_single = engine.run_until(s, p, Onion1(), 60 * SEC)
+        assert jnp.array_equal(o_batched.app.done_t, o_single.app.done_t)
+        assert jnp.array_equal(o_batched.app.forwarded,
+                               o_single.app.forwarded)
+        assert jnp.array_equal(o_batched.socks.bytes_recv,
+                               o_single.socks.bytes_recv)
+        assert jnp.array_equal(o_batched.socks.bytes_sent,
+                               o_single.socks.bytes_sent)
+        # Batching exists to SAVE steps.
+        assert int(o_batched.n_steps) < int(o_single.n_steps)
+
     def test_deterministic(self):
         s, p, a = sim.build_onion(num_circuits=3,
                                   bytes_per_circuit=1 << 15,

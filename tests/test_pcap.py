@@ -16,19 +16,27 @@ SEC = simtime.SIMTIME_ONE_SECOND
 
 
 class TestCapture:
-    def test_ring_records_sent_packets(self):
+    def test_ring_records_both_directions(self):
+        from shadow1_tpu.core.state import CAP_DELIVER, CAP_SEND
         state, params, app = sim.build_phold(
             num_hosts=4, latency_ns=10 * MS, msgs_per_host=2,
             stop_time=SEC, seed=2)
         state = state.replace(cap=make_capture_ring(1024))
         out = engine.run_until(state, params, app, 500 * MS)
         total = int(out.cap.total)
-        assert total == int(out.hosts.pkts_sent.sum())
-        assert total > 0
+        # Send direction at emit time + receive direction at delivery.
+        assert total == int(out.hosts.pkts_sent.sum() +
+                            out.hosts.pkts_recv.sum() +
+                            out.hosts.pkts_dropped_router.sum())
+        assert total > 0 and total <= 1024  # no wrap in this world
+        kinds = jnp.asarray(out.cap.kind[:total])
+        assert int((kinds == CAP_SEND).sum()) == \
+            int(out.hosts.pkts_sent.sum())
+        assert int((kinds == CAP_DELIVER).sum()) == \
+            int(out.hosts.pkts_recv.sum())
         # Records carry sane metadata.
-        n = min(total, 1024)
-        assert bool(jnp.all(out.cap.proto[:n] == 17))   # phold is UDP
-        assert bool(jnp.all(out.cap.time[:n] <= 500 * MS))
+        assert bool(jnp.all(out.cap.proto[:total] == 17))   # phold is UDP
+        assert bool(jnp.all(out.cap.time[:total] <= 500 * MS))
 
     def test_capture_does_not_change_trajectory(self):
         kw = dict(num_hosts=4, latency_ns=10 * MS, msgs_per_host=2,
@@ -43,14 +51,31 @@ class TestCapture:
                                captured.hosts.pkts_sent)
 
     def test_pcap_file_roundtrip(self, tmp_path):
+        from shadow1_tpu.core.state import CAP_SEND
         state, params, app = sim.build_bulk(
             num_hosts=2, server=0, bytes_per_client=30_000,
             latency_ns=5 * MS, stop_time=10 * SEC)
         state = state.replace(cap=make_capture_ring(4096))
         out = engine.run_until(state, params, app, 10 * SEC)
         path = os.path.join(tmp_path, "capture.pcap")
+        # Unfiltered export = the wire view: send-direction records only
+        # (each packet once).
         n = write_pcap(path, out.cap)
-        assert n == min(int(out.cap.total), 4096) and n > 0
+        total = min(int(out.cap.total), 4096)
+        n_send = int((jnp.asarray(out.cap.kind[:total]) == CAP_SEND).sum())
+        assert n == n_send and n > 0
+
+        # Per-host export = that interface's view, BOTH directions.
+        n0 = write_pcap(os.path.join(tmp_path, "h0.pcap"), out.cap,
+                        host_filter=0)
+        kinds = jnp.asarray(out.cap.kind[:total])
+        src = jnp.asarray(out.cap.src[:total])
+        dst = jnp.asarray(out.cap.dst[:total])
+        expect = int((((src == 0) & (kinds == CAP_SEND)) |
+                      ((dst == 0) & (kinds != CAP_SEND))).sum())
+        assert n0 == expect
+        # The receive direction is actually present.
+        assert int(((dst == 0) & (kinds != CAP_SEND)).sum()) > 0
 
         with open(path, "rb") as f:
             data = f.read()
