@@ -453,6 +453,26 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
     else:
         tail_drop = jnp.zeros_like(due)
 
+    # Tail drops are receive-side events a masked receiver must see in
+    # its capture (they are exactly the overflow traffic an operator
+    # enables capture to diagnose); only traced when a host configures
+    # an interface buffer.
+    if state.cap is not None and params.has_iface_buf:
+        from .state import CAP_RDROP
+        rows_b = jnp.broadcast_to(
+            jnp.arange(h, dtype=I32)[:, None], (h, ki))
+        td_mask = (tail_drop & params.pcap_mask[:, None]).reshape(-1)
+        blk = ib.blk
+        state = _cap_append(
+            state, td_mask,
+            time_v=jnp.broadcast_to(tick_t[:, None], (h, ki)),
+            src=blk[:, ICOL_SRC], dst=rows_b,
+            sport=blk[:, ICOL_SPORT], dport=blk[:, ICOL_DPORT],
+            proto=blk[:, ICOL_PROTO], flags=blk[:, ICOL_FLAGS],
+            length=blk[:, ICOL_LEN],
+            seq=_bitcast_i32_u32(blk[:, ICOL_SEQ]),
+            ack=_bitcast_i32_u32(blk[:, ICOL_ACK]), kind=CAP_RDROP)
+
     st2 = jnp.where(due, STAGE_RX_QUEUED, st2)
     st2 = jnp.where(tail_drop, STAGE_FREE, st2)
     status = jnp.where(due.reshape(-1),

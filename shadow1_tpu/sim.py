@@ -176,6 +176,7 @@ def build_onion(num_circuits: int,
                 seed: int = 1,
                 sock_slots: int = 8,
                 pool_slab: int = 128,
+                inbox_slab: int | None = None,
                 bw_Bps: int = 1 << 27):
     """Tor-like onion-circuit world (apps/onion.py): `num_circuits` chains
     of client -> hops relays -> server, each circuit streaming
@@ -197,8 +198,10 @@ def build_onion(num_circuits: int,
             bw_up_Bps=jnp.full(num_hosts, bw_Bps),
             bw_down_Bps=jnp.full(num_hosts, bw_Bps),
             seed=seed, stop_time=stop_time)
-        state = make_sim_state(num_hosts, sock_slots=sock_slots,
-                               pool_capacity=num_hosts * pool_slab)
+        state = make_sim_state(
+            num_hosts, sock_slots=sock_slots,
+            pool_capacity=num_hosts * pool_slab,
+            inbox_capacity=(num_hosts * inbox_slab) if inbox_slab else None)
         # Relays and servers listen; circuit legs arrive as children.
         listeners = jnp.asarray((role == 1) | (role == 2))
         state = state.replace(socks=tcp_mod.listen_v(

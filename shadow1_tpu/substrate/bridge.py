@@ -175,11 +175,14 @@ class Substrate:
 
     def __init__(self, resolve_ip, workdir: str, sock_slot_base: int = 0,
                  ephemeral_base: int = 40000, resolve_name=None,
-                 host_ip=None):
+                 host_ip=None, wedge_timeout_ms: int = 30000):
         """resolve_ip: callable(int ipv4) -> host index (DNS analog).
         resolve_name: callable(str) -> int ipv4 for getaddrinfo
         (OP_RESOLVE); host_ip: callable(host index) -> int ipv4 used to
-        fill recvfrom()'s source address."""
+        fill recvfrom()'s source address.  wedge_timeout_ms: how long a
+        plugin may compute between syscalls before it is declared wedged
+        -- raise it for legitimately compute-heavy plugins (the default
+        treats >30s of wall-clock between syscalls as a runaway loop)."""
         self._lib = _SeqLib().lib
         os.makedirs(workdir, exist_ok=True)
         self.workdir = workdir
@@ -191,6 +194,7 @@ class Substrate:
         self.procs: list[RealProcess] = []
         self.sock_slot_base = sock_slot_base
         self._next_port = ephemeral_base
+        self.wedge_timeout_ms = int(wedge_timeout_ms)
         self.content_provider = None   # (host, slot, vsock, n) -> bytes
         self._pending = []             # queued device ops for this sync
         self.max_slots = 1 << 30       # refined from the state at sync
@@ -266,6 +270,16 @@ class Substrate:
         """Publish the clock, run every runnable process until it blocks,
         apply the produced socket ops.  Returns the updated state."""
         self._lib.seq_settime(self.handle, EMULATED_EPOCH_NS + now_ns)
+        # Idle fast path: when every live process is parked on a pure
+        # timer (sleep/poll-timeout with a future wake), no syscall can
+        # run and no socket registers matter -- skip the device fetch
+        # entirely (it costs a multi-array device_get per sync, the
+        # r3-flagged per-window overhead).
+        live = [p for p in self.procs if not p.exited]
+        if live and all(
+                p.parked is not None and p.parked.op == OP_SLEEP
+                and p.parked.wake_ns > now_ns for p in live):
+            return state
         regs = self._fetch(state)
         self._pending = []
         # Local deltas so several syscalls within one sync see each
@@ -357,7 +371,9 @@ class Substrate:
                 return  # parked
             self._reply(p, *rep)
 
-    def _wait(self, p: RealProcess, timeout_ms: int = 30000):
+    def _wait(self, p: RealProcess, timeout_ms: int | None = None):
+        if timeout_ms is None:
+            timeout_ms = self.wedge_timeout_ms
         op = ctypes.c_uint32()
         fd = ctypes.c_int32()
         a0 = ctypes.c_int64()

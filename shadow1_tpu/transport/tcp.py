@@ -980,8 +980,32 @@ def transmit(state, params, em, tick_t, active):
     s_num = socks.slots
     slot_ids = jnp.arange(s_num, dtype=I32)[None, :]
 
+    # NIC-queue back-pressure (the vectorized analog of a full device TX
+    # queue stopping the stack): when the host's outbox slab lacks room
+    # for a full transmit round plus reply-lane headroom, DEFER
+    # transmission instead of emitting packets that staging would have
+    # to drop.  Slab-overflow drops look like heavy loss to TCP --
+    # retransmissions + SACK churn that keep the expensive recovery path
+    # hot (PERF.md r4: deeper buffers made the 10k rung WORSE) -- while
+    # deferral is invisible: the outbox frees at the next window
+    # boundary and t_resume re-ticks the sender there.
+    ko = state.pool.capacity // h
+    free_out = jnp.sum(
+        (state.pool.stage == st.STAGE_FREE).reshape(h, ko), axis=1)
+    # Headroom = the step's FULL emission lane count (TX slots + reply +
+    # timer + app + extra rx_batch reply lanes): every lane could stage
+    # this tick, and an under-counted reserve would re-create the very
+    # slab-overflow drops the gate exists to prevent.
+    room_ok = free_out >= em.valid.shape[1]
+    tx_active = active & room_ok
+
     retx, can_new, fin_ready = _tx_eligibility(socks)
-    want = (retx | can_new | fin_ready) & active[:, None]
+    want = (retx | can_new | fin_ready) & tx_active[:, None]
+    # Suppressed-but-willing senders must wake when the outbox drains
+    # (next window); without this a sender with only an RTO armed would
+    # stall for a full RTO.
+    deferred = active & ~room_ok & \
+        jnp.any(retx | can_new | fin_ready, axis=1)
     # Socket selection qdisc (reference network_interface.c:466-540):
     # FIFO serves the lowest eligible slot; RR rotates a per-host cursor
     # so concurrent sockets share the interface fairly.
@@ -1068,10 +1092,14 @@ def transmit(state, params, em, tick_t, active):
 
     # More sendable work remains at this instant -> re-tick the host.
     retx, can_new, fin_ready = _tx_eligibility(socks)
-    more = jnp.any((retx | can_new | fin_ready), axis=1) & active
+    more = jnp.any((retx | can_new | fin_ready), axis=1) & tx_active
     hosts = state.hosts
+    t_res = jnp.where(
+        more, tick_t,
+        jnp.where(deferred, tick_t + params.min_latency_ns,
+                  jnp.asarray(simtime.SIMTIME_INVALID, I64)))
     hosts = hosts.replace(
-        t_resume=jnp.where(more, tick_t, hosts.t_resume),
+        t_resume=jnp.minimum(hosts.t_resume, t_res),
         rr_next=jnp.where(use_rr & have, (pick + 1) % s_num,
                           hosts.rr_next))
     return state.replace(socks=socks, hosts=hosts), em

@@ -1,0 +1,26 @@
+/* Crash-containment fixture (tests/test_substrate.py): connects, sends
+ * part of a stream, then exits abnormally WITHOUT closing the socket.
+ * The simulation must carry on (count the exit code, never wedge);
+ * reference analog: a plugin process dying mid-run is contained by the
+ * host, not fatal to the simulation. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc < 3) return 2;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  struct sockaddr_in a = {0};
+  a.sin_family = AF_INET;
+  a.sin_port = htons(atoi(argv[2]));
+  inet_pton(AF_INET, argv[1], &a.sin_addr);
+  if (connect(fd, (struct sockaddr *)&a, sizeof a) != 0) return 4;
+  char buf[100];
+  memset(buf, 'Z', sizeof buf);
+  send(fd, buf, sizeof buf, 0);
+  usleep(50000); /* let some of it fly */
+  exit(3);       /* die mid-stream, socket left open */
+}

@@ -266,6 +266,34 @@ class TestRealProcess:
         # Arena hygiene: every delivered datagram's bytes were released.
         assert sub.arena.stats()["live"] == 0
 
+    def test_crash_containment_and_many_procs(self, tmp_path):
+        # Three real processes on one host: two well-behaved echo
+        # clients and one that dies mid-stream without closing its
+        # socket.  The crash must be contained -- the exit code recorded,
+        # the other clients unaffected, the simulation never wedged.
+        state, params, app = _world(seed=29)
+        sub = Substrate(resolve_ip={_ip_int(SERVER_IP): 0}.get,
+                        workdir=str(tmp_path / "crash"))
+
+        def echo_content(host, vs, offset, n):
+            return bytes(vs.sent[offset:offset + n])
+
+        sub.content_provider = echo_content
+        good = buildlib.build_binary(
+            pathlib.Path(__file__).parent / "data" / "eof_client.c",
+            "eof_client")
+        bad = buildlib.build_binary(
+            pathlib.Path(__file__).parent / "data" / "crasher.c",
+            "crasher")
+        p1 = sub.spawn(1, [good, SERVER_IP, str(SERVER_PORT), "800"])
+        px = sub.spawn(1, [bad, SERVER_IP, str(SERVER_PORT)])
+        p2 = sub.spawn(1, [good, SERVER_IP, str(SERVER_PORT), "900"])
+        out = bridge.run(sub, state, params, app, 30 * SEC)
+        assert px.exited and px.exit_code == 3   # abnormal exit recorded
+        assert p1.exited and p1.exit_code == 0, f"p1 rc={p1.exit_code}"
+        assert p2.exited and p2.exit_code == 0, f"p2 rc={p2.exit_code}"
+        assert int(out.err) == 0
+
     def test_client_blocks_in_virtual_time(self, tmp_path):
         # usleep(2000) x 3 and ~ROUNDS round trips at 5ms one-way latency:
         # the client's virtual clock must advance by at least the network

@@ -21,6 +21,7 @@ import time
 
 import shadow1_tpu  # noqa: F401
 import jax
+import jax.numpy as jnp
 
 from shadow1_tpu import sim
 from shadow1_tpu.core import engine, simtime
@@ -63,19 +64,53 @@ def rung_phold():
 
 
 def rung_onion(circuits: int, pool_slab: int = 64):
-    # 1 MiB streams keep the measured span busy.  NOTE: 16 MiB streams
-    # (multi-megabyte autotuned windows) reproducibly crash the tunnel
-    # backend's TPU worker -- keep this sizing until that is fixed
-    # (BASELINE.md "known backend issue").
-    s, p, a = sim.build_onion(num_circuits=circuits,
-                              bytes_per_circuit=1 << 20,
-                              pool_slab=pool_slab,
-                              stop_time=120 * SEC)
-    res, out = _measure(s, p, a, 1, 10)
-    res["circuits_done"] = int((out.app.done_t !=
-                                simtime.SIMTIME_INVALID).sum())
-    res["hosts"] = int(out.hosts.num_hosts)
-    return res
+    # Completion-time metric (round 4: TX back-pressure made fixed spans
+    # finish inside the warmup): run until EVERY circuit completes and
+    # report simulated/wall time over exactly that busy phase.
+    # 1 MiB streams; 16 MiB (and pool_slab 128 at 10k hosts) hit the
+    # known tunnel-backend kernel fault (tools/repro_tunnel_crash.py).
+    def build():
+        return sim.build_onion(num_circuits=circuits,
+                               bytes_per_circuit=1 << 20,
+                               pool_slab=pool_slab,
+                               stop_time=120 * SEC)
+
+    s, p, a = build()
+    # Warm the executable over the REAL busy phase (compile + first-run
+    # costs land here), then measure fresh worlds; best-of-2 because the
+    # tunnel worker's throughput varies with its health (it degrades
+    # after faults and recovers over minutes -- bench.py does the same).
+    jax.block_until_ready(engine.run_until(s, p, a, 5 * SEC))
+    best = None
+    for _attempt in range(2):
+        s, p, a = build()
+        jax.block_until_ready(s)
+        t0 = time.perf_counter()
+        t_sim = 0
+        while t_sim < 120:
+            t_sim += 5
+            s = engine.run_until(s, p, a, t_sim * SEC)
+            done = int((s.app.done_t != simtime.SIMTIME_INVALID).sum())
+            if done == circuits:
+                break
+        wall = time.perf_counter() - t0
+        if best is None or wall < best[0]:
+            best = (wall, t_sim, done, s)
+    wall, t_sim, done, s = best
+    INVT = simtime.SIMTIME_INVALID
+    done_t = int(jnp.max(jnp.where(s.app.done_t != INVT, s.app.done_t, 0)))
+    sim_s = done_t / SEC
+    return {
+        "circuits_done": done,
+        # None on timeout: a partial run has no completion time.
+        "sim_seconds_to_complete": round(sim_s, 3) if done == circuits
+        else None,
+        "wall_seconds": round(wall, 3),
+        "sim_per_wall": round(t_sim / wall, 3),  # sim-s actually executed
+        "microsteps": int(s.n_steps),
+        "err": int(s.err),
+        "hosts": int(s.hosts.num_hosts),
+    }
 
 
 def rung_gossip():
@@ -112,7 +147,11 @@ def main(rungs):
     if "4" in rungs:
         record("phold_16k", rung_phold)
     if "5" in rungs:
-        record("onion_10k", lambda: rung_onion(2000, pool_slab=32))
+        # slab 64 halves pool-overflow drops vs 32 (fewer retransmits ->
+        # the SACK fast path stays on): 0.537x vs 0.451x measured r4.
+        # slab 128 at this scale hits the tunnel-backend kernel fault
+        # (tools/repro_tunnel_crash.py) -- do not raise until that's fixed.
+        record("onion_10k", lambda: rung_onion(2000, pool_slab=64))
     if "6" in rungs:
         record("gossip_500", rung_gossip)
     print(json.dumps(results))
