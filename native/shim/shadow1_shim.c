@@ -33,6 +33,7 @@
 #include <poll.h>
 #include <stdarg.h>
 #include <sys/epoll.h>
+#include <sys/timerfd.h>
 #include <stddef.h>
 #include <stdint.h>
 #include <stdio.h>
@@ -57,6 +58,13 @@
 #define EPFD_BASE (VFD_BASE + MAX_VFD)
 #define MAX_EPFD 64
 #define MAX_WATCH 256
+
+/* timerfds are also shim-local: expiry is a pure function of the
+ * virtual clock page, so readiness needs no RPC; only BLOCKING (read
+ * before expiry, poll with no other ready fd) parks the process in
+ * virtual time via OP_SLEEP (reference timer.c/timerfd semantics). */
+#define TFD_BASE (EPFD_BASE + MAX_EPFD)
+#define MAX_TFD 64
 
 /* ---- wire protocol (must match native/sequencer.cc + substrate) ---- */
 enum {
@@ -116,6 +124,21 @@ typedef struct {
 } epoll_inst_t;
 
 static epoll_inst_t g_ep[MAX_EPFD];
+
+typedef struct {
+  int used;
+  int nonblock;         /* TFD_NONBLOCK: read returns EAGAIN pre-expiry */
+  int64_t expiry_ns;    /* absolute virtual ns; 0 = disarmed */
+  int64_t interval_ns;  /* periodic re-arm; 0 = one-shot */
+} tfd_t;
+
+static tfd_t g_tfd[MAX_TFD];
+
+static int is_tfd(int fd) {
+  return fd >= TFD_BASE && fd < TFD_BASE + MAX_TFD && g_tfd[fd - TFD_BASE].used;
+}
+
+static ssize_t tfd_read(int fd, void *buf, size_t n);
 
 static ssize_t (*real_read)(int, void *, size_t);
 static ssize_t (*real_write)(int, const void *, size_t);
@@ -351,6 +374,7 @@ ssize_t recv(int fd, void *buf, size_t n, int flags) {
 
 ssize_t read(int fd, void *buf, size_t n) {
   if (is_vfd(fd)) return vrecv(fd, buf, n, 0);
+  if (is_tfd(fd)) return tfd_read(fd, buf, n);
   return real_read(fd, buf, n);
 }
 
@@ -368,6 +392,10 @@ int close(int fd) {
   }
   if (fd >= EPFD_BASE && fd < EPFD_BASE + MAX_EPFD) {
     g_ep[fd - EPFD_BASE].used = 0;  /* epoll instance is shim-local */
+    return 0;
+  }
+  if (fd >= TFD_BASE && fd < TFD_BASE + MAX_TFD) {
+    g_tfd[fd - TFD_BASE].used = 0;  /* timerfd is shim-local */
     return 0;
   }
   return real_close(fd);
@@ -424,46 +452,219 @@ int fcntl(int fd, int cmd, ...) {
  * under the shim only ever wait on simulated sockets.  Wire format:
  * request data = nfds x {int32 fd, int32 events}, a0 = timeout_ms;
  * reply data = nfds x {int32 revents, int32 soerr}, ret = #ready. */
-int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
-  int any_v = 0;
-  for (nfds_t i = 0; i < nfds; i++)
-    if (is_vfd(fds[i].fd)) any_v = 1;
-  if (g_seq_fd >= 0 && !any_v && timeout != 0) {
-    /* No simulated fds but a wait was requested: sleeping must consume
-     * VIRTUAL time (a real sleep here stops the virtual clock and trips
-     * the sequencer's wedge watchdog).  Infinite timeout parks forever
-     * in sim time (the process is permanently idle). */
-    req_t rq = {.op = OP_SLEEP, .fd = -1,
-                .a0 = timeout < 0 ? (int64_t)1 << 62
-                                  : (int64_t)timeout * 1000000LL,
-                .len = 0};
-    rep_t rp;
-    rpc(&rq, &rp);
-    for (nfds_t i = 0; i < nfds; i++) fds[i].revents = 0;
-    return 0;
+/* Timerfd readiness is local (virtual-clock page); fill revents for tfd
+ * entries at time `now`, returning how many are ready. */
+static int tfd_fill(struct pollfd *fds, nfds_t nfds, int64_t now) {
+  int n = 0;
+  for (nfds_t i = 0; i < nfds; i++) {
+    if (!is_tfd(fds[i].fd)) continue;
+    tfd_t *t = &g_tfd[fds[i].fd - TFD_BASE];
+    fds[i].revents = 0;
+    if (t->expiry_ns != 0 && now >= t->expiry_ns &&
+        (fds[i].events & POLLIN)) {
+      fds[i].revents = POLLIN;
+      n++;
+    }
   }
-  if (g_seq_fd < 0 || !any_v || nfds > MAX_DATA / 8) {
+  return n;
+}
+
+int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
+  int any_v = 0, any_t = 0;
+  int64_t next_exp = (int64_t)1 << 62;
+  for (nfds_t i = 0; i < nfds; i++) {
+    if (is_vfd(fds[i].fd)) any_v = 1;
+    else if (is_tfd(fds[i].fd)) {
+      any_t = 1;
+      tfd_t *t = &g_tfd[fds[i].fd - TFD_BASE];
+      if (t->expiry_ns != 0 && t->expiry_ns < next_exp)
+        next_exp = t->expiry_ns;
+    }
+  }
+  if (g_seq_fd < 0 || nfds > MAX_DATA / 8) {
+    /* Unmanaged, or too many fds to marshal: visible real-poll failure
+     * beats a silent virtual sleep over ready simulated fds. */
     static int (*real_poll)(struct pollfd *, nfds_t, int);
     if (!real_poll) real_poll = dlsym(RTLD_NEXT, "poll");
     return real_poll(fds, nfds, timeout);
   }
-  req_t rq = {.op = OP_POLL, .fd = -1, .a0 = timeout, .len = (uint32_t)(nfds * 8)};
-  int32_t *w = (int32_t *)rq.data;
-  for (nfds_t i = 0; i < nfds; i++) {
-    w[2 * i] = fds[i].fd;
-    w[2 * i + 1] = fds[i].events;
+  if (!any_v && !any_t) {
+    if (timeout != 0) {
+      /* No simulated fds but a wait was requested: sleeping must
+       * consume VIRTUAL time (a real sleep here stops the virtual clock
+       * and trips the sequencer's wedge watchdog).  Infinite timeout
+       * parks forever in sim time (the process is permanently idle). */
+      req_t rq = {.op = OP_SLEEP, .fd = -1,
+                  .a0 = timeout < 0 ? (int64_t)1 << 62
+                                    : (int64_t)timeout * 1000000LL,
+                  .len = 0};
+      rep_t rp;
+      rpc(&rq, &rp);
+      for (nfds_t i = 0; i < nfds; i++) fds[i].revents = 0;
+      return 0;
+    }
+    static int (*real_poll0)(struct pollfd *, nfds_t, int);
+    if (!real_poll0) real_poll0 = dlsym(RTLD_NEXT, "poll");
+    return real_poll0(fds, nfds, 0);
   }
+
+  /* Effective timeout: a pending timerfd expiry bounds the wait. */
+  int64_t now = any_t ? vnow() : 0;
+  int t_ready = any_t ? tfd_fill(fds, nfds, now) : 0;
+  int eff_timeout = timeout;
+  if (any_t) {
+    if (t_ready > 0) eff_timeout = 0;
+    else if (next_exp < ((int64_t)1 << 62)) {
+      int64_t ms = (next_exp - now + 999999) / 1000000;
+      if (ms < 1) ms = 1;
+      if (ms > 0x7FFFFFFF) ms = 0x7FFFFFFF;  /* far-future: clamp */
+      if (timeout < 0 || ms < timeout) eff_timeout = (int)ms;
+    }
+  }
+
+  if (!any_v) {
+    /* Timerfd-only wait: park in virtual time until the expiry (or the
+     * caller's timeout), then re-evaluate.  Non-simulated entries in
+     * the set report not-ready. */
+    for (nfds_t i = 0; i < nfds; i++)
+      if (!is_tfd(fds[i].fd)) fds[i].revents = 0;
+    if (t_ready > 0 || eff_timeout == 0) return t_ready;
+    req_t rq = {.op = OP_SLEEP, .fd = -1,
+                .a0 = eff_timeout < 0 ? (int64_t)1 << 62
+                                      : (int64_t)eff_timeout * 1000000LL,
+                .len = 0};
+    rep_t rp;
+    rpc(&rq, &rp);
+    return tfd_fill(fds, nfds, vnow());
+  }
+
+  /* Marshal ONLY simulated-socket entries; timerfds are local and real
+   * fds are reported not-ready by the bridge contract. */
+  req_t rq = {.op = OP_POLL, .fd = -1, .a0 = eff_timeout, .len = 0};
+  int32_t *w = (int32_t *)rq.data;
+  int widx[MAX_DATA / 8];
+  int nw = 0;
+  for (nfds_t i = 0; i < nfds; i++) {
+    if (is_tfd(fds[i].fd)) continue;
+    w[2 * nw] = fds[i].fd;
+    w[2 * nw + 1] = fds[i].events;
+    widx[nw++] = (int)i;
+  }
+  rq.len = (uint32_t)(nw * 8);
   rep_t rp;
   int64_t r = rpc(&rq, &rp);
   if (r < 0) return (int)r;
   const int32_t *rv = (const int32_t *)rp.data;
-  for (nfds_t i = 0; i < nfds; i++) {
-    fds[i].revents = (short)rv[2 * i];
-    int soerr = rv[2 * i + 1];
-    if (is_vfd(fds[i].fd) && soerr)
-      g_vfd_soerr[fds[i].fd - VFD_BASE] = soerr;
+  int total = 0;
+  for (int k = 0; k < nw; k++) {
+    struct pollfd *p = &fds[widx[k]];
+    p->revents = (short)rv[2 * k];
+    if (p->revents) total++;
+    int soerr = rv[2 * k + 1];
+    if (is_vfd(p->fd) && soerr)
+      g_vfd_soerr[p->fd - VFD_BASE] = soerr;
   }
-  return (int)r;
+  if (any_t) total += tfd_fill(fds, nfds, vnow());
+  return total;
+}
+
+/* ---- timerfd (shim-local against the virtual clock) ---- */
+
+int timerfd_create(int clockid, int flags) {
+  (void)clockid;
+  (void)flags;
+  if (g_seq_fd < 0) {
+    static int (*real_tc)(int, int);
+    if (!real_tc) real_tc = dlsym(RTLD_NEXT, "timerfd_create");
+    return real_tc(clockid, flags);
+  }
+  for (int i = 0; i < MAX_TFD; i++) {
+    if (!g_tfd[i].used) {
+      g_tfd[i].used = 1;
+      g_tfd[i].nonblock = (flags & TFD_NONBLOCK) != 0;
+      g_tfd[i].expiry_ns = 0;
+      g_tfd[i].interval_ns = 0;
+      return TFD_BASE + i;
+    }
+  }
+  errno = EMFILE;
+  return -1;
+}
+
+int timerfd_settime(int fd, int flags, const struct itimerspec *new_v,
+                    struct itimerspec *old_v) {
+  if (!is_tfd(fd)) {
+    static int (*real_ts)(int, int, const struct itimerspec *,
+                          struct itimerspec *);
+    if (!real_ts) real_ts = dlsym(RTLD_NEXT, "timerfd_settime");
+    return real_ts(fd, flags, new_v, old_v);
+  }
+  tfd_t *t = &g_tfd[fd - TFD_BASE];
+  int64_t now = vnow();
+  if (old_v) {
+    int64_t rem = t->expiry_ns ? t->expiry_ns - now : 0;
+    if (rem < 0) rem = 0;
+    old_v->it_value.tv_sec = rem / 1000000000LL;
+    old_v->it_value.tv_nsec = rem % 1000000000LL;
+    old_v->it_interval.tv_sec = t->interval_ns / 1000000000LL;
+    old_v->it_interval.tv_nsec = t->interval_ns % 1000000000LL;
+  }
+  if (!new_v) { errno = EFAULT; return -1; }
+  int64_t val = (int64_t)new_v->it_value.tv_sec * 1000000000LL +
+                new_v->it_value.tv_nsec;
+  t->interval_ns = (int64_t)new_v->it_interval.tv_sec * 1000000000LL +
+                   new_v->it_interval.tv_nsec;
+  if (val == 0)
+    t->expiry_ns = 0;  /* disarm */
+  else
+    t->expiry_ns = (flags & 1 /* TFD_TIMER_ABSTIME */) ? val : now + val;
+  return 0;
+}
+
+int timerfd_gettime(int fd, struct itimerspec *cur) {
+  if (!is_tfd(fd)) {
+    static int (*real_tg)(int, struct itimerspec *);
+    if (!real_tg) real_tg = dlsym(RTLD_NEXT, "timerfd_gettime");
+    return real_tg(fd, cur);
+  }
+  tfd_t *t = &g_tfd[fd - TFD_BASE];
+  int64_t rem = t->expiry_ns ? t->expiry_ns - vnow() : 0;
+  if (rem < 0) rem = 0;
+  cur->it_value.tv_sec = rem / 1000000000LL;
+  cur->it_value.tv_nsec = rem % 1000000000LL;
+  cur->it_interval.tv_sec = t->interval_ns / 1000000000LL;
+  cur->it_interval.tv_nsec = t->interval_ns % 1000000000LL;
+  return 0;
+}
+
+/* Blocking read on a timerfd parks in VIRTUAL time until expiry, then
+ * returns the u64 expiration count (re-arming periodic timers). */
+static ssize_t tfd_read(int fd, void *buf, size_t n) {
+  if (n < 8) { errno = EINVAL; return -1; }
+  tfd_t *t = &g_tfd[fd - TFD_BASE];
+  for (;;) {
+    int64_t now = vnow();
+    if (t->expiry_ns != 0 && now >= t->expiry_ns) {
+      uint64_t count = 1;
+      if (t->interval_ns > 0) {
+        count += (uint64_t)((now - t->expiry_ns) / t->interval_ns);
+        t->expiry_ns += (int64_t)count * t->interval_ns;
+      } else {
+        t->expiry_ns = 0;
+      }
+      memcpy(buf, &count, 8);
+      return 8;
+    }
+    if (t->nonblock) {
+      errno = EAGAIN;
+      return -1;
+    }
+    int64_t wait_ns = t->expiry_ns == 0 ? (int64_t)1 << 62
+                                        : t->expiry_ns - now;
+    req_t rq = {.op = OP_SLEEP, .fd = -1, .a0 = wait_ns, .len = 0};
+    rep_t rp;
+    rpc(&rq, &rp);
+  }
 }
 
 int shutdown(int fd, int how) {

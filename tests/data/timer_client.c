@@ -1,0 +1,71 @@
+/* timerfd event loop in virtual time (tests/test_substrate.py).
+ *
+ * Classic event-loop shape: a periodic timerfd registered in epoll
+ * drives `rounds` ticks; the loop also does a plain blocking read()
+ * tick and checks timerfd_gettime.  All expirations must occur in
+ * VIRTUAL time (the vtime delta proves the clock advanced by the timer
+ * schedule, not wall time).
+ */
+#include <stdio.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/timerfd.h>
+#include <time.h>
+#include <unistd.h>
+
+static long long now_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+int main(int argc, char **argv) {
+  int rounds = argc > 1 ? atoi(argv[1]) : 10;
+  long long period_ms = argc > 2 ? atoll(argv[2]) : 20;
+
+  long long t0 = now_ns();
+
+  /* Blocking-read one-shot first. */
+  int tfd = timerfd_create(CLOCK_MONOTONIC, 0);
+  if (tfd < 0) return 3;
+  struct itimerspec its = {0};
+  its.it_value.tv_nsec = 5 * 1000000; /* 5 ms one-shot */
+  if (timerfd_settime(tfd, 0, &its, NULL) != 0) return 4;
+  uint64_t count = 0;
+  if (read(tfd, &count, sizeof count) != 8 || count != 1) return 5;
+
+  /* Periodic + epoll loop. */
+  its.it_value.tv_nsec = period_ms * 1000000;
+  its.it_interval.tv_nsec = period_ms * 1000000;
+  if (timerfd_settime(tfd, 0, &its, NULL) != 0) return 6;
+  struct itimerspec cur;
+  if (timerfd_gettime(tfd, &cur) != 0) return 7;
+  if (cur.it_interval.tv_nsec != period_ms * 1000000) return 8;
+
+  int ep = epoll_create1(0);
+  if (ep < 0) return 9;
+  struct epoll_event ev = {.events = EPOLLIN, .data = {.u32 = 5}};
+  if (epoll_ctl(ep, EPOLL_CTL_ADD, tfd, &ev) != 0) return 10;
+
+  long long ticks = 0;
+  while (ticks < rounds) {
+    struct epoll_event got[2];
+    int n = epoll_wait(ep, got, 2, 10000);
+    if (n < 0) return 11;
+    if (n == 0) continue;
+    if (got[0].data.u32 != 5 || !(got[0].events & EPOLLIN)) return 12;
+    if (read(tfd, &count, sizeof count) != 8 || count == 0) return 13;
+    ticks += (long long)count;
+  }
+  close(ep);
+  close(tfd);
+
+  long long dt = now_ns() - t0;
+  /* 5ms one-shot + rounds periods of period_ms must have elapsed in
+   * virtual time. */
+  if (dt < 5 * 1000000 + rounds * period_ms * 1000000) return 14;
+  printf("timer_client ok ticks=%lld vtime_delta_ns=%lld\n", ticks, dt);
+  return 0;
+}

@@ -266,6 +266,25 @@ class TestRealProcess:
         # Arena hygiene: every delivered datagram's bytes were released.
         assert sub.arena.stats()["live"] == 0
 
+    def test_timerfd_event_loop_virtual_time(self, tmp_path):
+        # timerfd_create/settime/gettime + blocking read + a periodic
+        # epoll loop, all shim-local against the virtual clock: 10 ticks
+        # at 20 ms must advance virtual time accordingly (reference
+        # timer.c / timerfd semantics).
+        state, params, app = _world(seed=31)
+        sub = Substrate(resolve_ip={_ip_int(SERVER_IP): 0}.get,
+                        workdir=str(tmp_path / "tmr"))
+        src = pathlib.Path(__file__).parent / "data" / "timer_client.c"
+        p = sub.spawn(1, [buildlib.build_binary(src, "timer_client"),
+                          "10", "20"])
+        bridge.run(sub, state, params, app, 30 * SEC)
+        stdout = (pathlib.Path(sub.workdir) / "proc-0.stdout").read_text()
+        assert p.exited and p.exit_code == 0, \
+            f"rc={p.exit_code} stdout={stdout!r}"
+        assert "timer_client ok ticks=10" in stdout
+        delta = int(stdout.split("vtime_delta_ns=")[1].split()[0])
+        assert delta >= (5 + 10 * 20) * MS  # one-shot + 10 periods
+
     def test_crash_containment_and_many_procs(self, tmp_path):
         # Three real processes on one host: two well-behaved echo
         # clients and one that dies mid-stream without closing its
