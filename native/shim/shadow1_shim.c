@@ -477,7 +477,10 @@ int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
     else if (is_tfd(fds[i].fd)) {
       any_t = 1;
       tfd_t *t = &g_tfd[fds[i].fd - TFD_BASE];
-      if (t->expiry_ns != 0 && t->expiry_ns < next_exp)
+      /* Only a timer the caller can actually observe (POLLIN requested)
+       * may bound the wait; otherwise its expiry must not wake poll. */
+      if ((fds[i].events & POLLIN) && t->expiry_ns != 0 &&
+          t->expiry_ns < next_exp)
         next_exp = t->expiry_ns;
     }
   }

@@ -159,6 +159,41 @@ def run_config(args) -> int:
         drain = LogDrain(
             __import__("os").path.join(args.data_directory, "shadow.log"),
             asm.hostnames)
+    # Real-process plugins (config <plugin path> pointing at an actual
+    # executable): spawn them under the substrate at their start times
+    # and drive the run through the window-protocol bridge.
+    substrate = None
+    if asm.real_procs:
+        from .substrate import Substrate, bridge as _bridge
+        dns = asm.dns
+
+        def _res_ip(ip):
+            try:
+                return dns.resolve_ip(ip).host_index
+            except KeyError:
+                return None
+
+        def _res_name(name):
+            try:
+                return dns.resolve_name(name).ip
+            except KeyError:
+                return None
+
+        workdir = args.data_directory or "shadow1-procs"
+        substrate = Substrate(
+            resolve_ip=_res_ip,
+            workdir=__import__("os").path.join(workdir, "procs"),
+            # Low slots belong to the modeled side (tgen listener=0,
+            # client=1); real processes allocate above them.
+            sock_slot_base=2,
+            resolve_name=_res_name,
+            host_ip=lambda i: dns.address_of(i).ip)
+        for host_i, argv, start_ns, stop_ns in asm.real_procs:
+            substrate.spawn_at(host_i, argv, start_ns, stop_ns)
+        if not args.quiet:
+            print(f"[shadow1-tpu] {len(asm.real_procs)} real process(es) "
+                  f"under the substrate", file=sys.stderr)
+
     t = int(state.now)
     hb_next = 0
     while t < stop:
@@ -166,7 +201,10 @@ def run_config(args) -> int:
         # the tracker samples between bounded device launches.
         t_next = min(t + (tracker.sample_interval_ns if tracker else stop),
                      stop)
-        state = engine.run_chunked(state, params, app, t_next)
+        if substrate is not None:
+            state = _bridge.run(substrate, state, params, app, t_next)
+        else:
+            state = engine.run_chunked(state, params, app, t_next)
         t = t_next
         if tracker is not None and t >= hb_next:
             tracker.heartbeat(state, t)
@@ -218,7 +256,22 @@ def run_config(args) -> int:
         drain.close()
     if tracker is not None:
         tracker.summary(summary, state)
+    if substrate is not None:
+        procs = substrate.procs
+        summary["processes"] = len(procs)
+        def _scheduled_stop(p):
+            return p.exit_code == -15 and p.stop_ns is not None
+        summary["processes_exited_ok"] = sum(
+            1 for p in procs if p.exited and
+            (p.exit_code == 0 or _scheduled_stop(p)))
+        summary["processes_failed"] = sum(
+            1 for p in procs if p.exited and p.exit_code != 0
+            and not _scheduled_stop(p))
+        summary["processes_running_at_stop"] = sum(
+            1 for p in procs if not p.exited)
     print(json.dumps(summary))
+    if substrate is not None and summary["processes_failed"]:
+        return 3
     return 0 if int(state.err) == 0 else 2
 
 

@@ -60,6 +60,7 @@ class Assembled:
     pcap_dirs: dict = None          # host index -> pcapdir
     heartbeat_freq_s: object = None  # [H] i64, 0 = default
     loglevels: list = None          # per-host loglevel strings
+    real_procs: list = None   # [(host_index, argv, start_ns, stop_ns|None)]
 
 
 def _expand_hosts(cfg):
@@ -74,16 +75,33 @@ def _expand_hosts(cfg):
     return names, specs
 
 
+def _plugin_path(cfg, plugin_id: str) -> str | None:
+    """Resolve a plugin's path (one resolver for classification AND
+    spawning, so they can never disagree)."""
+    spec = cfg.plugins.get(plugin_id)
+    if not (spec and spec.path):
+        return None
+    path = os.path.expanduser(spec.path)
+    if not os.path.isabs(path):
+        path = os.path.join(cfg.base_dir, path)
+    return path
+
+
 def _plugin_kind(cfg, plugin_id: str) -> str:
-    """Classify a plugin by id/path; modeled equivalents only (real-code
-    execution is the round-3+ substrate)."""
+    """Classify a plugin: a path that resolves to an actual executable
+    runs as a REAL process under the substrate (reference plugin .so
+    loading; here fork/exec of the binary itself); otherwise known
+    modeled equivalents apply (tgen)."""
+    path = _plugin_path(cfg, plugin_id)
+    if path and os.path.isfile(path) and os.access(path, os.X_OK):
+        return "real"
     spec = cfg.plugins.get(plugin_id)
     hay = f"{plugin_id} {spec.path if spec else ''}".lower()
     if "tgen" in hay:
         return "tgen"
     raise ValueError(
-        f"plugin {plugin_id!r} has no modeled equivalent yet "
-        f"(supported: tgen); real-plugin execution is not built")
+        f"plugin {plugin_id!r} is neither an existing executable (real-"
+        f"process plugin) nor a known modeled equivalent (tgen)")
 
 
 def build(cfg, seed: int = 1, sock_slots: int | None = None,
@@ -205,23 +223,32 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
     host_graph = np.full(h, -1, np.int64)
     start_t = np.zeros(h, np.int64)
     stop_t = np.full(h, simtime.SIMTIME_INVALID, np.int64)
+    real_procs: list = []    # (host_index, argv, start_ns, stop_ns|None)
     for i, s in enumerate(specs):
         if not s.processes:
             continue
-        if len(s.processes) > 1:
-            raise ValueError(f"host {names[i]!r}: multiple processes per "
-                             f"host not yet modeled")
-        p = s.processes[0]
-        _plugin_kind(cfg, p.plugin)  # raises on unsupported
-        arg = p.arguments.strip().split()[0] if p.arguments.strip() else ""
-        path = arg if os.path.isabs(arg) else os.path.join(cfg.base_dir, arg)
-        if path not in graph_of_args:
-            graph_of_args[path] = len(graphs)
-            graphs.append(tgen_app.parse_tgen(path))
-        host_graph[i] = graph_of_args[path]
-        start_t[i] = p.starttime_s * SEC
-        if p.stoptime_s:
-            stop_t[i] = p.stoptime_s * SEC
+        for p in s.processes:
+            if _plugin_kind(cfg, p.plugin) == "real":
+                argv = [_plugin_path(cfg, p.plugin)] + p.arguments.split()
+                real_procs.append(
+                    (i, argv, p.starttime_s * SEC,
+                     p.stoptime_s * SEC if p.stoptime_s else None))
+                continue
+            if host_graph[i] >= 0:
+                raise ValueError(f"host {names[i]!r}: multiple MODELED "
+                                 f"processes per host not yet supported "
+                                 f"(real-process plugins compose freely)")
+            arg = (p.arguments.strip().split()[0]
+                   if p.arguments.strip() else "")
+            path = arg if os.path.isabs(arg) \
+                else os.path.join(cfg.base_dir, arg)
+            if path not in graph_of_args:
+                graph_of_args[path] = len(graphs)
+                graphs.append(tgen_app.parse_tgen(path))
+            host_graph[i] = graph_of_args[path]
+            start_t[i] = p.starttime_s * SEC
+            if p.stoptime_s:
+                stop_t[i] = p.stoptime_s * SEC
 
     # --- sizing -----------------------------------------------------------
     # Server fan-in bounds the needed socket slots: count clients whose
@@ -240,6 +267,11 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
                 fan_in[resolve_peer(ps)[0]] += 1
     if sock_slots is None:
         sock_slots = int(max(4, min(512, 2 * fan_in.max() + 4)))
+        if real_procs:
+            # Real processes allocate slots dynamically (sockets, child
+            # connections); give them headroom the graph analysis above
+            # cannot see.
+            sock_slots = max(sock_slots, 16)
 
     # Packets occupy the *source* host's pool slab until consumed, so a
     # high-fan-in server needs slab room proportional to its concurrent
@@ -272,18 +304,39 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
                 socks = tcp.listen_v(socks, mask, 0, g.serverport,
                                      backlog=int(fan_in.max()) + 1)
         state = state.replace(socks=socks)
-        return state.replace(app=tgen_app.build_state(
+        if real_procs and not graphs:
+            # Pure real-process world: the substrate datagram ring is
+            # the only on-device app (the tgen interpreter cannot run on
+            # zero graphs).
+            from ..substrate import devapp
+            return state.replace(app=devapp.init_state(h))
+        tg_state = tgen_app.build_state(
             h, graphs, host_graph, start_t, stop_t,
-            resolve_peer=resolve_peer))
+            resolve_peer=resolve_peer)
+        if real_procs:
+            # Real processes need the device-side datagram ring; compose
+            # it with the modeled tgen interpreter (apps/compose.py).
+            from ..substrate import devapp
+            return state.replace(app=(devapp.init_state(h), tg_state))
+        return state.replace(app=tg_state)
 
     state = _pkg.build_on_host(_build_state)
-    app = tgen_app.Tgen()
+    if real_procs:
+        from ..apps.compose import Stacked
+        from ..substrate import devapp
+        if graphs:
+            app = Stacked(devapp.SubstrateTx(), tgen_app.Tgen())
+        else:
+            app = devapp.SubstrateTx()
+    else:
+        app = tgen_app.Tgen()
 
     return Assembled(state=state, params=params, app=app, hostnames=names,
                      dns=dns, topology=topo, config=cfg,
                      stop_time=cfg.stoptime_s * SEC,
                      pcap_mask=pcap_mask, pcap_dirs=pcap_dirs,
-                     heartbeat_freq_s=hb_freq, loglevels=loglevels)
+                     heartbeat_freq_s=hb_freq, loglevels=loglevels,
+                     real_procs=real_procs)
 
 
 def load(path: str, **kw) -> Assembled:

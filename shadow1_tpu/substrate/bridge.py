@@ -168,6 +168,7 @@ class RealProcess:
         self.exited = False
         self.exit_code: int | None = None
         self.trace: list[tuple] = []   # deterministic syscall transcript
+        self.stop_ns: int | None = None  # <process stoptime> kill point
 
 
 class Substrate:
@@ -195,6 +196,7 @@ class Substrate:
         self.sock_slot_base = sock_slot_base
         self._next_port = ephemeral_base
         self.wedge_timeout_ms = int(wedge_timeout_ms)
+        self._spawn_queue: list[tuple] = []   # (start_ns, host, argv)
         self.content_provider = None   # (host, slot, vsock, n) -> bytes
         self._pending = []             # queued device ops for this sync
         self.max_slots = 1 << 30       # refined from the state at sync
@@ -223,6 +225,14 @@ class Substrate:
         self._local_pops: dict[tuple, int] = {}
 
     # -- process management -------------------------------------------------
+
+    def spawn_at(self, host: int, argv: list[str], start_ns: int,
+                 stop_ns: int | None = None) -> None:
+        """Defer a spawn until virtual time reaches start_ns; optionally
+        kill the process at stop_ns (reference <process starttime /
+        stoptime>, slave_addNewVirtualProcess scheduling)."""
+        self._spawn_queue.append((int(start_ns), host, list(argv),
+                                  int(stop_ns) if stop_ns else None))
 
     def spawn(self, host: int, argv: list[str]) -> RealProcess:
         arr = (ctypes.c_char_p * len(argv))(*[a.encode() for a in argv])
@@ -270,6 +280,24 @@ class Substrate:
         """Publish the clock, run every runnable process until it blocks,
         apply the produced socket ops.  Returns the updated state."""
         self._lib.seq_settime(self.handle, EMULATED_EPOCH_NS + now_ns)
+        # Due deferred spawns become real processes this sync (ordered by
+        # (start, queue position) for determinism).
+        if self._spawn_queue:
+            due = [s for s in self._spawn_queue if s[0] <= now_ns]
+            self._spawn_queue = [s for s in self._spawn_queue
+                                 if s[0] > now_ns]
+            for _t, host, argv, stop_ns in due:
+                p = self.spawn(host, argv)
+                p.stop_ns = stop_ns
+        # <process stoptime>: kill overdue processes (reference process
+        # teardown at its configured stop).
+        for p in self.procs:
+            stop_ns = getattr(p, "stop_ns", None)
+            if stop_ns is not None and not p.exited and now_ns >= stop_ns:
+                self._lib.seq_kill(self.handle, p.proc_id)
+                p.exited = True
+                p.exit_code = -15  # SIGTERM-style: stopped by schedule
+                p.parked = None
         # Idle fast path: when every live process is parked on a pure
         # timer (sleep/poll-timeout with a future wake), no syscall can
         # run and no socket registers matter -- skip the device fetch
@@ -294,15 +322,19 @@ class Substrate:
         return self._apply(state, now_ns)
 
     def next_wake(self) -> int | None:
-        """Earliest virtual time a parked process needs (sleep expiry)."""
+        """Earliest virtual time a parked process needs (sleep expiry or
+        a deferred spawn's start time)."""
         wakes = [p.parked.wake_ns for p in self.procs
                  if not p.exited and p.parked is not None
                  and p.parked.op in (OP_SLEEP, OP_POLL)
                  and p.parked.wake_ns >= 0]
+        wakes += [s[0] for s in self._spawn_queue]
+        wakes += [p.stop_ns for p in self.procs
+                  if not p.exited and p.stop_ns is not None]
         return min(wakes) if wakes else None
 
     def all_exited(self) -> bool:
-        return all(p.exited for p in self.procs)
+        return not self._spawn_queue and all(p.exited for p in self.procs)
 
     # -- internals ------------------------------------------------------------
 
@@ -1143,6 +1175,11 @@ def run(substrate: Substrate, state, params, app, t_target: int,
     t = int(state.now)
     state = substrate.sync(state, params, t)
     while t < t_target:
+        if substrate.all_exited():
+            # No process can ever act again: finish the span as a pure
+            # engine run (modeled apps may still be trafficking);
+            # chunked so no single device launch is unbounded.
+            return engine.run_chunked(state, params, app, t_target)
         wake = substrate.next_wake()
         t_next = min(t + sync_interval_ns, t_target)
         if wake is not None:
@@ -1150,6 +1187,4 @@ def run(substrate: Substrate, state, params, app, t_target: int,
         state = engine.run_until(state, params, app, t_next)
         t = t_next
         state = substrate.sync(state, params, t)
-        if substrate.all_exited():
-            break
     return state

@@ -23,5 +23,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # jax.config.update at interpreter start, which overrides the env var; undo
 # it before any backend initializes.
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Free compiled executables + trace caches between test modules.
+
+    A full-suite run accumulates dozens of distinct compiled worlds in
+    one process; past ~70% of the suite the XLA CPU compiler has twice
+    segfaulted/aborted on a FRESH compile (the same test passes alone in
+    a clean process).  Bounding per-process compiler state avoids the
+    crash; the persistent on-disk cache keeps recompiles cheap."""
+    yield
+    jax.clear_caches()

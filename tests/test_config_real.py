@@ -1,0 +1,99 @@
+"""Config-driven real processes: shadow.config.xml whose <plugin path>
+points at an actual executable spawns it under the substrate -- the
+reference's defining workflow (a config of real plugins) end to end
+through the CLI: assemble -> DNS -> substrate spawn at starttime ->
+bridge-driven run -> summary.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from shadow1_tpu import cli
+from shadow1_tpu.substrate import buildlib
+
+DATA = pathlib.Path(__file__).parent / "data"
+
+
+def _config(tmp_path, total=2000):
+    srv = buildlib.build_binary(DATA / "echo_server.c", "echo_server")
+    cl = buildlib.build_binary(DATA / "eof_client.c", "eof_client")
+    tmr = buildlib.build_binary(DATA / "timer_client.c", "timer_client")
+    cfg = tmp_path / "shadow.config.xml"
+    cfg.write_text(f"""<shadow stoptime="30">
+  <topology><![CDATA[<?xml version="1.0" encoding="utf-8"?>
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="ip" attr.type="string" for="node" id="d0" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d4" />
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d5" />
+  <graph edgedefault="undirected">
+    <node id="net"><data key="d0">0.0.0.0</data></node>
+    <edge source="net" target="net">
+      <data key="d4">10.0</data><data key="d5">0.0</data>
+    </edge>
+  </graph>
+</graphml>
+]]></topology>
+  <plugin id="echosrv" path="{srv}"/>
+  <plugin id="echocli" path="{cl}"/>
+  <plugin id="ticker" path="{tmr}"/>
+  <host id="server" iphint="11.0.0.1">
+    <process plugin="echosrv" starttime="1" arguments="7777 1"/>
+  </host>
+  <host id="client" iphint="11.0.0.2">
+    <process plugin="echocli" starttime="2"
+             arguments="11.0.0.1 7777 {total}"/>
+  </host>
+  <host id="clock" iphint="11.0.0.3">
+    <!-- would tick for ~5 virtual hours; stoptime kills it at t=4 -->
+    <process plugin="ticker" starttime="1" stoptime="4"
+             arguments="1000000 20"/>
+  </host>
+</shadow>""")
+    return cfg
+
+
+def test_cli_runs_real_plugin_pair(tmp_path, capsys):
+    cfg = _config(tmp_path)
+    rc = cli.main(["run", str(cfg), "--data-directory",
+                   str(tmp_path / "out"), "--quiet"])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["err_flags"] == 0
+    assert summary["packets_sent"] > 0
+    # 2 ran to completion; the ticker was killed at its <process
+    # stoptime> (a scheduled stop, not a failure).
+    assert summary["processes"] == 3
+    assert summary["processes_exited_ok"] == 3
+    assert summary["processes_failed"] == 0
+    assert summary["processes_running_at_stop"] == 0
+    procdir = tmp_path / "out" / "procs"
+    outs = sorted(procdir.glob("proc-*.stdout"))
+    assert len(outs) >= 2
+    blob = "".join(o.read_text() for o in outs)
+    # Server echoed the exact stream; client verified it byte-for-byte.
+    assert "echo_server ok conns=1 bytes=2000" in blob
+    assert "eof_client ok bytes=2000" in blob
+
+
+def test_unknown_plugin_still_rejected(tmp_path):
+    cfg = tmp_path / "bad.xml"
+    cfg.write_text("""<shadow stoptime="5">
+  <topology><![CDATA[<?xml version="1.0" encoding="utf-8"?>
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="d4" />
+  <graph edgedefault="undirected">
+    <node id="net"/>
+    <edge source="net" target="net"><data key="d4">10.0</data></edge>
+  </graph>
+</graphml>
+]]></topology>
+  <plugin id="mystery" path="/nonexistent/plugin.bin"/>
+  <host id="a"><process plugin="mystery" starttime="1"/></host>
+</shadow>""")
+    from shadow1_tpu.config import assemble, shadowxml
+    c = shadowxml.parse(str(cfg))
+    c.base_dir = str(tmp_path)
+    with pytest.raises(ValueError, match="neither an existing executable"):
+        assemble.build(c)

@@ -17,6 +17,17 @@ backend, not XLA semantics.
 
 Findings log (update as bisection narrows):
   - r3: build_onion(2000, 16 MiB) crash on tunnel; 1 MiB ok.
+  - r4: CHEAPER TRIGGER FOUND -- the crash is buffer-size-, not
+    stream-size-, dependent: build_onion(2000, 1 MiB, pool_slab=128)
+    faults the worker during the FIRST simulated second (<60s incl.
+    compile; jax.errors.JaxRuntimeError UNAVAILABLE "TPU device error --
+    often a kernel fault").  pool_slab=64 at the same scale is stable
+    (measured through 11 sim-s).  Suspects are the exchange-rank
+    superblock tables, which scale P0*H/M: at slab 128 the [b, h] count/
+    cumsum tables reach ~267 MB and the packed block scatter moves
+    ~107 MB -- the 16 MiB-stream trigger plausibly reached the same
+    region via autotuned windows filling bigger slabs.  The worker
+    recovers on its own in ~1 minute; in-flight runs die.
 
 WORKAROUND (until the backend bug is isolated): autotune growth is
 already capped by transport/tcp.py SND_BUF_MAX/RCV_BUF_MAX (4/6 MiB);
@@ -39,12 +50,12 @@ from shadow1_tpu.core import engine, simtime
 SEC = simtime.SIMTIME_ONE_SECOND
 
 
-def attempt(circuits: int, mib: int, span_s: int = 5):
-    print(f"--- build_onion({circuits}, {mib} MiB): running {span_s} sim-s "
-          f"on {jax.default_backend()} ...", flush=True)
+def attempt(circuits: int, mib: int, slab: int, span_s: int = 1):
+    print(f"--- build_onion({circuits}, {mib} MiB, slab={slab}): running "
+          f"{span_s} sim-s on {jax.default_backend()} ...", flush=True)
     s, p, a = sim.build_onion(num_circuits=circuits,
                               bytes_per_circuit=mib << 20,
-                              pool_slab=32, stop_time=120 * SEC)
+                              pool_slab=slab, stop_time=120 * SEC)
     t0 = time.perf_counter()
     s = engine.run_until(s, p, a, span_s * SEC)
     jax.block_until_ready(s)
@@ -53,10 +64,13 @@ def attempt(circuits: int, mib: int, span_s: int = 5):
 
 
 def main(max_circuits: int):
+    # The r4 minimal trigger first (faults the tunnel worker in <60s);
+    # then the original r3 shape for cross-checking.
+    attempt(min(2000, max_circuits), 1, 128)
     for circuits in (50, 200, 1000, 2000):
         if circuits > max_circuits:
             break
-        attempt(circuits, 16)
+        attempt(circuits, 16, 32, span_s=5)
     print("no crash reproduced at this scale/backend")
 
 
