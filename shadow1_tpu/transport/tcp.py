@@ -500,16 +500,24 @@ def process_arrivals(state, params, em, tick_t, pkt, mask,
     # is raised so the caller can resize the socket table.
     slot_overflow = jnp.any(want_child & ~have_free)
 
-    cv = _Sock(socks, child_slot)
-    _apply_defaults(cv, spawn)
-    cv.setwhere(spawn, stype=SOCK_TCP, tcp_state=TCPS_SYNRECEIVED,
-                local_port=p_dport, peer_host=p_src, peer_port=p_sport,
-                parent=lsn_slot, child_order=p_id,
-                rcv_nxt=(p_seq + jnp.uint32(1)).astype(U32),
-                rcv_read=(p_seq + jnp.uint32(1)).astype(U32),
-                snd_una=0, snd_nxt=1, snd_wnd=p_wnd, ts_recent=p_ts,
-                t_rto=tick_t + RTO_INIT)
-    socks = cv.scatter(socks, spawn)
+    # Child creation resets ~47 fields of the child slot (full tcp_new
+    # analog); SYNs only exist during connection setup, so the whole
+    # pass is gated -- steady-state delivery rounds skip it entirely
+    # (same fast-path rationale as the SACK gates below).
+    def _spawn_children(s):
+        cv = _Sock(s, child_slot)
+        _apply_defaults(cv, spawn)
+        cv.setwhere(spawn, stype=SOCK_TCP, tcp_state=TCPS_SYNRECEIVED,
+                    local_port=p_dport, peer_host=p_src, peer_port=p_sport,
+                    parent=lsn_slot, child_order=p_id,
+                    rcv_nxt=(p_seq + jnp.uint32(1)).astype(U32),
+                    rcv_read=(p_seq + jnp.uint32(1)).astype(U32),
+                    snd_una=0, snd_nxt=1, snd_wnd=p_wnd, ts_recent=p_ts,
+                    t_rto=tick_t + RTO_INIT)
+        return cv.scatter(s, spawn)
+
+    socks = jax.lax.cond(jnp.any(spawn), _spawn_children, lambda s: s,
+                         socks)
 
     # --- connected-socket processing ---------------------------------------
     sv = _Sock(socks, conn_slot)
