@@ -28,6 +28,46 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def run_udp_pingpong_sim(workdir, binp, rounds, server_name="server",
+                         seed=23):
+    """Shared two-host UDP ping-pong sim run (used by the substrate test
+    and the OS-equivalence dual-run): returns (server_proc, client_proc,
+    final_state, substrate)."""
+    import jax.numpy as jnp
+
+    import shadow1_tpu
+    from shadow1_tpu.core import simtime
+    from shadow1_tpu.core.params import make_net_params
+    from shadow1_tpu.core.state import make_sim_state
+    from shadow1_tpu.routing.synthetic import uniform_full_mesh
+    from shadow1_tpu.substrate import Substrate, bridge, devapp
+
+    MS = simtime.SIMTIME_ONE_MILLISECOND
+    SEC = simtime.SIMTIME_ONE_SECOND
+
+    def _build():
+        lat, rel = uniform_full_mesh(2, 5 * MS)
+        params = make_net_params(
+            latency_ns=lat, reliability=rel, host_vertex=jnp.arange(2),
+            bw_up_Bps=jnp.full(2, 1 << 30),
+            bw_down_Bps=jnp.full(2, 1 << 30),
+            seed=seed, stop_time=30 * SEC)
+        state = make_sim_state(2, sock_slots=8, pool_capacity=1 << 10)
+        state = state.replace(app=devapp.init_state(2))
+        return state, params
+
+    state, params = shadow1_tpu.build_on_host(_build)
+    sip, cip = (10 << 24) | 1, (10 << 24) | 2
+    sub = Substrate(resolve_ip={sip: 0, cip: 1}.get,
+                    workdir=str(workdir),
+                    resolve_name={"server": sip}.get,
+                    host_ip={0: sip, 1: cip}.get)
+    ps = sub.spawn(0, [binp, "server", "5353", str(rounds)])
+    pc = sub.spawn(1, [binp, "client", "5353", str(rounds), server_name])
+    out = bridge.run(sub, state, params, devapp.SubstrateTx(), 30 * SEC)
+    return ps, pc, out, sub
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():
     """Free compiled executables + trace caches between test modules.

@@ -54,6 +54,66 @@ def _native_run(tmp_path):
     return rc_srv, (tmp_path / "srv.out").read_text(), cp
 
 
+def _free_udp_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_udp_native_and_sim_agree(tmp_path):
+    # Second slice of the dual-run oracle: the UDP ping-pong pair (bind/
+    # sendto/recvfrom/getaddrinfo) against the real kernel vs the sim.
+    # Unconnected UDP gives no connect-refused signal, so the native
+    # harness retries the WHOLE pair on any wedge (a datagram sent before
+    # the server's bind just vanishes).
+    import time
+
+    rounds = 6
+    binp = buildlib.build_binary(DATA / "udp_pingpong.c", "udp_pingpong")
+    nat = tmp_path / "native"
+    nat.mkdir(parents=True)
+    rc_srv = cp = None
+    for attempt in range(3):
+        port = _free_udp_port()
+        srv_log = nat / f"srv{attempt}.out"
+        with open(srv_log, "w") as so:
+            sp = subprocess.Popen([binp, "server", str(port), str(rounds)],
+                                  stdout=so, stderr=subprocess.STDOUT)
+            try:
+                time.sleep(0.3)  # let bind() land before the first ping
+                try:
+                    cp = subprocess.run(
+                        [binp, "client", str(port), str(rounds),
+                         "127.0.0.1"],
+                        capture_output=True, text=True, timeout=30)
+                    if cp.returncode == 0:
+                        rc_srv = sp.wait(timeout=30)
+                        break
+                except subprocess.TimeoutExpired:
+                    pass  # fresh server + port next attempt
+            finally:
+                sp.kill()
+    assert cp is not None and cp.returncode == 0, \
+        f"native client never succeeded (last rc="\
+        f"{cp.returncode if cp else None})"
+    assert rc_srv == 0
+    native_srv = srv_log.read_text()
+
+    # Sim run of the same binary pair (shared world with the substrate
+    # suite; conftest.run_udp_pingpong_sim).
+    from conftest import run_udp_pingpong_sim
+    ps, pc, _out, sub = run_udp_pingpong_sim(tmp_path / "sim", binp,
+                                             rounds)
+    sim_srv = (pathlib.Path(sub.workdir) / "proc-0.stdout").read_text()
+    sim_cli = (pathlib.Path(sub.workdir) / "proc-1.stdout").read_text()
+
+    assert (ps.exit_code, pc.exit_code) == (rc_srv, cp.returncode) == (0, 0)
+    assert sim_srv.strip() == native_srv.strip()
+    assert sim_cli.strip() == cp.stdout.strip()
+
+
 def test_native_and_sim_agree(tmp_path):
     rc_srv, srv_out, cp = _native_run(tmp_path / "native")
     assert cp.returncode == 0, f"native client rc={cp.returncode}"

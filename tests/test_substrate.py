@@ -226,34 +226,13 @@ class TestRealProcess:
         # Real UDP server + real UDP client: getaddrinfo against the DNS
         # registry, sendto/recvfrom datagrams carried by the payload
         # arena, timing by the engine (SubstrateTx ring -> emissions).
-        from shadow1_tpu.substrate import devapp
+        from conftest import run_udp_pingpong_sim
 
-        def _build():
-            lat, rel = uniform_full_mesh(2, 5 * MS)
-            params = make_net_params(
-                latency_ns=lat, reliability=rel,
-                host_vertex=jnp.arange(2),
-                bw_up_Bps=jnp.full(2, 1 << 30),
-                bw_down_Bps=jnp.full(2, 1 << 30),
-                seed=23, stop_time=30 * SEC)
-            state = make_sim_state(2, sock_slots=8, pool_capacity=1 << 10)
-            state = state.replace(app=devapp.init_state(2))
-            return state, params
-
-        state, params = shadow1_tpu.build_on_host(_build)
-        server_ip = _ip_int(SERVER_IP)
-        client_ip = _ip_int("10.0.0.2")
-        sub = Substrate(
-            resolve_ip={server_ip: 0, client_ip: 1}.get,
-            workdir=str(tmp_path / "udp"),
-            resolve_name={"server": server_ip}.get,
-            host_ip={0: server_ip, 1: client_ip}.get)
         src = pathlib.Path(__file__).parent / "data" / "udp_pingpong.c"
         binp = buildlib.build_binary(src, "udp_pingpong")
         rounds = 6
-        ps = sub.spawn(0, [binp, "server", "5353", str(rounds)])
-        pc = sub.spawn(1, [binp, "client", "5353", str(rounds), "server"])
-        out = bridge.run(sub, state, params, devapp.SubstrateTx(), 30 * SEC)
+        ps, pc, out, sub = run_udp_pingpong_sim(tmp_path / "udp", binp,
+                                                rounds)
         srv_out = (pathlib.Path(sub.workdir) / "proc-0.stdout").read_text()
         cli_out = (pathlib.Path(sub.workdir) / "proc-1.stdout").read_text()
         assert ps.exited and ps.exit_code == 0, \

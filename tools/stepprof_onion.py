@@ -28,12 +28,15 @@ I32, I64 = jnp.int32, jnp.int64
 SEC = simtime.SIMTIME_ONE_SECOND
 
 
-def main(circuits: int):
+def main(circuits: int, warm_ms: int = 500):
     state, params, app = sim.build_onion(
         num_circuits=circuits, bytes_per_circuit=1 << 20,
-        pool_slab=32, stop_time=120 * SEC)
-    # Into the busy phase: clients started, streams flowing.
-    state = engine.run_until(state, params, app, 2 * SEC)
+        pool_slab=64, stop_time=120 * SEC)
+    # Into the busy phase: clients started, streams flowing.  (Post
+    # back-pressure the whole workload completes in ~1.6 sim-s at 10k
+    # hosts, so the default warm point is mid-transfer at 0.5 s.)
+    state = engine.run_until(state, params, app,
+                             warm_ms * simtime.SIMTIME_ONE_MILLISECOND)
     jax.block_until_ready(state)
     print(f"hosts={state.hosts.num_hosts} steps_so_far={int(state.n_steps)}")
     we = jnp.asarray(120 * SEC, I64)
@@ -118,4 +121,5 @@ def main(circuits: int):
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 2000)
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 2000,
+         int(sys.argv[2]) if len(sys.argv) > 2 else 500)
