@@ -1,0 +1,55 @@
+"""Plot tool: heartbeat.csv -> PNG time series (reference's plot step,
+SURVEY.md L7)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from shadow1_tpu.observe import Tracker  # noqa: E402  (header source)
+
+
+def test_plots_from_heartbeat(tmp_path):
+    import plot as plot_tool
+
+    hb = tmp_path / "heartbeat.csv"
+    hb.write_text(
+        Tracker.HEADER +
+        "1.000,alpha,1000.0,900.0,10,9,1,0,2,1\n"
+        "1.000,beta,500.0,400.0,5,4,0,1,0,0\n"
+        "2.000,alpha,1100.0,950.0,11,10,0,0,1,2\n"
+        "2.000,beta,600.0,500.0,6,5,2,0,0,1\n")
+    written = plot_tool.main(str(tmp_path), str(tmp_path / "plots"))
+    names = {os.path.basename(p) for p in written}
+    assert names == {"throughput.png", "drops.png", "queues.png"}
+    for p in written:
+        assert os.path.getsize(p) > 1000  # a real rendered image
+
+    # Aggregation sums hosts per timestamp.
+    ts, s = plot_tool.aggregate(plot_tool.load(str(tmp_path)))
+    assert ts == [1.0, 2.0]
+    assert s["bytes_sent_per_s"] == [1500.0, 1700.0]
+    assert s["drops_inet"] == [1.0, 2.0]
+
+
+def test_aggregate_step_holds_mixed_cadences(tmp_path):
+    # A host on a coarser per-host heartbeat cadence keeps contributing
+    # its last rate between its rows (no sawtooth); deltas sum only at
+    # reported timestamps.
+    import plot as plot_tool
+
+    hb = tmp_path / "heartbeat.csv"
+    hb.write_text(
+        Tracker.HEADER +
+        "1.000,fast,100.0,0.0,1,0,0,0,0,0\n"
+        "1.000,slow,50.0,0.0,1,0,0,0,0,0\n"
+        "2.000,fast,200.0,0.0,1,0,0,0,0,0\n"
+        "3.000,fast,300.0,0.0,1,0,0,0,0,0\n"
+        "3.000,slow,60.0,0.0,4,0,0,0,0,0\n")
+    ts, s = plot_tool.aggregate(plot_tool.load(str(tmp_path)))
+    assert ts == [1.0, 2.0, 3.0]
+    # slow's 50.0 holds through t=2.
+    assert s["bytes_sent_per_s"] == [150.0, 250.0, 360.0]
+    # deltas never double-count held rows.
+    assert s["pkts_sent"] == [2.0, 1.0, 5.0]

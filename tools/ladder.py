@@ -138,8 +138,10 @@ def main(rungs):
         print(json.dumps({name: results[name]}), flush=True)
 
     if "1" in rungs:
+        # warm to 2s: the 2-host example's client starts at t=2.
         record("tgen_2host",
-               lambda: rung_tgen("examples/tgen-2host/shadow.config.xml"))
+               lambda: rung_tgen("examples/tgen-2host/shadow.config.xml",
+                                 warm_s=2))
     if "2" in rungs:
         # warm to 5s: the 100-host example's web clients start at t=5.
         record("tgen_100host",

@@ -1,0 +1,111 @@
+"""Plot a run's heartbeat telemetry: the reference's plotting tool
+analog (its setup script ships a plot step that turns heartbeat logs
+into time-series graphs; SURVEY.md L7).
+
+    PYTHONPATH=. python tools/plot.py <data-directory> [out-directory]
+
+Reads `heartbeat.csv` (observe.Tracker format) and writes:
+  throughput.png   -- aggregate send/receive rates over simulated time
+  drops.png        -- drops PER HEARTBEAT INTERVAL (wire + router)
+  queues.png       -- total tx/rx queue occupancy over time
+
+Rate columns are step-held per host between its rows, so hosts on
+different per-host heartbeat cadences aggregate without sawtooth
+artifacts; delta columns (packets, drops) are summed at the timestamps
+they were reported.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import sys
+from collections import defaultdict
+
+import matplotlib
+
+matplotlib.use("Agg")  # headless
+import matplotlib.pyplot as plt  # noqa: E402
+
+
+def load(data_dir: str):
+    rows = []
+    with open(os.path.join(data_dir, "heartbeat.csv")) as f:
+        for rec in csv.DictReader(f):
+            rows.append(rec)
+    return rows
+
+
+RATE_COLS = ("bytes_sent_per_s", "bytes_recv_per_s",
+             "tx_queued", "rx_queued")
+DELTA_COLS = ("pkts_sent", "pkts_recv", "drops_inet", "drops_router")
+
+
+def aggregate(rows):
+    """Aggregate per-host rows into per-timestamp series.
+
+    Rates and occupancies are STEP-HELD per host (a host on a coarser
+    per-host heartbeat cadence keeps contributing its last value between
+    its rows); deltas are summed at the timestamps they were reported."""
+    ts = sorted({float(r["time_s"]) for r in rows})
+    t_index = {t: i for i, t in enumerate(ts)}
+    n = len(ts)
+    series = {k: [0.0] * n for k in RATE_COLS + DELTA_COLS}
+    per_host = defaultdict(list)
+    for r in rows:
+        per_host[r["host"]].append(r)
+    for host_rows in per_host.values():
+        host_rows.sort(key=lambda r: float(r["time_s"]))
+        for k in RATE_COLS:
+            cur = 0.0
+            j = 0
+            for i, t in enumerate(ts):
+                while j < len(host_rows) and \
+                        float(host_rows[j]["time_s"]) <= t:
+                    cur = float(host_rows[j][k])
+                    j += 1
+                series[k][i] += cur
+        for r in host_rows:
+            i = t_index[float(r["time_s"])]
+            for k in DELTA_COLS:
+                series[k][i] += float(r[k])
+    return ts, series
+
+
+def main(data_dir: str, out_dir: str | None = None) -> list:
+    out_dir = out_dir or data_dir
+    os.makedirs(out_dir, exist_ok=True)
+    ts, s = aggregate(load(data_dir))
+    written = []
+
+    def chart(name, title, ylab, lines):
+        f, ax = plt.subplots(figsize=(8, 4.5))
+        ax.set_title(title)
+        ax.set_xlabel("simulated time (s)")
+        ax.set_ylabel(ylab)
+        for col, label in lines:
+            ax.plot(ts, s[col], label=label)
+        ax.legend()
+        p = os.path.join(out_dir, f"{name}.png")
+        f.savefig(p, dpi=110, bbox_inches="tight")
+        plt.close(f)
+        written.append(p)
+
+    if ts:
+        chart("throughput", "Aggregate throughput", "bytes/s",
+              [("bytes_sent_per_s", "sent"),
+               ("bytes_recv_per_s", "received")])
+        chart("drops", "Drops per interval", "packets",
+              [("drops_inet", "wire (reliability)"),
+               ("drops_router", "router (CoDel/tail)")])
+        chart("queues", "Queue occupancy", "packets",
+              [("tx_queued", "tx queued"), ("rx_queued", "rx queued")])
+    for p in written:
+        print(p)
+    return written
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    main(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else None)
