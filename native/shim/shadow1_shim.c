@@ -473,7 +473,12 @@ int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
   int any_v = 0, any_t = 0;
   int64_t next_exp = (int64_t)1 << 62;
   for (nfds_t i = 0; i < nfds; i++) {
-    if (is_vfd(fds[i].fd)) any_v = 1;
+    /* A CLOSED vfd (in range, g_vfd_open cleared) must still route to
+     * the bridge, which answers POLLNVAL for it -- otherwise a set
+     * holding only closed vfds would take the OP_SLEEP branch and park
+     * forever where Linux returns POLLNVAL immediately. */
+    if (fds[i].fd >= VFD_BASE && fds[i].fd < VFD_BASE + MAX_VFD)
+      any_v = 1;
     else if (is_tfd(fds[i].fd)) {
       any_t = 1;
       tfd_t *t = &g_tfd[fds[i].fd - TFD_BASE];
@@ -841,31 +846,51 @@ int epoll_wait(int epfd, struct epoll_event *events, int maxevents,
   }
   epoll_inst_t *e = &g_ep[epfd - EPFD_BASE];
   if (maxevents <= 0) { errno = EINVAL; return -1; }
-  struct pollfd pf[MAX_WATCH];
-  for (int i = 0; i < e->nwatch; i++) {
-    pf[i].fd = e->wfd[i];
-    pf[i].events = 0;
-    if (e->wevents[i] & EPOLLIN) pf[i].events |= POLLIN;
-    if (e->wevents[i] & EPOLLOUT) pf[i].events |= POLLOUT;
-    if (e->wevents[i] & EPOLLPRI) pf[i].events |= POLLPRI;
-    pf[i].revents = 0;
+  for (;;) {
+    struct pollfd pf[MAX_WATCH];
+    for (int i = 0; i < e->nwatch; i++) {
+      pf[i].fd = e->wfd[i];
+      pf[i].events = 0;
+      if (e->wevents[i] & EPOLLIN) pf[i].events |= POLLIN;
+      if (e->wevents[i] & EPOLLOUT) pf[i].events |= POLLOUT;
+      if (e->wevents[i] & EPOLLPRI) pf[i].events |= POLLPRI;
+      pf[i].revents = 0;
+    }
+    int r = poll(pf, e->nwatch, timeout);
+    if (r <= 0) return r;
+    int n = 0;
+    /* Walk backwards so removing a dead fd (swap-with-last) never
+     * skips an unvisited entry. */
+    for (int i = e->nwatch - 1; i >= 0; i--) {
+      if (!pf[i].revents) continue;
+      if (pf[i].revents & POLLNVAL) {
+        /* Linux silently removes closed fds from epoll sets; the
+         * bridge reports them as POLLNVAL.  Mirror the auto-removal
+         * so a stale fd can't pin poll() permanently ready. */
+        e->nwatch--;
+        e->wfd[i] = e->wfd[e->nwatch];
+        e->wevents[i] = e->wevents[e->nwatch];
+        e->wdata[i] = e->wdata[e->nwatch];
+        continue;
+      }
+      if (n >= maxevents) continue;
+      uint32_t rev = 0;
+      if (pf[i].revents & POLLIN) rev |= EPOLLIN;
+      if (pf[i].revents & POLLOUT) rev |= EPOLLOUT;
+      if (pf[i].revents & POLLPRI) rev |= EPOLLPRI;
+      if (pf[i].revents & POLLERR) rev |= EPOLLERR;
+      if (pf[i].revents & POLLHUP) rev |= EPOLLHUP;
+      events[n].events = rev;
+      events[n].data = e->wdata[i];
+      n++;
+    }
+    if (n > 0 || timeout == 0) return n;
+    /* Every ready entry was a dead fd we just removed: block again
+     * (Linux would never have reported them).  A positive timeout is
+     * conservatively restarted in full -- the shim's poll runs in
+     * virtual time where the remaining-time bookkeeping lives
+     * bridge-side. */
   }
-  int r = poll(pf, e->nwatch, timeout);
-  if (r <= 0) return r;
-  int n = 0;
-  for (int i = 0; i < e->nwatch && n < maxevents; i++) {
-    if (!pf[i].revents) continue;
-    uint32_t rev = 0;
-    if (pf[i].revents & POLLIN) rev |= EPOLLIN;
-    if (pf[i].revents & POLLOUT) rev |= EPOLLOUT;
-    if (pf[i].revents & POLLPRI) rev |= EPOLLPRI;
-    if (pf[i].revents & POLLERR) rev |= EPOLLERR;
-    if (pf[i].revents & POLLHUP) rev |= EPOLLHUP;
-    events[n].events = rev;
-    events[n].data = e->wdata[i];
-    n++;
-  }
-  return n;
 }
 
 int epoll_pwait(int epfd, struct epoll_event *events, int maxevents,

@@ -88,20 +88,31 @@ def _plugin_path(cfg, plugin_id: str) -> str | None:
 
 
 def _plugin_kind(cfg, plugin_id: str) -> str:
-    """Classify a plugin: a path that resolves to an actual executable
-    runs as a REAL process under the substrate (reference plugin .so
-    loading; here fork/exec of the binary itself); otherwise known
-    modeled equivalents apply (tgen)."""
+    """Classify a plugin: an executable PROGRAM runs as a REAL process
+    under the substrate (here fork/exec of the binary itself); a shared
+    object (.so, the reference's plugin format) or a known name maps to
+    its modeled equivalent (tgen).  Shared objects routinely carry the
+    exec bit, so the .so check must come first -- otherwise the same
+    config flips between modeled and fork/exec depending on whether the
+    plugin file happens to exist on disk."""
     path = _plugin_path(cfg, plugin_id)
-    if path and os.path.isfile(path) and os.access(path, os.X_OK):
-        return "real"
     spec = cfg.plugins.get(plugin_id)
     hay = f"{plugin_id} {spec.path if spec else ''}".lower()
-    if "tgen" in hay:
-        return "tgen"
-    raise ValueError(
-        f"plugin {plugin_id!r} is neither an existing executable (real-"
-        f"process plugin) nor a known modeled equivalent (tgen)")
+    is_shared_obj = bool(path) and (
+        path.endswith(".so") or ".so." in os.path.basename(path))
+    if is_shared_obj or not (
+            path and os.path.isfile(path) and os.access(path, os.X_OK)):
+        if "tgen" in hay:
+            return "tgen"
+        if is_shared_obj:
+            raise ValueError(
+                f"plugin {plugin_id!r} is a shared object ({path}); "
+                f"fork/exec cannot run it and no modeled equivalent is "
+                f"known -- point the plugin at an executable program")
+        raise ValueError(
+            f"plugin {plugin_id!r} is neither an existing executable "
+            f"(real-process plugin) nor a known modeled equivalent (tgen)")
+    return "real"
 
 
 def build(cfg, seed: int = 1, sock_slots: int | None = None,

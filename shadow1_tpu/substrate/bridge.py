@@ -257,9 +257,17 @@ class Substrate:
         # A device-freed slot (RST / RTO teardown sets stype=SOCK_FREE
         # immediately) may still be referenced by an OPEN vfd whose owner
         # hasn't observed the error yet -- handing it out again would
-        # alias two VSockets onto one slot.  Exclude every slot a live
-        # vfd still holds.
+        # alias two VSockets onto one slot.  Socket tables are per-host
+        # ([H, S]), so only vfds on THIS host can alias a slot index --
+        # excluding other hosts' vfds would burn one global slot per open
+        # socket anywhere in the world and spuriously EMFILE.
         for p in self.procs:
+            if p.host != host or p.exited:
+                # Exited processes never marked their vfds closed, but
+                # the device has (or will) tear their sockets down; their
+                # slots must return to the pool or restart churn shrinks
+                # it monotonically.
+                continue
             for vs in p.vfds.values():
                 if not vs.closed:
                     taken.add(vs.slot)
@@ -580,6 +588,11 @@ class Substrate:
                     else:
                         vs.pipe.write_open = False
                 elif vs.kind == "udp":
+                    # Drop the sync-local pop count with the ring: the
+                    # udp_close apply op zeroes udp_head/udp_count, so a
+                    # stale _local_pops entry would make a slot-reusing
+                    # socket see a negative available count.
+                    self._local_pops.pop((p.host, vs.slot), None)
                     self._pending.append(("udp_close", p.host, vs.slot))
                 else:
                     self._pending.append(("close", p.host, vs.slot))
@@ -914,6 +927,13 @@ class Substrate:
                 # the vfd range but unknown) is POLLNVAL.
                 if fd >= VFD_BASE:
                     rev = POLLNVAL
+            elif vs.closed:
+                # A closed vfd left in a poll set must never consult slot
+                # registers: _pick_slot may have reused its slot for a
+                # newer connection.  Linux reports POLLNVAL for poll on a
+                # closed fd; epoll drops it from the set (callers of this
+                # helper filter accordingly).
+                rev = POLLNVAL
             elif vs.pipe is not None:
                 if vs.kind == "pipe_r":
                     if vs.pipe.buf or not vs.pipe.write_open:
@@ -1088,9 +1108,14 @@ class Substrate:
             elif kind == "udp_close":
                 from ..core.state import SOCK_FREE
                 _, h, slot = op
+                # Zero the datagram ring bookkeeping too: a later UDP
+                # socket reusing this slot must not inherit the stale
+                # queue (ghost datagrams from _try_recvfrom).
                 socks = socks.replace(
                     stype=socks.stype.at[h, slot].set(SOCK_FREE),
-                    local_port=socks.local_port.at[h, slot].set(0))
+                    local_port=socks.local_port.at[h, slot].set(0),
+                    udp_head=socks.udp_head.at[h, slot].set(0),
+                    udp_count=socks.udp_count.at[h, slot].set(0))
             elif kind == "udp_pop":
                 from ..transport import udp as udp_mod
                 _, h, slot = op
