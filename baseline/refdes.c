@@ -1,0 +1,504 @@
+/* refdes: a measured CPU baseline for the shadow1_tpu benchmarks.
+ *
+ * A deliberately well-written, reference-architecture discrete-event
+ * simulator in one C file: per-host binary heaps behind per-host
+ * mutexes, pthread worker threads over a static host partition, and a
+ * conservative lookahead-window barrier protocol -- the same
+ * architecture as the reference's pthread engine (scheduler_policy
+ * host-single walk, worker_sendPacket latency lookup + drop draw,
+ * malloc'd packets), without its GLib/plugin overheads.  It therefore
+ * UNDERSTATES the reference's per-event cost (no userspace TCP state
+ * machine, no task closures, no object refcounting), making the ratio
+ * it yields conservative for the TPU engine.
+ *
+ * Reference architecture mirrored (citations into /root/reference):
+ *   - per-host queues drained below a window barrier:
+ *     src/main/core/scheduler/scheduler_policy_host_single.c:210-271
+ *   - conservative window advance by min link latency (lookahead):
+ *     src/main/core/master.c:133-159,450-480
+ *   - per-packet latency lookup + reliability draw + event push:
+ *     src/main/core/worker.c:243-304
+ *   - deterministic event order (time, seq): src/main/core/work/event.c:110-153
+ *
+ * Workloads:
+ *   phold  N hosts, M initial messages each; a delivery schedules a
+ *          forward to a uniform other host after an exponential delay
+ *          (the reference's src/test/phold/test_phold.c shape, matching
+ *          shadow1_tpu.sim.build_phold semantics and bench.py's
+ *          sent+recv event counting).
+ *   onion  C circuits x (client -> 3 relays -> server), S bytes per
+ *          circuit in MTU segments under a fixed in-flight window with
+ *          cumulative ACKs every other segment -- the data-movement
+ *          shape of ladder rung 5, reported as wall seconds to complete
+ *          all circuits.
+ *
+ * Build: cc -O2 -pthread -o refdes refdes.c -lm
+ * Run:   ./refdes phold <hosts> <msgs/host> <sim_seconds> [threads]
+ *        ./refdes onion <circuits> <bytes/circuit> [threads]
+ * Output: one JSON line.
+ */
+
+#include <inttypes.h>
+#include <math.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+typedef int64_t stime_t; /* simulated nanoseconds */
+
+#define NS_PER_SEC 1000000000LL
+#define NS_PER_MS 1000000LL
+#define TIME_INF ((stime_t)1 << 62)
+
+/* ---------------------------------------------------------------- events */
+
+enum { EV_SEND, EV_DELIVER, EV_ONION_SEG, EV_ONION_ACK };
+
+typedef struct packet {
+  int32_t src, dst;
+  int32_t bytes;
+  int32_t circuit, hop;
+  int64_t seq;
+  unsigned char payload[64]; /* reference packets carry a malloc'd payload */
+} packet_t;
+
+typedef struct event {
+  stime_t time;
+  uint64_t seq; /* (src<<40 | counter): deterministic tiebreak */
+  int32_t kind;
+  int32_t host;
+  packet_t *pkt;
+} event_t;
+
+/* ------------------------------------------------------- per-host state */
+
+typedef struct host {
+  pthread_mutex_t lock;
+  event_t *heap;
+  int32_t heap_len, heap_cap;
+  uint64_t rng;      /* xorshift64 state, seeded per host */
+  uint64_t ev_ctr;   /* event sequence counter for tiebreak */
+  int64_t sent, recv;
+  /* onion per-host stream state (one circuit role per host) */
+  int32_t onion_role;    /* 0 client, 1..3 relay, 4 server, -1 none */
+  int32_t onion_circuit;
+  int64_t snd_next, snd_una, acked; /* client window bookkeeping */
+} host_t;
+
+static host_t *g_hosts;
+static int g_nhosts;
+static stime_t g_stop = TIME_INF;
+static stime_t g_lookahead;
+static int g_nthreads = 1;
+
+/* latency matrix, vertices capped at 256 like sim.build_phold */
+static int g_nvert;
+static stime_t *g_lat; /* [V*V] */
+
+static inline stime_t lat_lookup(int src, int dst) {
+  return g_lat[(src % g_nvert) * g_nvert + (dst % g_nvert)];
+}
+
+static inline uint64_t xorshift64(uint64_t *s) {
+  uint64_t x = *s;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  return *s = x;
+}
+
+static inline double rng_uniform(uint64_t *s) {
+  return (double)(xorshift64(s) >> 11) * (1.0 / 9007199254740992.0);
+}
+
+/* ------------------------------------------------------------ host heap */
+
+static inline int ev_before(const event_t *a, const event_t *b) {
+  if (a->time != b->time) return a->time < b->time;
+  return a->seq < b->seq;
+}
+
+static void heap_push(host_t *h, event_t ev) {
+  if (h->heap_len == h->heap_cap) {
+    h->heap_cap = h->heap_cap ? h->heap_cap * 2 : 16;
+    h->heap = realloc(h->heap, (size_t)h->heap_cap * sizeof(event_t));
+  }
+  int i = h->heap_len++;
+  h->heap[i] = ev;
+  while (i > 0) {
+    int p = (i - 1) / 2;
+    if (!ev_before(&h->heap[i], &h->heap[p])) break;
+    event_t t = h->heap[p];
+    h->heap[p] = h->heap[i];
+    h->heap[i] = t;
+    i = p;
+  }
+}
+
+static event_t heap_pop(host_t *h) {
+  event_t top = h->heap[0];
+  h->heap[0] = h->heap[--h->heap_len];
+  int i = 0;
+  for (;;) {
+    int l = 2 * i + 1, r = l + 1, m = i;
+    if (l < h->heap_len && ev_before(&h->heap[l], &h->heap[m])) m = l;
+    if (r < h->heap_len && ev_before(&h->heap[r], &h->heap[m])) m = r;
+    if (m == i) break;
+    event_t t = h->heap[m];
+    h->heap[m] = h->heap[i];
+    h->heap[i] = t;
+    i = m;
+  }
+  return top;
+}
+
+static void push_to(int dst, event_t ev) {
+  host_t *h = &g_hosts[dst];
+  pthread_mutex_lock(&h->lock);
+  heap_push(h, ev);
+  pthread_mutex_unlock(&h->lock);
+}
+
+/* ------------------------------------------------------------ workloads */
+
+static double g_mean_delay_ns;
+static int64_t g_onion_done, g_onion_total;
+static int64_t g_onion_bytes, g_onion_seg = 1460, g_onion_win = 64;
+static pthread_mutex_t g_done_lock = PTHREAD_MUTEX_INITIALIZER;
+
+static void phold_execute(host_t *h, int self, event_t *ev) {
+  if (ev->kind == EV_DELIVER) {
+    h->recv++;
+    free(ev->pkt);
+    /* schedule the forward after an exponential think time */
+    stime_t d = (stime_t)(-log1p(-rng_uniform(&h->rng)) * g_mean_delay_ns);
+    if (d < 1) d = 1;
+    event_t send = {.time = ev->time + d,
+                    .seq = ((uint64_t)self << 40) | h->ev_ctr++,
+                    .kind = EV_SEND,
+                    .host = self,
+                    .pkt = NULL};
+    push_to(self, send); /* the drain released our lock before execute */
+  } else {
+    h->sent++;
+    int off = 1 + (int)(rng_uniform(&h->rng) * (g_nhosts - 1));
+    if (off > g_nhosts - 1) off = g_nhosts - 1;
+    int dst = (self + off) % g_nhosts;
+    packet_t *p = malloc(sizeof(packet_t));
+    p->src = self;
+    p->dst = dst;
+    p->bytes = 64;
+    p->seq = (int64_t)h->ev_ctr;
+    memset(p->payload, (int)(h->ev_ctr & 0xff), sizeof(p->payload));
+    event_t del = {.time = ev->time + lat_lookup(self, dst),
+                   .seq = ((uint64_t)self << 40) | h->ev_ctr++,
+                   .kind = EV_DELIVER,
+                   .host = dst,
+                   .pkt = p};
+    push_to(dst, del);
+  }
+}
+
+/* onion: hosts are laid out circuit-major: c*5 + {0 client,1..3 relay,
+ * 4 server}.  The client keeps g_onion_win segments in flight; the
+ * server acks every second segment (delack shape); relays forward both
+ * directions.  Per-hop per-segment work mirrors phold's deliver path. */
+
+static void onion_client_pump(host_t *h, int self, stime_t now) {
+  int64_t nseg = (g_onion_bytes + g_onion_seg - 1) / g_onion_seg;
+  while (h->snd_next < nseg && h->snd_next - h->snd_una < g_onion_win) {
+    packet_t *p = malloc(sizeof(packet_t));
+    p->src = self;
+    p->dst = self + 1;
+    p->bytes = (int32_t)g_onion_seg;
+    p->circuit = h->onion_circuit;
+    p->hop = 0;
+    p->seq = h->snd_next++;
+    h->sent++;
+    event_t del = {.time = now + lat_lookup(self, self + 1),
+                   .seq = ((uint64_t)self << 40) | h->ev_ctr++,
+                   .kind = EV_ONION_SEG,
+                   .host = self + 1,
+                   .pkt = p};
+    push_to(self + 1, del);
+  }
+}
+
+static void onion_execute(host_t *h, int self, event_t *ev) {
+  packet_t *p = ev->pkt;
+  h->recv++;
+  if (ev->kind == EV_ONION_SEG) {
+    if (h->onion_role == 4) { /* server: count + maybe ack */
+      int64_t seq = p->seq;
+      free(p);
+      h->acked = seq + 1;
+      if ((seq & 1) || h->acked * g_onion_seg >= g_onion_bytes) {
+        packet_t *a = malloc(sizeof(packet_t));
+        a->src = self;
+        a->dst = self - 1;
+        a->bytes = 0;
+        a->circuit = h->onion_circuit;
+        a->hop = 4;
+        a->seq = h->acked;
+        h->sent++;
+        event_t del = {.time = ev->time + lat_lookup(self, self - 1),
+                       .seq = ((uint64_t)self << 40) | h->ev_ctr++,
+                       .kind = EV_ONION_ACK,
+                       .host = self - 1,
+                       .pkt = a};
+        push_to(self - 1, del);
+      }
+    } else { /* relay: forward toward the server */
+      int dst = self + 1;
+      p->hop++;
+      h->sent++;
+      event_t del = {.time = ev->time + lat_lookup(self, dst),
+                     .seq = ((uint64_t)self << 40) | h->ev_ctr++,
+                     .kind = EV_ONION_SEG,
+                     .host = dst,
+                     .pkt = p};
+      push_to(dst, del);
+    }
+  } else { /* ACK flowing back toward the client */
+    if (h->onion_role == 0) {
+      int64_t nseg = (g_onion_bytes + g_onion_seg - 1) / g_onion_seg;
+      if (p->seq > h->snd_una) h->snd_una = p->seq;
+      free(p);
+      if (h->snd_una >= nseg) {
+        pthread_mutex_lock(&g_done_lock);
+        g_onion_done++;
+        pthread_mutex_unlock(&g_done_lock);
+      } else {
+        onion_client_pump(h, self, ev->time);
+      }
+    } else {
+      int dst = self - 1;
+      h->sent++;
+      event_t del = {.time = ev->time + lat_lookup(self, dst),
+                     .seq = ((uint64_t)self << 40) | h->ev_ctr++,
+                     .kind = EV_ONION_ACK,
+                     .host = dst,
+                     .pkt = p};
+      push_to(dst, del);
+    }
+  }
+}
+
+/* -------------------------------------------------- window-barrier loop */
+
+static int g_workload; /* 0 phold, 1 onion */
+static pthread_barrier_t g_barrier;
+static stime_t g_window_end;
+static stime_t *g_thread_min; /* per-thread min next-event time */
+static volatile int g_running = 1;
+
+static void drain_host(int self, stime_t wend) {
+  host_t *h = &g_hosts[self];
+  pthread_mutex_lock(&h->lock);
+  while (h->heap_len > 0 && h->heap[0].time < wend) {
+    event_t ev = heap_pop(h);
+    /* execute OUTSIDE the host lock for cross-host pushes?  The
+     * reference holds the dst-host lock during execution (event.c:65);
+     * we hold our own and take the peer's on push -- peer != self
+     * always (lookahead >= min latency), so no self-deadlock. */
+    pthread_mutex_unlock(&h->lock);
+    if (g_workload == 0)
+      phold_execute(h, self, &ev);
+    else
+      onion_execute(h, self, &ev);
+    pthread_mutex_lock(&h->lock);
+  }
+  pthread_mutex_unlock(&h->lock);
+}
+
+typedef struct targ {
+  int tid, lo, hi;
+} targ_t;
+
+/* Locked peek: the heap array may be realloc'd by a concurrent push. */
+static inline stime_t host_peek(int i) {
+  host_t *h = &g_hosts[i];
+  pthread_mutex_lock(&h->lock);
+  stime_t t = h->heap_len ? h->heap[0].time : TIME_INF;
+  pthread_mutex_unlock(&h->lock);
+  return t;
+}
+
+static void *worker(void *vp) {
+  targ_t *a = vp;
+  for (;;) {
+    pthread_barrier_wait(&g_barrier); /* window start */
+    if (!g_running) break;
+    stime_t wend = g_window_end;
+    /* host-single policy walk: repeat until no assigned host has an
+     * event below the barrier (self-scheduled events may re-arm) */
+    for (;;) {
+      int again = 0;
+      for (int hst = a->lo; hst < a->hi; hst++) {
+        if (host_peek(hst) < wend) {
+          drain_host(hst, wend);
+          again = 1;
+        }
+      }
+      if (!again) break;
+    }
+    stime_t mn = TIME_INF;
+    for (int hst = a->lo; hst < a->hi; hst++) {
+      stime_t t = host_peek(hst);
+      if (t < mn) mn = t;
+    }
+    g_thread_min[a->tid] = mn;
+    pthread_barrier_wait(&g_barrier); /* window end */
+  }
+  return NULL;
+}
+
+static double now_wall(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s phold|onion ...\n", argv[0]);
+    return 2;
+  }
+  int nthreads = 0;
+  stime_t link_lat = 10 * NS_PER_MS;
+  if (!strcmp(argv[1], "phold")) {
+    g_workload = 0;
+    g_nhosts = argc > 2 ? atoi(argv[2]) : 16384;
+    int msgs = argc > 3 ? atoi(argv[3]) : 4;
+    double sim_s = argc > 4 ? atof(argv[4]) : 2.0;
+    nthreads = argc > 5 ? atoi(argv[5]) : 0;
+    g_stop = (stime_t)(sim_s * NS_PER_SEC);
+    g_mean_delay_ns = 10.0 * NS_PER_MS;
+    g_nvert = g_nhosts < 256 ? g_nhosts : 256;
+    g_lat = malloc((size_t)g_nvert * g_nvert * sizeof(stime_t));
+    for (int i = 0; i < g_nvert * g_nvert; i++) g_lat[i] = link_lat;
+    g_hosts = calloc((size_t)g_nhosts, sizeof(host_t));
+    for (int i = 0; i < g_nhosts; i++) {
+      pthread_mutex_init(&g_hosts[i].lock, NULL);
+      g_hosts[i].rng = 0x9e3779b97f4a7c15ULL ^ ((uint64_t)i * 0xbf58476d1ce4e5b9ULL + 1);
+      for (int m = 0; m < msgs; m++) {
+        stime_t d = (stime_t)(-log1p(-rng_uniform(&g_hosts[i].rng)) * g_mean_delay_ns);
+        event_t ev = {.time = d < 1 ? 1 : d,
+                      .seq = ((uint64_t)i << 40) | g_hosts[i].ev_ctr++,
+                      .kind = EV_SEND,
+                      .host = i,
+                      .pkt = NULL};
+        heap_push(&g_hosts[i], ev);
+      }
+    }
+  } else if (!strcmp(argv[1], "onion")) {
+    g_workload = 1;
+    int circuits = argc > 2 ? atoi(argv[2]) : 2000;
+    g_onion_bytes = argc > 3 ? atoll(argv[3]) : (1 << 20);
+    nthreads = argc > 4 ? atoi(argv[4]) : 0;
+    g_onion_total = circuits;
+    g_nhosts = circuits * 5;
+    g_nvert = g_nhosts < 256 ? g_nhosts : 256;
+    g_lat = malloc((size_t)g_nvert * g_nvert * sizeof(stime_t));
+    for (int i = 0; i < g_nvert * g_nvert; i++) g_lat[i] = link_lat;
+    g_hosts = calloc((size_t)g_nhosts, sizeof(host_t));
+    for (int i = 0; i < g_nhosts; i++) {
+      pthread_mutex_init(&g_hosts[i].lock, NULL);
+      g_hosts[i].rng = 0x9e3779b97f4a7c15ULL ^ ((uint64_t)i * 0xbf58476d1ce4e5b9ULL + 1);
+      g_hosts[i].onion_role = i % 5;
+      g_hosts[i].onion_circuit = i / 5;
+    }
+    /* every client primes its window at t=1ms */
+    for (int c = 0; c < circuits; c++) {
+      int self = c * 5;
+      event_t kick = {.time = NS_PER_MS,
+                      .seq = ((uint64_t)self << 40) | g_hosts[self].ev_ctr++,
+                      .kind = EV_ONION_ACK, /* ack(0) primes the pump */
+                      .host = self,
+                      .pkt = NULL};
+      packet_t *p = malloc(sizeof(packet_t));
+      memset(p, 0, sizeof(*p));
+      kick.pkt = p;
+      heap_push(&g_hosts[self], kick);
+    }
+  } else {
+    fprintf(stderr, "unknown workload %s\n", argv[1]);
+    return 2;
+  }
+
+  if (nthreads <= 0) {
+    long n = sysconf(_SC_NPROCESSORS_ONLN);
+    nthreads = n > 0 ? (int)n : 1;
+  }
+  if (nthreads > g_nhosts) nthreads = g_nhosts;
+  g_nthreads = nthreads;
+  g_lookahead = link_lat;
+  g_thread_min = malloc((size_t)nthreads * sizeof(stime_t));
+  pthread_barrier_init(&g_barrier, NULL, (unsigned)nthreads + 1);
+  pthread_t *tids = malloc((size_t)nthreads * sizeof(pthread_t));
+  targ_t *targs = malloc((size_t)nthreads * sizeof(targ_t));
+  int per = (g_nhosts + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; t++) {
+    targs[t].tid = t;
+    targs[t].lo = t * per;
+    targs[t].hi = (t + 1) * per < g_nhosts ? (t + 1) * per : g_nhosts;
+    pthread_create(&tids[t], NULL, worker, &targs[t]);
+  }
+
+  double t0 = now_wall();
+  stime_t now = 0;
+  int64_t windows = 0;
+  for (;;) {
+    /* window start: advance to min next event + lookahead */
+    g_window_end = now + g_lookahead;
+    if (g_window_end > g_stop) g_window_end = g_stop;
+    pthread_barrier_wait(&g_barrier); /* release workers */
+    pthread_barrier_wait(&g_barrier); /* workers done */
+    windows++;
+    stime_t mn = TIME_INF;
+    for (int t = 0; t < nthreads; t++)
+      if (g_thread_min[t] < mn) mn = g_thread_min[t];
+    if (g_workload == 1) {
+      pthread_mutex_lock(&g_done_lock);
+      int64_t done = g_onion_done;
+      pthread_mutex_unlock(&g_done_lock);
+      if (done >= g_onion_total) { now = g_window_end; break; }
+    }
+    if (mn >= g_stop) { now = g_stop; break; }
+    now = mn > g_window_end ? mn : g_window_end;
+    if (now >= g_stop) break;
+  }
+  g_running = 0;
+  pthread_barrier_wait(&g_barrier);
+  for (int t = 0; t < nthreads; t++) pthread_join(tids[t], NULL);
+  double wall = now_wall() - t0;
+
+  int64_t sent = 0, recv = 0;
+  for (int i = 0; i < g_nhosts; i++) {
+    sent += g_hosts[i].sent;
+    recv += g_hosts[i].recv;
+  }
+  int64_t events = sent + recv;
+  if (g_workload == 0) {
+    printf("{\"workload\": \"phold\", \"hosts\": %d, \"threads\": %d, "
+           "\"sim_seconds\": %.3f, \"events\": %" PRId64 ", "
+           "\"wall_sec\": %.3f, \"events_per_sec\": %.1f, "
+           "\"windows\": %" PRId64 "}\n",
+           g_nhosts, g_nthreads, (double)now / NS_PER_SEC, events, wall,
+           (double)events / wall, windows);
+  } else {
+    printf("{\"workload\": \"onion\", \"circuits\": %" PRId64 ", "
+           "\"threads\": %d, \"bytes_per_circuit\": %" PRId64 ", "
+           "\"completed\": %" PRId64 ", \"sim_seconds\": %.3f, "
+           "\"events\": %" PRId64 ", \"wall_sec\": %.3f, "
+           "\"events_per_sec\": %.1f}\n",
+           g_onion_total, g_nthreads, g_onion_bytes, g_onion_done,
+           (double)now / NS_PER_SEC, events, wall,
+           (double)events / wall);
+  }
+  return 0;
+}

