@@ -12,14 +12,27 @@ within-tick order defined by slot index.  This reproduces the role of the
 reference's per-host srcHostEventID in the deterministic event total order
 (/root/reference/src/main/core/work/event.c:110-153) without any sequential
 bookkeeping.
+
+Layout (round 5): emissions are staged directly in the packed packet-record
+column format shared with the outbox and inbox (state.OCOL_* / ICOL_*), so
+one `put` is a single row build + one dynamic-update-slice instead of ~16
+per-field updates, and the engine's staging merge moves whole rows.  The
+engine later patches the columns only it can know (SRC, CTR, TS, TIME,
+LAT).  `t_send` rides in the TIME columns until staging decodes it.
 """
 
 from __future__ import annotations
 
+import jax
 from flax import struct
 import jax.numpy as jnp
 
-from .state import F32, I32, I64, U32, SACK_BLOCKS
+from .state import (F32, I32, I64, U32, SACK_BLOCKS, OCOLS,
+                    ICOL_SPORT, ICOL_DPORT, ICOL_PROTO, ICOL_FLAGS,
+                    ICOL_SEQ, ICOL_ACK, ICOL_WND, ICOL_LEN, ICOL_PAYLOAD,
+                    ICOL_TIME_LO, ICOL_TIME_HI, ICOL_TSE_LO, ICOL_TSE_HI,
+                    ICOL_SACK0_LO, OCOL_DST, OCOL_PRIO,
+                    enc_lo, enc_hi, dec_i64)
 
 # Emission slots, in deterministic within-tick order.
 SLOT_RX_REPLY = 0   # ACK/SYN-ACK/RST generated while processing an arrival
@@ -32,26 +45,53 @@ NUM_SLOTS = SLOT_TX_BASE + TX_SLOTS
 
 @struct.dataclass
 class Emissions:
-    """[H, NUM_SLOTS] staged outgoing packets for the current tick."""
+    """[H, E] staged outgoing packets for the current tick, in packed
+    column format (state.OCOL_* layout, engine-owned columns zero)."""
 
     valid: jnp.ndarray       # [H,E] bool
-    dst: jnp.ndarray         # [H,E] i32
-    sport: jnp.ndarray       # [H,E] i32
-    dport: jnp.ndarray       # [H,E] i32
-    proto: jnp.ndarray       # [H,E] i32
-    flags: jnp.ndarray       # [H,E] i32
-    seq: jnp.ndarray         # [H,E] u32
-    ack: jnp.ndarray         # [H,E] u32
-    wnd: jnp.ndarray         # [H,E] i32
-    length: jnp.ndarray      # [H,E] i32
-    ts_echo: jnp.ndarray     # [H,E] i64
-    t_send: jnp.ndarray      # [H,E] i64 per-lane send instant; 0 = the
-                             # tick time (rx_batch rounds stamp replies at
-                             # the triggering arrival's own time)
-    sack_lo: jnp.ndarray     # [H,E,SACK_BLOCKS] u32 advertised SACK ranges
-    sack_hi: jnp.ndarray     # [H,E,SACK_BLOCKS] u32
-    payload_id: jnp.ndarray  # [H,E] i32
-    priority: jnp.ndarray    # [H,E] f32
+    blk: jnp.ndarray         # [H,E,OCOLS] i32
+
+    # Decoded column views (engine staging + capture/log paths).
+    @property
+    def dst(self):
+        return self.blk[:, :, OCOL_DST]
+
+    @property
+    def sport(self):
+        return self.blk[:, :, ICOL_SPORT]
+
+    @property
+    def dport(self):
+        return self.blk[:, :, ICOL_DPORT]
+
+    @property
+    def proto(self):
+        return self.blk[:, :, ICOL_PROTO]
+
+    @property
+    def flags(self):
+        return self.blk[:, :, ICOL_FLAGS]
+
+    @property
+    def seq(self):
+        return jax.lax.bitcast_convert_type(self.blk[:, :, ICOL_SEQ], U32)
+
+    @property
+    def ack(self):
+        return jax.lax.bitcast_convert_type(self.blk[:, :, ICOL_ACK], U32)
+
+    @property
+    def length(self):
+        return self.blk[:, :, ICOL_LEN]
+
+    @property
+    def payload_id(self):
+        return self.blk[:, :, ICOL_PAYLOAD]
+
+    @property
+    def t_send(self):
+        return dec_i64(self.blk[:, :, ICOL_TIME_LO],
+                       self.blk[:, :, ICOL_TIME_HI])
 
 
 def empty(num_hosts: int, num_slots: int = NUM_SLOTS) -> Emissions:
@@ -62,21 +102,7 @@ def empty(num_hosts: int, num_slots: int = NUM_SLOTS) -> Emissions:
     he = (num_hosts, num_slots)
     return Emissions(
         valid=jnp.zeros(he, jnp.bool_),
-        dst=jnp.zeros(he, I32),
-        sport=jnp.zeros(he, I32),
-        dport=jnp.zeros(he, I32),
-        proto=jnp.zeros(he, I32),
-        flags=jnp.zeros(he, I32),
-        seq=jnp.zeros(he, U32),
-        ack=jnp.zeros(he, U32),
-        wnd=jnp.zeros(he, I32),
-        length=jnp.zeros(he, I32),
-        ts_echo=jnp.zeros(he, I64),
-        t_send=jnp.zeros(he, I64),
-        sack_lo=jnp.zeros(he + (SACK_BLOCKS,), U32),
-        sack_hi=jnp.zeros(he + (SACK_BLOCKS,), U32),
-        payload_id=jnp.full(he, -1, I32),
-        priority=jnp.zeros(he, F32),
+        blk=jnp.zeros(he + (OCOLS,), I32),
     )
 
 
@@ -85,40 +111,57 @@ def put(em: Emissions, mask: jnp.ndarray, slot: int, *, dst, sport, dport,
         t_send=0, sack_lo=None, sack_hi=None, payload_id=-1,
         priority=0.0) -> Emissions:
     """Vectorized emit: for hosts where `mask` is set, stage one packet in
-    `slot`.  All field arguments are scalars or [H] arrays."""
+    `slot`.  All field arguments are scalars or [H] arrays.  Builds the
+    packed row once and writes it with a single update."""
 
     h = em.valid.shape[0]
 
     def b(x, dtype):
         return jnp.broadcast_to(jnp.asarray(x).astype(dtype), (h,))
 
-    def upd(cur, val, dtype):
-        return cur.at[:, slot].set(jnp.where(mask, b(val, dtype), cur[:, slot]))
+    def bc32(x, dtype):
+        """[H] value in its natural dtype -> i32 column."""
+        v = b(x, dtype)
+        if dtype == U32:
+            return jax.lax.bitcast_convert_type(v, I32)
+        if dtype == F32:
+            return jax.lax.bitcast_convert_type(v, I32)
+        return v.astype(I32)
 
-    def upd3(cur, val):
-        if val is None:
-            return cur
-        v = jnp.asarray(val).astype(U32)
-        if v.ndim == 1:
-            v = jnp.broadcast_to(v[None, :], (h, SACK_BLOCKS))
-        new = jnp.where(mask[:, None], v, cur[:, slot, :])
-        return cur.at[:, slot, :].set(new)
+    ts64 = b(t_send, I64)
+    tse64 = b(ts_echo, I64)
+    cols = [jnp.zeros((h,), I32)] * OCOLS
+    cols[ICOL_SPORT] = bc32(sport, I32)
+    cols[ICOL_DPORT] = bc32(dport, I32)
+    cols[ICOL_PROTO] = bc32(proto, I32)
+    cols[ICOL_FLAGS] = bc32(flags, I32)
+    cols[ICOL_SEQ] = bc32(seq, U32)
+    cols[ICOL_ACK] = bc32(ack, U32)
+    cols[ICOL_WND] = bc32(wnd, I32)
+    cols[ICOL_LEN] = bc32(length, I32)
+    cols[ICOL_PAYLOAD] = bc32(payload_id, I32)
+    cols[ICOL_TIME_LO] = enc_lo(ts64)
+    cols[ICOL_TIME_HI] = enc_hi(ts64)
+    cols[ICOL_TSE_LO] = enc_lo(tse64)
+    cols[ICOL_TSE_HI] = enc_hi(tse64)
+    if sack_lo is not None:
+        slo = jnp.asarray(sack_lo).astype(U32)
+        shi = jnp.asarray(sack_hi).astype(U32)
+        if slo.ndim == 1:
+            slo = jnp.broadcast_to(slo[None, :], (h, SACK_BLOCKS))
+            shi = jnp.broadcast_to(shi[None, :], (h, SACK_BLOCKS))
+        for i in range(SACK_BLOCKS):
+            cols[ICOL_SACK0_LO + 2 * i] = \
+                jax.lax.bitcast_convert_type(slo[:, i], I32)
+            cols[ICOL_SACK0_LO + 2 * i + 1] = \
+                jax.lax.bitcast_convert_type(shi[:, i], I32)
+    cols[OCOL_DST] = bc32(dst, I32)
+    cols[OCOL_PRIO] = bc32(priority, F32)
 
+    row = jnp.stack(cols, axis=1)                      # [H, OCOLS]
+    new = jnp.where(mask[:, None], row, em.blk[:, slot, :])
     return Emissions(
-        valid=em.valid.at[:, slot].set(jnp.where(mask, True, em.valid[:, slot])),
-        dst=upd(em.dst, dst, I32),
-        sport=upd(em.sport, sport, I32),
-        dport=upd(em.dport, dport, I32),
-        proto=upd(em.proto, proto, I32),
-        flags=upd(em.flags, flags, I32),
-        seq=upd(em.seq, seq, U32),
-        ack=upd(em.ack, ack, U32),
-        wnd=upd(em.wnd, wnd, I32),
-        length=upd(em.length, length, I32),
-        ts_echo=upd(em.ts_echo, ts_echo, I64),
-        t_send=upd(em.t_send, t_send, I64),
-        sack_lo=upd3(em.sack_lo, sack_lo),
-        sack_hi=upd3(em.sack_hi, sack_hi),
-        payload_id=upd(em.payload_id, payload_id, I32),
-        priority=upd(em.priority, priority, F32),
+        valid=em.valid.at[:, slot].set(jnp.where(mask, True,
+                                                 em.valid[:, slot])),
+        blk=em.blk.at[:, slot, :].set(new),
     )

@@ -71,19 +71,13 @@ from .state import (ERR_POOL_OVERFLOW, I32, I64, U32, PROTO_TCP, PROTO_UDP,
                     ICOL_TIME_LO, ICOL_TIME_HI, ICOL_CTR_LO, ICOL_CTR_HI,
                     ICOL_TS_LO, ICOL_TS_HI, ICOL_TSE_LO, ICOL_TSE_HI,
                     ICOL_SACK0_LO, ICOL_SACK0_HI, ICOL_SACK2_HI, ICOLS,
+                    OCOL_DST, OCOL_LAT_LO, OCOL_LAT_HI, OCOL_PRIO, OCOLS,
+                    MCOL_STAGE, MCOL_STATUS,
                     LOG_WARNING, LOG_DEBUG, LOG_DROP_INET, LOG_DROP_ROUTER,
                     LOG_DROP_TAIL, LOG_DROP_POOL, LOG_DELIVER, LOG_SEND,
-                    enc_lo, enc_hi, dec_i64, pack_inbox_cols, SimState)
+                    enc_lo, enc_hi, dec_i64, SimState)
 
 INV = simtime.SIMTIME_INVALID
-
-# Plain Python int, NOT jnp: module-level jnp expressions run an eager device
-# op at import time and initialize the ambient JAX backend, which breaks the
-# CPU-child sandbox used by dryrun_multichip (see core/rng.py for the rule;
-# tests/test_import_hygiene.py locks it in). Weak typing makes `x & _MASK40`
-# identical for int64 x.
-_MASK40 = (1 << 40) - 1
-
 
 def _uses_tcp(app) -> bool:
     """Static app capability: apps that never open TCP sockets (pure-UDP
@@ -96,10 +90,6 @@ def _may_loopback(app) -> bool:
     the loopback insert path (an [H*E]-row scatter per micro-step) trace
     away entirely."""
     return getattr(app, "may_loopback", True)
-
-
-def _bitcast_u32_i32(x):
-    return jax.lax.bitcast_convert_type(x.astype(U32), I32)
 
 
 def _bitcast_i32_u32(x):
@@ -337,19 +327,18 @@ def _exchange_body(state: SimState, params) -> SimState:
     ok = mvp & (rank < n_free[dstp])
     islot = jnp.where(ok, dstp * ki + within, p1)       # p1 = drop sentinel
 
-    # --- packed block rows (all i32; i64 fields split lo/hi, u32 bitcast).
+    # --- forward the packed rows verbatim: the outbox block's first ICOLS
+    # columns ARE the inbox layout; only the TIME columns need splicing
+    # from the authoritative `time` array (the block's copy went stale if
+    # _tx_drain restamped the departure).
     def pad0(x):
         return jnp.pad(x, (0, pad))
 
-    cols = pack_inbox_cols(
-        src=pool.src, sport=pool.sport, dport=pool.dport, proto=pool.proto,
-        flags=pool.flags, seq_i32=_bitcast_u32_i32(pool.seq),
-        ack_i32=_bitcast_u32_i32(pool.ack), wnd=pool.wnd,
-        length=pool.length, payload_id=pool.payload_id, time=pool.time,
-        ctr=pool.pkt_id & _MASK40, ts=pool.ts, ts_echo=pool.ts_echo,
-        sack_lo_i32=[_bitcast_u32_i32(pool.sack_lo[:, i]) for i in range(3)],
-        sack_hi_i32=[_bitcast_u32_i32(pool.sack_hi[:, i]) for i in range(3)])
-    vals = jnp.stack([pad0(c.astype(I32)) for c in cols], axis=1)  # [npad, C]
+    vals = jnp.concatenate(
+        [pool.blk[:, :ICOL_TIME_LO],
+         enc_lo(pool.time)[:, None], enc_hi(pool.time)[:, None],
+         pool.blk[:, ICOL_TIME_HI + 1:ICOLS]], axis=1)    # [P0, ICOLS]
+    vals = jnp.pad(vals, ((0, pad), (0, 0)))              # [npad, ICOLS]
 
     blk = ib.blk.at[islot].set(vals, mode="drop")
     stage = ib.stage.at[islot].set(STAGE_IN_FLIGHT, mode="drop")
@@ -703,12 +692,26 @@ def _free_slot_pick(free2, rank2):
     return jnp.sum(jnp.where(onehot, ids, 0), axis=2, dtype=I32)
 
 
-def _merge_rows(cur, val2, oh, hit, shape):
-    """One-hot merge of [H,E] emission values into [H,K] slab rows (the
-    scatter-free staging primitive): entry (h,k) takes the value of the
-    emission lane mapped to it, else keeps its current value."""
-    v = jnp.sum(jnp.where(oh, val2[:, :, None], 0), axis=1, dtype=cur.dtype)
-    return jnp.where(hit, v, cur.reshape(shape)).reshape(-1)
+def _patched_rows(em, src2, ctr2, time_v, send_t, lat, stage_v, status_v):
+    """[H,E,MCOLS] staging rows: the emission block with the engine-owned
+    columns patched in (SRC, TIME, CTR, TS, LAT) plus the merge-scratch
+    STAGE/STATUS columns.  Pure slicing + stacking; one concatenate."""
+    eb = em.blk
+
+    def c(x):
+        return x[:, :, None].astype(I32)
+
+    return jnp.concatenate([
+        c(src2),                                   # ICOL_SRC
+        eb[:, :, 1:ICOL_TIME_LO],                  # SPORT..PAYLOAD
+        c(enc_lo(time_v)), c(enc_hi(time_v)),      # ICOL_TIME_*
+        c(enc_lo(ctr2)), c(enc_hi(ctr2)),          # ICOL_CTR_*
+        c(enc_lo(send_t)), c(enc_hi(send_t)),      # ICOL_TS_*
+        eb[:, :, ICOL_TSE_LO:OCOL_LAT_LO],         # TSE, SACK, DST
+        c(enc_lo(lat)), c(enc_hi(lat)),            # OCOL_LAT_*
+        eb[:, :, OCOL_PRIO:OCOL_PRIO + 1],         # OCOL_PRIO
+        c(stage_v), c(status_v),                   # MCOL_STAGE/STATUS
+    ], axis=2)
 
 
 def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
@@ -738,7 +741,6 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
 
     src2 = jnp.broadcast_to(jnp.arange(h, dtype=I32)[:, None], (h, e))
     ctr2 = ctr[:, None] + rank
-    pkt_id2 = (src2.astype(I64) << 40) | ctr2
 
     # Routing: latency (+ per-packet jitter) + reliability, loopback
     # shortcut.  vs is the emitting host's own vertex -- a broadcast, not
@@ -805,43 +807,27 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
         PDS_SND_CREATED | PDS_SND_INTERFACE_SENT | PDS_INET_SENT,
         PDS_SND_CREATED)
 
-    # --- scatter-free merge into the outbox slab rows.
+    # --- scatter-free merge into the outbox slab rows: ONE one-hot merge
+    # of the whole packed row (round 4 did ~21 per-field merges here; the
+    # step cost at small H is kernel-count-bound, see PERF.md).
     oh = (within[:, :, None] == ids[:, None, :]) & have_slot[:, :, None]
     hit = jnp.any(oh, axis=1)
 
-    def mg(cur, val2):
-        return _merge_rows(cur, val2, oh, hit, (h, ko))
-
-    def mg3(cur, val3):
-        # [H,E,B] emission blocks -> [P0,B] pool blocks.
-        b = cur.shape[1]
-        v = jnp.sum(jnp.where(oh[:, :, :, None], val3[:, :, None, :], 0),
-                    axis=1, dtype=cur.dtype)          # [H,Ko,B]
-        cur2 = cur.reshape(h, ko, b)
-        return jnp.where(hit[:, :, None], v, cur2).reshape(-1, b)
-
+    val3 = _patched_rows(em, src2, ctr2, time_v, send_t, lat,
+                         stage_v, status_v)            # [H,E,MCOLS]
+    v = jnp.sum(jnp.where(oh[:, :, :, None], val3[:, :, None, :], 0),
+                axis=1, dtype=I32)                     # [H,Ko,MCOLS]
+    blk3 = pool.blk.reshape(h, ko, OCOLS)
+    hit3 = hit[:, :, None]
     pool = pool.replace(
-        stage=mg(pool.stage, stage_v),
-        src=mg(pool.src, src2),
-        dst=mg(pool.dst, em.dst),
-        sport=mg(pool.sport, em.sport),
-        dport=mg(pool.dport, em.dport),
-        proto=mg(pool.proto, em.proto),
-        flags=mg(pool.flags, em.flags),
-        seq=mg(pool.seq, em.seq),
-        ack=mg(pool.ack, em.ack),
-        wnd=mg(pool.wnd, em.wnd),
-        length=mg(pool.length, em.length),
-        time=mg(pool.time, time_v),
-        lat_ns=mg(pool.lat_ns, lat),
-        pkt_id=mg(pool.pkt_id, pkt_id2),
-        ts=mg(pool.ts, send_t),
-        ts_echo=mg(pool.ts_echo, em.ts_echo),
-        sack_lo=mg3(pool.sack_lo, em.sack_lo),
-        sack_hi=mg3(pool.sack_hi, em.sack_hi),
-        payload_id=mg(pool.payload_id, em.payload_id),
-        priority=mg(pool.priority, em.priority),
-        status=mg(pool.status, status_v),
+        blk=jnp.where(hit3, v[:, :, :OCOLS], blk3).reshape(-1, OCOLS),
+        stage=jnp.where(hit, v[:, :, MCOL_STAGE],
+                        pool.stage.reshape(h, ko)).reshape(-1),
+        status=jnp.where(hit, v[:, :, MCOL_STATUS],
+                         pool.status.reshape(h, ko)).reshape(-1),
+        time=jnp.where(hit, dec_i64(v[:, :, ICOL_TIME_LO],
+                                    v[:, :, ICOL_TIME_HI]),
+                       pool.time.reshape(h, ko)).reshape(-1),
     )
     state = state.replace(pool=pool, hosts=hosts)
 
@@ -913,18 +899,21 @@ def _loopback_insert(state: SimState, em, lb, src2, ctr2, send_t):
     ok = lb & (lb_rank >= 0) & (lb_rank < n_free[:, None])
     islot = jnp.where(ok, src2 * ki + within, p1).reshape(-1)
 
+    # Packed rows in inbox layout: the emission block's first ICOLS
+    # columns with SRC/TIME/CTR/TS patched (arrival = send + 1ns).
     arr = send_t + simtime.SIMTIME_ONE_NANOSECOND
-    cols = pack_inbox_cols(
-        src=src2, sport=em.sport, dport=em.dport, proto=em.proto,
-        flags=em.flags, seq_i32=_bitcast_u32_i32(em.seq),
-        ack_i32=_bitcast_u32_i32(em.ack), wnd=em.wnd, length=em.length,
-        payload_id=em.payload_id, time=arr, ctr=ctr2, ts=send_t,
-        ts_echo=em.ts_echo,
-        sack_lo_i32=[_bitcast_u32_i32(em.sack_lo[:, :, i])
-                     for i in range(3)],
-        sack_hi_i32=[_bitcast_u32_i32(em.sack_hi[:, :, i])
-                     for i in range(3)])
-    vals = jnp.stack([c.astype(I32).reshape(-1) for c in cols], axis=1)
+
+    def c(x):
+        return x[:, :, None].astype(I32)
+
+    vals = jnp.concatenate([
+        c(src2),
+        em.blk[:, :, 1:ICOL_TIME_LO],
+        c(enc_lo(arr)), c(enc_hi(arr)),
+        c(enc_lo(ctr2)), c(enc_hi(ctr2)),
+        c(enc_lo(send_t)), c(enc_hi(send_t)),
+        em.blk[:, :, ICOL_TSE_LO:ICOLS],
+    ], axis=2).reshape(-1, ICOLS)
 
     pds = PDS_SND_CREATED | PDS_SND_INTERFACE_SENT | PDS_INET_SENT
     ib = ib.replace(
@@ -973,7 +962,9 @@ def _tx_drain(state: SimState, params, tick_t, active):
 
     tokens, last = nic.refill(hosts.tokens_tx, hosts.last_refill_tx,
                               params.bw_up_Bps, tick_t, active)
-    size = _wire_bytes(pool.proto[slot], pool.length[slot]).astype(I64) \
+    # One packed row gather for every field of the chosen packet.
+    row = pool.blk[slot]                                 # [H, OCOLS]
+    size = _wire_bytes(row[:, ICOL_PROTO], row[:, ICOL_LEN]).astype(I64) \
         * nic.SCALE
     boot = tick_t < params.bootstrap_end
     funded = have & (boot | (tokens >= size))
@@ -983,7 +974,7 @@ def _tx_drain(state: SimState, params, tick_t, active):
     # already includes this packet's keyed jitter draw, so departure needs
     # no routing lookup; the reliability draw also happened at staging, so
     # loss is independent of queueing).
-    arr = tick_t + pool.lat_ns[slot]
+    arr = tick_t + dec_i64(row[:, OCOL_LAT_LO], row[:, OCOL_LAT_HI])
     ko = pool.capacity // h
     funded_b = jnp.broadcast_to(funded[:, None], (h, ko)).reshape(-1)
     arr_b = jnp.broadcast_to(arr[:, None], (h, ko)).reshape(-1)

@@ -127,66 +127,69 @@ def _zeros(shape, dtype):
 
 @struct.dataclass
 class PacketPool:
-    """All packets in the world; fixed capacity P, SoA layout.
+    """All packets in the world (the OUTBOX half); fixed capacity P.
 
-    `pkt_id` is the deterministic total-order tiebreaker: a packet created
-    by host h as its n-th emission gets id (h << 40) | n, mirroring the
-    reference's (srcHostID, srcHostEventID) component of the event order
-    (event.c:110-153).  Drop draws are keyed by pkt_id so loss is identical
-    across meshes and window batchings.
+    Layout (round 5): every per-packet field that is written ONCE at
+    staging lives in a packed [P, OCOLS] i32 block whose first ICOLS
+    columns are byte-identical to the inbox layout -- emission staging
+    writes the block with ONE one-hot merge (instead of ~21 per-field
+    merges, the largest phase of the round-4 step), and the boundary
+    exchange forwards rows into the inbox with a 2-column time splice
+    instead of a 24-field re-pack.  Only the hot-mutated lifecycle
+    fields stay as separate arrays: `stage` (every phase), `time`
+    (authoritative; _tx_drain restamps departures), `status` (PDS trail).
+
+    The deterministic total-order tiebreaker pkt_id = (src << 40) | ctr
+    lives in the block's CTR columns, mirroring the reference's
+    (srcHostID, srcHostEventID) order component (event.c:110-153); drop
+    draws are keyed by it so loss is identical across meshes and window
+    batchings.
     """
 
+    blk: jnp.ndarray          # [P, OCOLS] i32 packed (immutable per stay;
+                              # TIME cols stale after _tx_drain -- `time`
+                              # below is authoritative)
     stage: jnp.ndarray        # [P] i32 STAGE_*
-    src: jnp.ndarray          # [P] i32 source host index
-    dst: jnp.ndarray          # [P] i32 destination host index
-    sport: jnp.ndarray       # [P] i32
-    dport: jnp.ndarray       # [P] i32
-    proto: jnp.ndarray        # [P] i32 PROTO_*
-    flags: jnp.ndarray        # [P] i32 TCP flags
-    seq: jnp.ndarray          # [P] u32
-    ack: jnp.ndarray          # [P] u32
-    wnd: jnp.ndarray          # [P] i32 advertised window (bytes)
-    length: jnp.ndarray       # [P] i32 payload bytes (headers excluded)
-    time: jnp.ndarray         # [P] i64 stage-dependent: ready/deliver/arrive time
-    lat_ns: jnp.ndarray       # [P] i64 path latency (incl. the packet's
-                              # jitter draw), fixed at staging so a parked
-                              # packet's departure needs no routing lookup
-    pkt_id: jnp.ndarray       # [P] i64 (src << 40) | per-src counter
-    ts: jnp.ndarray           # [P] i64 TCP timestamp (send time)
-    ts_echo: jnp.ndarray      # [P] i64 TCP timestamp echo
-    sack_lo: jnp.ndarray      # [P, SACK_BLOCKS] u32 advertised SACK ranges
-    sack_hi: jnp.ndarray      # [P, SACK_BLOCKS] u32 (lo == hi == 0: empty)
-    payload_id: jnp.ndarray   # [P] i32 host-side arena ref, -1 = modeled
-    priority: jnp.ndarray     # [P] f32 qdisc priority (reference packet.c priority)
+    time: jnp.ndarray         # [P] i64 stage-dependent: ready/deliver/arrive
     status: jnp.ndarray       # [P] i32 PDS_* trail
 
     @property
     def capacity(self) -> int:
         return self.stage.shape[0]
 
+    # Decoded views (observability / tests; column slices are cheap).
+    @property
+    def src(self):
+        return self.blk[:, ICOL_SRC]
+
+    @property
+    def dst(self):
+        return self.blk[:, OCOL_DST]
+
+    @property
+    def proto(self):
+        return self.blk[:, ICOL_PROTO]
+
+    @property
+    def length(self):
+        return self.blk[:, ICOL_LEN]
+
+    @property
+    def lat_ns(self):
+        return dec_i64(self.blk[:, OCOL_LAT_LO], self.blk[:, OCOL_LAT_HI])
+
+    @property
+    def pkt_id(self):
+        src = self.blk[:, ICOL_SRC].astype(I64)
+        ctr = dec_i64(self.blk[:, ICOL_CTR_LO], self.blk[:, ICOL_CTR_HI])
+        return (src << 40) | ctr
+
 
 def make_packet_pool(capacity: int) -> PacketPool:
     return PacketPool(
+        blk=_zeros((capacity, OCOLS), I32),
         stage=_zeros((capacity,), I32),
-        src=_zeros((capacity,), I32),
-        dst=_zeros((capacity,), I32),
-        sport=_zeros((capacity,), I32),
-        dport=_zeros((capacity,), I32),
-        proto=_zeros((capacity,), I32),
-        flags=_zeros((capacity,), I32),
-        seq=_zeros((capacity,), U32),
-        ack=_zeros((capacity,), U32),
-        wnd=_zeros((capacity,), I32),
-        length=_zeros((capacity,), I32),
         time=_full((capacity,), I64, simtime.SIMTIME_INVALID),
-        lat_ns=_zeros((capacity,), I64),
-        pkt_id=_zeros((capacity,), I64),
-        ts=_zeros((capacity,), I64),
-        ts_echo=_zeros((capacity,), I64),
-        sack_lo=_zeros((capacity, SACK_BLOCKS), U32),
-        sack_hi=_zeros((capacity, SACK_BLOCKS), U32),
-        payload_id=_full((capacity,), I32, -1),
-        priority=_zeros((capacity,), F32),
         status=_zeros((capacity,), I32),
     )
 
@@ -208,6 +211,23 @@ def make_packet_pool(capacity: int) -> PacketPool:
  ICOL_SACK2_LO, ICOL_SACK2_HI) = range(24)
 ICOLS = 24
 
+# Outbox/emission extension columns: the packed OUTBOX block (and the
+# emission staging block) shares the inbox's first ICOLS columns exactly,
+# then appends the send-side-only fields.  One layout end to end means
+# emit.put writes rows in their final wire format, staging merges ONE
+# block, and the boundary exchange forwards rows verbatim (time spliced).
+OCOL_DST = ICOLS + 0       # destination host
+OCOL_LAT_LO = ICOLS + 1    # path latency incl. the packet's jitter draw,
+OCOL_LAT_HI = ICOLS + 2    # fixed at staging (parked departures skip routing)
+OCOL_PRIO = ICOLS + 3      # qdisc priority (f32 bitcast)
+OCOLS = ICOLS + 4
+
+# Staging-scratch columns appended to the merge (split off into the
+# separate stage/status arrays after the one big one-hot merge).
+MCOL_STAGE = OCOLS + 0
+MCOL_STATUS = OCOLS + 1
+MCOLS = OCOLS + 2
+
 # SACK blocks carried per segment (reference packet TCP header
 # selectiveACKs list, packet.c; RFC 2018 allows 3-4 -- 3 fit the
 # timestamped header).
@@ -228,41 +248,6 @@ def enc_hi(x):
 
 def dec_i64(lo, hi):
     return (hi.astype(I64) << 31) | lo.astype(I64)
-
-
-def pack_inbox_cols(*, src, sport, dport, proto, flags, seq_i32, ack_i32,
-                    wnd, length, payload_id, time, ctr, ts, ts_echo,
-                    sack_lo_i32, sack_hi_i32):
-    """The ONE encode site for the packed inbox block: returns the list of
-    ICOLS i32 column arrays in ICOL_* order (callers stack them).  Both
-    the boundary exchange and the loopback insert must agree with
-    Inbox/engine.RxPkt decoding, so they share this."""
-    cols = [None] * ICOLS
-    cols[ICOL_SRC] = src
-    cols[ICOL_SPORT] = sport
-    cols[ICOL_DPORT] = dport
-    cols[ICOL_PROTO] = proto
-    cols[ICOL_FLAGS] = flags
-    cols[ICOL_SEQ] = seq_i32
-    cols[ICOL_ACK] = ack_i32
-    cols[ICOL_WND] = wnd
-    cols[ICOL_LEN] = length
-    cols[ICOL_PAYLOAD] = payload_id
-    cols[ICOL_TIME_LO] = enc_lo(time)
-    cols[ICOL_TIME_HI] = enc_hi(time)
-    cols[ICOL_CTR_LO] = enc_lo(ctr)
-    cols[ICOL_CTR_HI] = enc_hi(ctr)
-    cols[ICOL_TS_LO] = enc_lo(ts)
-    cols[ICOL_TS_HI] = enc_hi(ts)
-    cols[ICOL_TSE_LO] = enc_lo(ts_echo)
-    cols[ICOL_TSE_HI] = enc_hi(ts_echo)
-    cols[ICOL_SACK0_LO] = sack_lo_i32[0]
-    cols[ICOL_SACK0_HI] = sack_hi_i32[0]
-    cols[ICOL_SACK1_LO] = sack_lo_i32[1]
-    cols[ICOL_SACK1_HI] = sack_hi_i32[1]
-    cols[ICOL_SACK2_LO] = sack_lo_i32[2]
-    cols[ICOL_SACK2_HI] = sack_hi_i32[2]
-    return cols
 
 
 def onehot_slot(slots: int, slot):

@@ -83,7 +83,7 @@ def main():
         active = th < we
         tick = jnp.where(active, th, we)
         em = emit.empty(h)
-        s, em, _d = engine._rx_phase(s, params, em, tick, active, app)
+        s, em, _d, _tp = engine._rx_phase(s, params, em, tick, active, app, we)
         th2, _ = scan(s)
         return s, th2
 
@@ -91,7 +91,7 @@ def main():
         active = th < we
         tick = jnp.where(active, th, we)
         em = emit.empty(h)
-        s, em, _d = engine._rx_phase(s, params, em, tick, active, app)
+        s, em, _d, _tp = engine._rx_phase(s, params, em, tick, active, app, we)
         s, em = app.on_tick(s, params, em, tick, active)
         th2, _ = scan(s)
         return s, th2
@@ -100,7 +100,7 @@ def main():
         active = th < we
         tick = jnp.where(active, th, we)
         em = emit.empty(h)
-        s, em, _d = engine._rx_phase(s, params, em, tick, active, app)
+        s, em, _d, _tp = engine._rx_phase(s, params, em, tick, active, app, we)
         s, em = app.on_tick(s, params, em, tick, active)
         s, _p = engine._stage_emissions(s, params, em, tick, active, app)
         th2, _ = scan(s)

@@ -1,0 +1,121 @@
+"""Ablation profile: time the full micro-step loop with single phases
+no-op'd (monkeypatched before trace), so each phase's cost is a delta
+from the SAME full-step baseline -- build-up subsets (stepprof.py) have
+proven unreliable because partial graphs fuse differently than the real
+step.  Also times the window-boundary exchange separately.
+
+    python tools/stepprof2.py [num_hosts]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import shadow1_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from shadow1_tpu import sim
+from shadow1_tpu.core import engine, simtime
+
+I32, I64 = jnp.int32, jnp.int64
+
+NUM_HOSTS = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+
+
+def timeloop(name, state0, params, app, body, iters_pair=(50, 200)):
+    res = {}
+    for iters in iters_pair:
+        def run(st, th):
+            def cond(c):
+                return c[0] < iters
+
+            def b(c):
+                i, s, t = c
+                s, t = body(s, t)
+                return i + 1, s, t
+
+            return jax.lax.while_loop(cond, b,
+                                      (jnp.asarray(0, I32), st, th))
+
+        jf = jax.jit(run)
+        th0, _ = engine._scan_all(state0, params, app)
+        out = jf(state0, th0)
+        np.asarray(out[1].now)
+        ts = []
+        for trial in range(3):
+            st2 = state0.replace(now=state0.now + trial)
+            t0 = time.perf_counter()
+            out = jf(st2, th0)
+            np.asarray(out[1].now)
+            ts.append(time.perf_counter() - t0)
+        res[iters] = min(ts)
+    slope = (res[iters_pair[1]] - res[iters_pair[0]]) \
+        / (iters_pair[1] - iters_pair[0]) * 1e3
+    print(f"{name:40s} {slope:8.3f} ms/iter", flush=True)
+    return slope
+
+
+def main():
+    state, params, app = sim.build_phold(
+        num_hosts=NUM_HOSTS, msgs_per_host=4,
+        mean_delay_ns=10 * simtime.SIMTIME_ONE_MILLISECOND,
+        stop_time=10 * simtime.SIMTIME_ONE_SECOND,
+        pool_capacity=NUM_HOSTS * 8)
+    state = engine.run_until(state, params, app,
+                             50 * simtime.SIMTIME_ONE_MILLISECOND)
+    jax.block_until_ready(state)
+    we = jnp.asarray(10 * simtime.SIMTIME_ONE_SECOND, I64)
+
+    def v_full(s, th):
+        s = engine._microstep_core(s, params, app, th, we)
+        th2, _ = engine._scan_all(s, params, app)
+        return s, th2
+
+    base = timeloop("full microstep + scan", state, params, app, v_full)
+
+    # Ablations: patch, re-trace (new jit closure), unpatch.
+    def with_patches(patches):
+        def body(s, th):
+            s = engine._microstep_core(s, params, app, th, we)
+            th2, _ = engine._scan_all(s, params, app)
+            return s, th2
+        saved = {name: getattr(engine, name) for name in patches}
+        for name, fn in patches.items():
+            setattr(engine, name, fn)
+        try:
+            return timeloop(f"full - {'/'.join(patches)}", state, params,
+                            app, body)
+        finally:
+            for name, fn in saved.items():
+                setattr(engine, name, fn)
+
+    no_tx = with_patches({"_tx_drain":
+                          lambda s, params, tick_t, active: s})
+    no_stage = with_patches({"_stage_emissions":
+                             lambda s, params, em, tick_t, active, app:
+                             (s, jnp.zeros_like(em.valid))})
+    no_rx = with_patches({"_rx_phase":
+                          lambda s, params, em, tick_t, active, app, we2:
+                          (s, em, jnp.zeros(
+                              (s.hosts.num_hosts,), I32), tick_t)})
+
+    print(f"{'=> tx_drain':40s} {base - no_tx:8.3f} ms")
+    print(f"{'=> stage_emissions':40s} {base - no_stage:8.3f} ms")
+    print(f"{'=> rx_phase':40s} {base - no_rx:8.3f} ms")
+
+    # Window-boundary exchange, timed as its own loop (forced body).
+    def v_exch(s, th):
+        s = engine._exchange_body(s, params)
+        # data dependence so iterations don't collapse
+        s = s.replace(now=s.now + 1)
+        return s, th
+
+    timeloop("exchange_body (forced)", state, params, app, v_exch)
+
+
+if __name__ == "__main__":
+    main()
