@@ -230,6 +230,7 @@ def run_config(args) -> int:
         "drops_inet": int(jnp.sum(state.hosts.pkts_dropped_inet)),
         "drops_router": int(jnp.sum(state.hosts.pkts_dropped_router)),
         "drops_pool": int(jnp.sum(state.hosts.pkts_dropped_pool)),
+        "acks_thinned": int(jnp.sum(state.hosts.acks_thinned)),
         "err_flags": int(state.err),
     }
     if want_pcap and args.data_directory:

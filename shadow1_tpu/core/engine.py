@@ -116,12 +116,22 @@ class RxPkt:
         self.length = row[:, ICOL_LEN]
         self.payload_id = row[:, ICOL_PAYLOAD]
         self.time = time_row
-        self.ts = dec_i64(row[:, ICOL_TS_LO], row[:, ICOL_TS_HI])
-        self.ts_echo = dec_i64(row[:, ICOL_TSE_LO], row[:, ICOL_TSE_HI])
-        self.sack_lo = _bitcast_i32_u32(
-            row[:, ICOL_SACK0_LO:ICOL_SACK2_HI + 1:2])
-        self.sack_hi = _bitcast_i32_u32(
-            row[:, ICOL_SACK0_HI:ICOL_SACK2_HI + 2:2])
+        if row.shape[1] >= ICOLS:
+            self.ts = dec_i64(row[:, ICOL_TS_LO], row[:, ICOL_TS_HI])
+            self.ts_echo = dec_i64(row[:, ICOL_TSE_LO], row[:, ICOL_TSE_HI])
+            self.sack_lo = _bitcast_i32_u32(
+                row[:, ICOL_SACK0_LO:ICOL_SACK2_HI + 1:2])
+            self.sack_hi = _bitcast_i32_u32(
+                row[:, ICOL_SACK0_HI:ICOL_SACK2_HI + 2:2])
+        else:
+            # Narrow (TCP-free) inbox: the TCP machine is traced away, so
+            # these registers are never consumed; keep them as zeros for
+            # shape stability.
+            z = jnp.zeros_like(time_row)
+            self.ts = z
+            self.ts_echo = z
+            self.sack_lo = jnp.zeros((row.shape[0], 3), U32)
+            self.sack_hi = jnp.zeros((row.shape[0], 3), U32)
         self.pkt_id = keys_row
 
 
@@ -283,12 +293,33 @@ def _superblock(n: int, h: int) -> int:
     return min(m, max(64, n))
 
 
+def _rank_by_dst(mask, dstp, h, m):
+    """Per-item rank among masked same-destination items, in flat order
+    (hierarchical: scatter-add superblock counts + exclusive cumsum +
+    in-superblock pairwise ranks).  Returns ([npad] rank, [H] totals)."""
+    npad = dstp.shape[0]
+    blkid = jnp.arange(npad, dtype=I32) // m
+    b = npad // m
+    ones = jnp.where(mask, 1, 0).astype(I32)
+    cnt = jnp.zeros((b, h), I32).at[blkid, dstp].add(ones, mode="drop")
+    csum = jnp.cumsum(cnt, axis=0)
+    off = csum - cnt                                   # exclusive over blocks
+    total = csum[-1]                                   # [H] items per dst
+    d3 = dstp.reshape(b, m)
+    l3 = mask.reshape(b, m)
+    eq = (d3[:, :, None] == d3[:, None, :]) & l3[:, None, :]
+    lower = jnp.tril(jnp.ones((m, m), bool), -1)[None]
+    rank_in = jnp.sum(eq & lower, axis=2, dtype=I32).reshape(-1)
+    return off.reshape(-1)[blkid * h + dstp] + rank_in, total
+
+
 def _exchange_body(state: SimState, params) -> SimState:
     pool, ib, hosts = state.pool, state.inbox, state.hosts
     h = hosts.num_hosts
     p0 = pool.capacity
     p1 = ib.capacity
     ki = p1 // h
+    ic = ib.blk.shape[1]          # ICOLS, or NCOLS_UDP for TCP-free worlds
 
     moving = pool.stage == STAGE_IN_FLIGHT             # [P0], src-major order
     dst = jnp.clip(pool.dst, 0, h - 1)
@@ -303,63 +334,91 @@ def _exchange_body(state: SimState, params) -> SimState:
     pad = npad - p0
     dstp = jnp.pad(dst, (0, pad))
     mvp = jnp.pad(moving, (0, pad))
-    blkid = jnp.arange(npad, dtype=I32) // m
-    b = npad // m
-    ones = jnp.where(mvp, 1, 0).astype(I32)
-    cnt = jnp.zeros((b, h), I32).at[blkid, dstp].add(ones, mode="drop")
-    csum = jnp.cumsum(cnt, axis=0)
-    off = csum - cnt                                   # exclusive over blocks
-    total = csum[-1]                                   # [H] movers per dst
-    d3 = dstp.reshape(b, m)
-    l3 = mvp.reshape(b, m)
-    eq = (d3[:, :, None] == d3[:, None, :]) & l3[:, None, :]
-    lower = jnp.tril(jnp.ones((m, m), bool), -1)[None]
-    rank_in = jnp.sum(eq & lower, axis=2, dtype=I32).reshape(-1)
-    rank = off.reshape(-1)[blkid * h + dstp] + rank_in  # [npad]
+    rank, total = _rank_by_dst(mvp, dstp, h, m)
 
-    # --- destination slab free-slot assignment (ascending slot order, so
-    # same-time ties keep rank order).
     free2 = (ib.stage == STAGE_FREE).reshape(h, ki)
     ids = jnp.arange(ki, dtype=I32)[None, :]
-    order2 = jnp.argsort(jnp.where(free2, ids, ids + ki), axis=1).astype(I32)
     n_free = jnp.sum(free2, axis=1, dtype=I32)          # [H]
-    within = order2.reshape(-1)[dstp * ki + jnp.clip(rank, 0, ki - 1)]
-    ok = mvp & (rank < n_free[dstp])
+
+    # --- ACK-before-data shedding (TCP worlds, overflow windows only):
+    # when a destination slab can't take every mover, deliberately shed
+    # pure ACKs first -- the vectorized analog of ACK compression under
+    # router pressure.  Cumulative ACKing absorbs the loss (the next ACK
+    # supersedes the shed one), so only DATA/control drops are protocol-
+    # visible and only they raise ERR_POOL_OVERFLOW.  Implemented as a
+    # class-aware re-rank: protected movers keep their rank among
+    # protected; pure ACKs rank after all protected for that dst.  Slot
+    # positions don't affect delivery order ((time, pkt_id) row-min), so
+    # the re-rank changes only WHO overflows, deterministically.
+    if ic >= ICOLS:
+        blk_f = pool.blk
+        from .state import TCP_FLAG_ACK
+        # Pure ACK = the ACK flag alone: no payload, no SYN/FIN/RST, and
+        # no PSH (which marks zero-window probes -- never shed those).
+        pure_ack = (blk_f[:, ICOL_PROTO] == PROTO_TCP) & \
+            (blk_f[:, ICOL_LEN] == 0) & \
+            (blk_f[:, ICOL_FLAGS] == TCP_FLAG_ACK)
+        ackp = jnp.pad(pure_ack, (0, pad)) & mvp
+        overflow = jnp.any(total > n_free)
+
+        def two_class(_):
+            rank_prot, total_prot = _rank_by_dst(mvp & ~ackp, dstp, h, m)
+            r = jnp.where(ackp, total_prot[dstp] + (rank - rank_prot),
+                          rank_prot)
+            return r, total_prot
+
+        rank_eff, total_prot = jax.lax.cond(
+            overflow & jnp.any(ackp), two_class,
+            lambda _: (rank, total), None)
+    else:
+        rank_eff, total_prot = rank, total
+
+    # --- destination slab free-slot assignment (ascending slot order).
+    order2 = jnp.argsort(jnp.where(free2, ids, ids + ki), axis=1).astype(I32)
+    within = order2.reshape(-1)[dstp * ki + jnp.clip(rank_eff, 0, ki - 1)]
+    ok = mvp & (rank_eff < n_free[dstp])
     islot = jnp.where(ok, dstp * ki + within, p1)       # p1 = drop sentinel
 
-    # --- forward the packed rows verbatim: the outbox block's first ICOLS
+    # --- forward the packed rows verbatim: the outbox block's first `ic`
     # columns ARE the inbox layout; only the TIME columns need splicing
     # from the authoritative `time` array (the block's copy went stale if
     # _tx_drain restamped the departure).
-    def pad0(x):
-        return jnp.pad(x, (0, pad))
-
     vals = jnp.concatenate(
         [pool.blk[:, :ICOL_TIME_LO],
          enc_lo(pool.time)[:, None], enc_hi(pool.time)[:, None],
-         pool.blk[:, ICOL_TIME_HI + 1:ICOLS]], axis=1)    # [P0, ICOLS]
-    vals = jnp.pad(vals, ((0, pad), (0, 0)))              # [npad, ICOLS]
+         pool.blk[:, ICOL_TIME_HI + 1:ic]], axis=1)       # [P0, ic]
+    vals = jnp.pad(vals, ((0, pad), (0, 0)))              # [npad, ic]
 
-    blk = ib.blk.at[islot].set(vals, mode="drop")
-    stage = ib.stage.at[islot].set(STAGE_IN_FLIGHT, mode="drop")
-    status = ib.status.at[islot].set(pad0(pool.status), mode="drop")
-    ib = ib.replace(blk=blk, stage=stage, status=status)
+    ib = ib.replace(
+        blk=ib.blk.at[islot].set(vals, mode="drop"),
+        stage=ib.stage.at[islot].set(STAGE_IN_FLIGHT, mode="drop"),
+        status=ib.status.at[islot].set(jnp.pad(pool.status, (0, pad)),
+                                       mode="drop")
+        if params.pds_trail else ib.status,
+    )
 
-    # Movers leave the outbox whether they fit or overflowed (an
-    # overflowed packet is a counted drop -- the fixed-capacity escape
-    # hatch, surfaced via ERR_POOL_OVERFLOW like slab exhaustion).
+    # Movers leave the outbox whether they fit or overflowed.  Shed pure
+    # ACKs are accounted as thinning; DATA/control overflow is a counted
+    # drop and raises the capacity escape-hatch flag.
     pool = pool.replace(stage=jnp.where(moving, STAGE_FREE, pool.stage))
-    drops = jnp.maximum(total - n_free, 0).astype(I64)
+    drops_all = jnp.maximum(total - n_free, 0).astype(I64)
+    data_drops = jnp.minimum(
+        drops_all, jnp.maximum(total_prot - n_free, 0).astype(I64))
+    acks_shed = drops_all - data_drops
     hosts = hosts.replace(
-        pkts_dropped_pool=hosts.pkts_dropped_pool + drops)
-    err = state.err | jnp.where(jnp.any(drops > 0), ERR_POOL_OVERFLOW,
+        pkts_dropped_pool=hosts.pkts_dropped_pool + data_drops,
+        acks_thinned=hosts.acks_thinned + acks_shed)
+    err = state.err | jnp.where(jnp.any(data_drops > 0), ERR_POOL_OVERFLOW,
                                 0).astype(state.err.dtype)
     state = state.replace(pool=pool, inbox=ib, hosts=hosts, err=err)
     if state.log is not None:
+        from .state import LOG_ACK_THIN
         rows = jnp.arange(h, dtype=I32)
         now_v = jnp.broadcast_to(state.now, (h,))
-        state = _log_append(state, drops > 0, LOG_DROP_POOL, LOG_WARNING,
-                            now_v, rows, drops)
+        state = _log_append(state, data_drops > 0, LOG_DROP_POOL,
+                            LOG_WARNING, now_v, rows, data_drops)
+        state = _log_append(state, acks_shed > 0, LOG_ACK_THIN,
+                            LOG_WARNING, now_v, rows, acks_shed)
     return state
 
 
@@ -464,10 +523,13 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
 
     st2 = jnp.where(due, STAGE_RX_QUEUED, st2)
     st2 = jnp.where(tail_drop, STAGE_FREE, st2)
-    status = jnp.where(due.reshape(-1),
-                       ib.status | PDS_ROUTER_ENQUEUED, ib.status)
-    status = jnp.where(tail_drop.reshape(-1),
-                       status | PDS_ROUTER_DROPPED, status)
+    if params.pds_trail:
+        status = jnp.where(due.reshape(-1),
+                           ib.status | PDS_ROUTER_ENQUEUED, ib.status)
+        status = jnp.where(tail_drop.reshape(-1),
+                           status | PDS_ROUTER_DROPPED, status)
+    else:
+        status = ib.status
     hosts = hosts.replace(
         pkts_dropped_router=hosts.pkts_dropped_router +
         jnp.sum(tail_drop, axis=1),
@@ -565,12 +627,13 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
         # Inbox slot release + status trail for everything dequeued.
         oh = (ids == col[:, None])
         st2 = jnp.where(oh & funded[:, None], STAGE_FREE, st2)
-        fm = (oh & (funded & drop)[:, None]).reshape(-1)
-        status = jnp.where(fm, status | PDS_ROUTER_ENQUEUED |
-                           PDS_ROUTER_DROPPED, status)
-        dm = (oh & deliver[:, None]).reshape(-1)
-        status = jnp.where(dm, status | PDS_ROUTER_ENQUEUED |
-                           PDS_RCV_SOCKET_PROCESSED, status)
+        if params.pds_trail:
+            fm = (oh & (funded & drop)[:, None]).reshape(-1)
+            status = jnp.where(fm, status | PDS_ROUTER_ENQUEUED |
+                               PDS_ROUTER_DROPPED, status)
+            dm = (oh & deliver[:, None]).reshape(-1)
+            status = jnp.where(dm, status | PDS_ROUTER_ENQUEUED |
+                               PDS_RCV_SOCKET_PROCESSED, status)
 
         hosts = hosts.replace(
             rx_queued=rx_q_now -
@@ -824,7 +887,8 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
         stage=jnp.where(hit, v[:, :, MCOL_STAGE],
                         pool.stage.reshape(h, ko)).reshape(-1),
         status=jnp.where(hit, v[:, :, MCOL_STATUS],
-                         pool.status.reshape(h, ko)).reshape(-1),
+                         pool.status.reshape(h, ko)).reshape(-1)
+        if params.pds_trail else pool.status,
         time=jnp.where(hit, dec_i64(v[:, :, ICOL_TIME_LO],
                                     v[:, :, ICOL_TIME_HI]),
                        pool.time.reshape(h, ko)).reshape(-1),
@@ -836,8 +900,8 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     # when the app never loops back).
     lb_placed = jnp.zeros_like(lb)
     if _may_loopback(app):
-        state, lb_placed = _loopback_insert(state, em, lb, src2, ctr2,
-                                            send_t)
+        state, lb_placed = _loopback_insert(state, params, em, lb, src2,
+                                            ctr2, send_t)
 
     all_placed = placed | lb_placed
     overflow = jnp.any(live & ~all_placed & ~lb) | jnp.any(lb & ~lb_placed)
@@ -884,7 +948,8 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     return state, all_placed
 
 
-def _loopback_insert(state: SimState, em, lb, src2, ctr2, send_t):
+def _loopback_insert(state: SimState, params, em, lb, src2, ctr2,
+                     send_t):
     """Insert loopback emissions into the sender's own inbox slab.
     Arrival = send + 1ns (reference network_interface.c:548-555)."""
     ib = state.inbox
@@ -906,20 +971,24 @@ def _loopback_insert(state: SimState, em, lb, src2, ctr2, send_t):
     def c(x):
         return x[:, :, None].astype(I32)
 
-    vals = jnp.concatenate([
+    ic = ib.blk.shape[1]          # ICOLS, or NCOLS_UDP for TCP-free worlds
+    pieces = [
         c(src2),
         em.blk[:, :, 1:ICOL_TIME_LO],
         c(enc_lo(arr)), c(enc_hi(arr)),
         c(enc_lo(ctr2)), c(enc_hi(ctr2)),
-        c(enc_lo(send_t)), c(enc_hi(send_t)),
-        em.blk[:, :, ICOL_TSE_LO:ICOLS],
-    ], axis=2).reshape(-1, ICOLS)
+    ]
+    if ic >= ICOLS:
+        pieces += [c(enc_lo(send_t)), c(enc_hi(send_t)),
+                   em.blk[:, :, ICOL_TSE_LO:ICOLS]]
+    vals = jnp.concatenate(pieces, axis=2).reshape(-1, ic)
 
     pds = PDS_SND_CREATED | PDS_SND_INTERFACE_SENT | PDS_INET_SENT
     ib = ib.replace(
         blk=ib.blk.at[islot].set(vals, mode="drop"),
         stage=ib.stage.at[islot].set(STAGE_IN_FLIGHT, mode="drop"),
-        status=ib.status.at[islot].set(pds, mode="drop"),
+        status=ib.status.at[islot].set(pds, mode="drop")
+        if params.pds_trail else ib.status,
     )
     return state.replace(inbox=ib), ok
 
@@ -984,7 +1053,7 @@ def _tx_drain(state: SimState, params, tick_t, active):
         time=jnp.where(chosen_dep, arr_b, pool.time),
         status=jnp.where(chosen_dep,
                          pool.status | PDS_SND_INTERFACE_SENT | PDS_INET_SENT,
-                         pool.status),
+                         pool.status) if params.pds_trail else pool.status,
     )
 
     hosts = hosts.replace(
@@ -1010,6 +1079,11 @@ def _microstep_core(state: SimState, params, app, t_h, window_end):
     from ..transport import tcp as tcp_mod
 
     h = state.hosts.num_hosts
+    if _uses_tcp(app) and state.inbox.blk.shape[1] < ICOLS:
+        raise ValueError(
+            "this world's inbox was built narrow (uses_tcp=False in "
+            "make_sim_state) but the app uses TCP; TCP segments need the "
+            "TS/SACK inbox columns")
     active = t_h < window_end
     tick_t = jnp.where(active, t_h, window_end)
 
@@ -1025,7 +1099,10 @@ def _microstep_core(state: SimState, params, app, t_h, window_end):
         n_lanes = emit.NUM_SLOTS + max(0, int(getattr(app, "rx_batch", 1))
                                        - 1)
     else:
-        n_lanes = emit.SLOT_APP + 1
+        # Pure-UDP apps may batch several sends per tick into extra lanes
+        # (app_tx_lanes), each stamped with its own t_send.
+        n_lanes = emit.SLOT_APP + max(1, int(getattr(app, "app_tx_lanes",
+                                                     1)))
     em = emit.empty(h, n_lanes)
 
     # Phase A: arrivals through the destination slab (router queue, NIC rx
@@ -1044,7 +1121,13 @@ def _microstep_core(state: SimState, params, app, t_h, window_end):
 
     # Phase C: application tick.
     if app is not None:
-        state, em = app.on_tick(state, params, em, t_post, active)
+        if getattr(app, "wants_window_end", False):
+            # The window bound lets the app pre-emit future sends that
+            # provably precede its next possible arrival (send batching).
+            state, em = app.on_tick(state, params, em, t_post, active,
+                                    window_end=window_end)
+        else:
+            state, em = app.on_tick(state, params, em, t_post, active)
 
     # Phase D: TCP transmission, merge staged emissions into the outbox
     # (direct-admit or park) or own inbox (loopback), then drain parked

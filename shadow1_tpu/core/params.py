@@ -83,6 +83,11 @@ class NetParams:
     # ranking costs an [H, slab, slab] comparison cube per micro-step, so
     # it must trace away entirely for the (default) unbounded case.
     has_iface_buf: bool = struct.field(pytree_node=False, default=False)
+    # STATIC: maintain the per-packet PDS_* delivery-status trail
+    # (reference packet.h:18-41).  Pure observability -- nothing consumes
+    # it programmatically -- and it costs a packed scatter per window plus
+    # masked updates in every micro-step, so it traces away by default.
+    pds_trail: bool = struct.field(pytree_node=False, default=False)
 
     @property
     def n_vertices(self) -> int:

@@ -211,6 +211,13 @@ def make_packet_pool(capacity: int) -> PacketPool:
  ICOL_SACK2_LO, ICOL_SACK2_HI) = range(24)
 ICOLS = 24
 
+# Narrow inbox width for worlds whose app never opens TCP sockets: the
+# TS/TSE/SACK columns (14..23) only feed the TCP machine, and the
+# window-boundary exchange's packed row scatter is the single most
+# expensive op per window (tools/exchprof.py) -- scattering 14 columns
+# instead of 24 cuts it ~40% for pure-UDP worlds (phold).
+NCOLS_UDP = ICOL_CTR_HI + 1
+
 # Outbox/emission extension columns: the packed OUTBOX block (and the
 # emission staging block) shares the inbox's first ICOLS columns exactly,
 # then appends the send-side-only fields.  One layout end to end means
@@ -285,7 +292,11 @@ class Inbox:
     loop, elementwise.
     """
 
-    blk: jnp.ndarray      # [P1, ICOLS] i32 packed fields (immutable per stay)
+    blk: jnp.ndarray      # [P1, C] i32 packed fields (immutable per stay;
+                          # C = ICOLS, or NCOLS_UDP for TCP-free worlds)
+    # stage/status stay SEPARATE [P1] arrays: packing them into a [P1,2]
+    # block made every hot-loop stage read a stride-2 load and cost ~25%
+    # of phold throughput for one saved per-window scatter (measured r5).
     stage: jnp.ndarray    # [P1] i32 STAGE_FREE / IN_FLIGHT / RX_QUEUED
     status: jnp.ndarray   # [P1] i32 PDS_* trail
 
@@ -305,10 +316,10 @@ class Inbox:
         return (src << 40) | ctr
 
 
-def make_inbox(num_hosts: int, slab: int) -> Inbox:
+def make_inbox(num_hosts: int, slab: int, cols: int = ICOLS) -> Inbox:
     p1 = num_hosts * slab
     return Inbox(
-        blk=_zeros((p1, ICOLS), I32),
+        blk=_zeros((p1, cols), I32),
         stage=_zeros((p1,), I32),
         status=_zeros((p1,), I32),
     )
@@ -536,9 +547,14 @@ class HostTable:
     pkts_recv: jnp.ndarray     # [H] i64
     pkts_dropped_inet: jnp.ndarray   # [H] i64 reliability drops
     pkts_dropped_router: jnp.ndarray  # [H] i64 CoDel/overflow drops
-    pkts_dropped_pool: jnp.ndarray   # [H] i64 slab-exhaustion drops (the
+    pkts_dropped_pool: jnp.ndarray   # [H] i64 slab-exhaustion drops of
+                                     # protocol-visible packets (the
                                      # fixed-capacity escape hatch; also
                                      # raises ERR_POOL_OVERFLOW)
+    acks_thinned: jnp.ndarray        # [H] i64 pure ACKs deliberately shed
+                                     # at exchange overflow (ACK-compression
+                                     # analog: cumulative ACKing absorbs
+                                     # them; NOT an error)
 
     @property
     def num_hosts(self) -> int:
@@ -570,6 +586,7 @@ def make_host_table(num_hosts: int) -> HostTable:
         pkts_dropped_inet=_zeros(h, I64),
         pkts_dropped_router=_zeros(h, I64),
         pkts_dropped_pool=_zeros(h, I64),
+        acks_thinned=_zeros(h, I64),
     )
 
 
@@ -647,6 +664,7 @@ LOG_DROP_TAIL = 3      # interface-buffer tail drop
 LOG_DROP_POOL = 4      # slab-capacity drop (capacity escape hatch)
 LOG_DELIVER = 5        # packet delivered to a socket
 LOG_SEND = 6           # packet placed on the wire
+LOG_ACK_THIN = 7       # pure ACKs shed at exchange overflow (not an error)
 
 
 @struct.dataclass
@@ -716,7 +734,8 @@ class SimState:
 
 def make_sim_state(num_hosts: int, sock_slots: int = 16,
                    pool_capacity: int = 1 << 15, app=None,
-                   inbox_capacity: int | None = None) -> SimState:
+                   inbox_capacity: int | None = None,
+                   uses_tcp: bool = True) -> SimState:
     # Both pools are partitioned into per-host slabs: the outbox by SOURCE
     # (engine._stage_emissions allocates from the emitting host's slab),
     # the inbox by DESTINATION (engine._exchange fills it at window
@@ -730,7 +749,8 @@ def make_sim_state(num_hosts: int, sock_slots: int = 16,
     return SimState(
         now=jnp.asarray(0, I64),
         pool=make_packet_pool(num_hosts * slab),
-        inbox=make_inbox(num_hosts, islab),
+        inbox=make_inbox(num_hosts, islab,
+                         cols=ICOLS if uses_tcp else NCOLS_UDP),
         socks=make_socket_table(num_hosts, sock_slots),
         hosts=make_host_table(num_hosts),
         app=app,

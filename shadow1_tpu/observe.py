@@ -209,6 +209,7 @@ _LOG_MSG = {
     4: "packet-pool capacity drop ({arg})",
     5: "delivered packet from host {arg}",
     6: "sent packet to host {arg}",
+    7: "thinned {arg} pure ACKs at exchange overflow",
 }
 
 

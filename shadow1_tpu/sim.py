@@ -59,7 +59,8 @@ def build_phold(num_hosts: int,
     params = _pkg.build_on_host(_build_params)
     def _build_state():
         state = make_sim_state(num_hosts, sock_slots=sock_slots,
-                               pool_capacity=pool_capacity)
+                               pool_capacity=pool_capacity,
+                               uses_tcp=False)
         return state.replace(
             socks=udp.open_bind_all(state.socks, slot=0,
                                     port=phold_app.PHOLD_PORT),
@@ -151,7 +152,8 @@ def build_gossip(num_hosts: int = 500,
             bw_down_Bps=jnp.full(num_hosts, bw_Bps),
             seed=seed, stop_time=stop_time)
         state = make_sim_state(num_hosts, sock_slots=2,
-                               pool_capacity=num_hosts * pool_slab)
+                               pool_capacity=num_hosts * pool_slab,
+                               uses_tcp=False)
         state = state.replace(
             socks=udp.open_bind_all(state.socks, slot=0,
                                     port=gossip_app.GOSSIP_PORT))

@@ -1,0 +1,92 @@
+"""ACK-before-data shedding at the window-boundary exchange.
+
+When a destination inbox slab overflows, pure ACKs are deliberately shed
+before any data/control packet (ACK-compression analog: cumulative
+ACKing absorbs the loss), are counted in hosts.acks_thinned, and do NOT
+raise ERR_POOL_OVERFLOW; data overflow still does (reference capacity
+escape hatch semantics, engine._exchange_body).
+"""
+
+import jax.numpy as jnp
+
+from shadow1_tpu import sim
+from shadow1_tpu.core import engine
+from shadow1_tpu.core.state import (ICOL_FLAGS, ICOL_LEN, ICOL_PROTO,
+                                    OCOL_DST, PROTO_TCP, STAGE_FREE,
+                                    STAGE_IN_FLIGHT, TCP_FLAG_ACK,
+                                    ERR_POOL_OVERFLOW, I32, I64)
+
+
+def _world():
+    # Tiny TCP world for (state, params); pool/inbox get hand-crafted.
+    state, params, app = sim.build_bulk(
+        num_hosts=2, server=0, bytes_per_client=1000,
+        stop_time=10**9, seed=1)
+    return state, params
+
+
+def _craft(state, n_data, n_acks, n_free):
+    """Host 1 has n_data data segments + n_acks pure ACKs in flight to
+    host 0 (src-major flat order: data first, then ACKs), and host 0's
+    inbox slab has exactly n_free free slots."""
+    pool = state.pool
+    h = state.hosts.num_hosts
+    ko = pool.capacity // h
+    assert n_data + n_acks <= ko, "crafted movers must fit host 1's slab"
+    base = 1 * ko  # host 1's slab
+    idx = jnp.arange(n_data + n_acks, dtype=I32) + base
+    is_ack = jnp.arange(n_data + n_acks) >= n_data
+    blk = pool.blk
+    blk = blk.at[idx, ICOL_PROTO].set(PROTO_TCP)
+    blk = blk.at[idx, ICOL_FLAGS].set(TCP_FLAG_ACK)
+    blk = blk.at[idx, ICOL_LEN].set(jnp.where(is_ack, 0, 100).astype(I32))
+    blk = blk.at[idx, OCOL_DST].set(0)
+    pool = pool.replace(
+        blk=blk,
+        stage=pool.stage.at[idx].set(STAGE_IN_FLIGHT),
+        time=pool.time.at[idx].set(jnp.asarray(1000, I64)),
+    )
+    # Occupy host 0's inbox slab except the first n_free slots (occupied =
+    # RX_QUEUED backlog; the exchange only uses STAGE_FREE slots).
+    ib = state.inbox
+    ki = ib.capacity // h
+    occupy = jnp.arange(n_free, ki, dtype=I32)
+    stage = ib.stage.at[occupy].set(3)  # STAGE_RX_QUEUED
+    return state.replace(pool=pool, inbox=ib.replace(stage=stage))
+
+
+def test_acks_shed_before_data_no_error():
+    state, params = _world()
+    n_data, n_acks = 6, 4               # 8 free: data fits, 2 ACKs shed
+    state = _craft(state, n_data, n_acks, n_free=8)
+    out = engine._exchange_body(state, params)
+    assert int(out.err) & ERR_POOL_OVERFLOW == 0
+    assert int(out.hosts.pkts_dropped_pool.sum()) == 0
+    assert int(out.hosts.acks_thinned.sum()) == 2
+    # every data segment made it into the inbox
+    ib = out.inbox
+    placed_data = int(((ib.stage == STAGE_IN_FLIGHT) &
+                       (ib.blk[:, ICOL_LEN] == 100)).sum())
+    assert placed_data == n_data
+    placed_acks = int(((ib.stage == STAGE_IN_FLIGHT) &
+                       (ib.blk[:, ICOL_LEN] == 0) &
+                       (ib.blk[:, ICOL_PROTO] == PROTO_TCP)).sum())
+    assert placed_acks == 2
+
+
+def test_data_overflow_still_raises():
+    state, params = _world()
+    state = _craft(state, 11, 2, n_free=8)   # data alone overflows by 3
+    out = engine._exchange_body(state, params)
+    assert int(out.err) & ERR_POOL_OVERFLOW
+    assert int(out.hosts.pkts_dropped_pool.sum()) == 3
+    assert int(out.hosts.acks_thinned.sum()) == 2
+
+
+def test_no_overflow_no_thinning():
+    state, params = _world()
+    state = _craft(state, 2, 2, n_free=8)
+    out = engine._exchange_body(state, params)
+    assert int(out.err) == 0
+    assert int(out.hosts.acks_thinned.sum()) == 0
+    assert int(out.hosts.pkts_dropped_pool.sum()) == 0

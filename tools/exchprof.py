@@ -1,0 +1,131 @@
+"""Component ablation of engine._exchange_body on the real chip.
+
+    python tools/exchprof.py [num_hosts]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import shadow1_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from shadow1_tpu import sim
+from shadow1_tpu.core import engine, simtime
+from shadow1_tpu.core.state import STAGE_FREE, STAGE_IN_FLIGHT, I32, I64
+
+NUM_HOSTS = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+
+
+def timeloop(name, state0, params, body):
+    res = {}
+    for iters in (20, 80):
+        def run(st):
+            def cond(c):
+                return c[0] < iters
+
+            def b(c):
+                i, s = c
+                s = body(s)
+                s = s.replace(now=s.now + 1)
+                return i + 1, s
+
+            return jax.lax.while_loop(cond, b, (jnp.asarray(0, I32), st))
+
+        jf = jax.jit(run)
+        out = jf(state0)
+        np.asarray(out[1].now)
+        ts = []
+        for trial in range(3):
+            st2 = state0.replace(now=state0.now + trial)
+            t0 = time.perf_counter()
+            out = jf(st2)
+            np.asarray(out[1].now)
+            ts.append(time.perf_counter() - t0)
+        res[iters] = min(ts)
+    slope = (res[80] - res[20]) / 60 * 1e3
+    print(f"{name:44s} {slope:8.3f} ms/iter", flush=True)
+    return slope
+
+
+def main():
+    state, params, app = sim.build_phold(
+        num_hosts=NUM_HOSTS, msgs_per_host=4,
+        mean_delay_ns=10 * simtime.SIMTIME_ONE_MILLISECOND,
+        stop_time=10 * simtime.SIMTIME_ONE_SECOND,
+        pool_capacity=NUM_HOSTS * 8)
+    state = engine.run_until(state, params, app,
+                             50 * simtime.SIMTIME_ONE_MILLISECOND)
+    jax.block_until_ready(state)
+
+    timeloop("exchange_body full", state, params,
+             lambda s: engine._exchange_body(s, params))
+
+    # Variant bodies copied from _exchange_body with parts disabled.
+    from shadow1_tpu.core.state import (ICOLS, ICOL_TIME_LO, ICOL_TIME_HI,
+                                        enc_lo, enc_hi)
+
+    def variant(s, *, do_rank=True, do_order=True, do_scatter=True):
+        pool, ib, hosts = s.pool, s.inbox, s.hosts
+        h = hosts.num_hosts
+        p0 = pool.capacity
+        p1 = ib.capacity
+        ki = p1 // h
+        moving = pool.stage == STAGE_IN_FLIGHT
+        dst = jnp.clip(pool.dst, 0, h - 1)
+        m = engine._superblock(p0, h)
+        npad = -(-p0 // m) * m
+        pad = npad - p0
+        dstp = jnp.pad(dst, (0, pad))
+        mvp = jnp.pad(moving, (0, pad))
+        if do_rank:
+            rank, total = engine._rank_by_dst(mvp, dstp, h, m)
+        else:
+            rank = jnp.zeros((npad,), I32)
+            total = jnp.zeros((h,), I32)
+        free2 = (ib.stage == STAGE_FREE).reshape(h, ki)
+        ids = jnp.arange(ki, dtype=I32)[None, :]
+        if do_order:
+            order2 = jnp.argsort(jnp.where(free2, ids, ids + ki),
+                                 axis=1).astype(I32)
+        else:
+            order2 = jnp.broadcast_to(ids, (h, ki)).astype(I32)
+        n_free = jnp.sum(free2, axis=1, dtype=I32)
+        within = order2.reshape(-1)[dstp * ki + jnp.clip(rank, 0, ki - 1)]
+        ok = mvp & (rank < n_free[dstp])
+        islot = jnp.where(ok, dstp * ki + within, p1)
+        ic = ib.blk.shape[1]
+        vals = jnp.concatenate(
+            [pool.blk[:, :ICOL_TIME_LO],
+             enc_lo(pool.time)[:, None], enc_hi(pool.time)[:, None],
+             pool.blk[:, ICOL_TIME_HI + 1:ic]], axis=1)
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+        if do_scatter:
+            ib = ib.replace(
+                blk=ib.blk.at[islot].set(vals, mode="drop"),
+                stage=ib.stage.at[islot].set(STAGE_IN_FLIGHT, mode="drop"),
+                status=ib.status.at[islot].set(
+                    jnp.pad(pool.status, (0, pad)), mode="drop"))
+        else:
+            # keep a data dependence on the whole islot/vals pipeline
+            ib = ib.replace(stage=ib.stage + (jnp.sum(islot) * 0) +
+                            (jnp.sum(vals[:, 0]) * 0))
+        pool = pool.replace(stage=jnp.where(moving, STAGE_FREE, pool.stage))
+        return s.replace(pool=pool, inbox=ib)
+
+    timeloop("variant full (sanity)", state, params,
+             lambda s: variant(s))
+    timeloop("no row-scatter", state, params,
+             lambda s: variant(s, do_scatter=False))
+    timeloop("no rank (hierarchy off)", state, params,
+             lambda s: variant(s, do_rank=False))
+    timeloop("no free-order argsort", state, params,
+             lambda s: variant(s, do_order=False))
+
+
+if __name__ == "__main__":
+    main()
