@@ -31,6 +31,8 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <poll.h>
+#include <pthread.h>
+#include <semaphore.h>
 #include <stdarg.h>
 #include <sys/epoll.h>
 #include <sys/timerfd.h>
@@ -172,6 +174,33 @@ static int is_vfd(int fd) {
   return fd >= VFD_BASE && fd < VFD_BASE + MAX_VFD && g_vfd_open[fd - VFD_BASE];
 }
 
+/* ---- cooperative virtual threads (the rpth analog) -------------------
+ *
+ * The reference runs real multi-threaded plugins by replacing libpthread
+ * with a cooperative userspace scheduler (src/external/rpth/
+ * pth_lib.c:98-146; ~90 pthread_* mappings in src/main/host/
+ * process.c:1084-1110).  Here the same guarantee -- exactly one plugin
+ * thread runs at a time, switching only at deterministic interposed
+ * points -- is enforced with a TOKEN over real OS threads: every thread
+ * parks on its own condvar until handed the token, blocking calls
+ * release it, and when ALL threads are blocked the token holder issues
+ * ONE union readiness RPC (the same OP_POLL the single-threaded shim
+ * uses), so the sequencer/bridge protocol is completely unchanged and
+ * the process still looks like one run-until-blocked unit.
+ *
+ * Determinism: switches happen only at interposed blocking points; the
+ * next thread is chosen round-robin by slot index; wakeups derive from
+ * the bridge's deterministic replies and the virtual clock.  A state
+ * where every thread waits on a mutex/cond/join (nothing external can
+ * ever fire) is a guaranteed deadlock and aborts with a diagnostic
+ * instead of hanging the sequencer. */
+#define VT_NO_DEADLINE ((int64_t)1 << 62)
+static int vt_multi(void);
+static void vt_wait_fd(int fd, short ev);
+static void vt_wait_sleep(int64_t wake_ns);
+static void vt_wait_poll(struct pollfd *fds, int nfds, int64_t wake_ns);
+static void vt_wait_tfd(int tfd_idx);
+
 /* One blocking round trip to the sequencer. */
 static int64_t rpc(req_t *rq, rep_t *rp) {
   if (g_seq_fd < 0) {
@@ -216,15 +245,33 @@ int socket(int domain, int type, int protocol) {
 int connect(int fd, const struct sockaddr *addr, socklen_t alen) {
   if (is_vfd(fd) && addr && addr->sa_family == AF_INET) {
     const struct sockaddr_in *a = (const struct sockaddr_in *)addr;
+    int user_nb = g_vfd_nonblock[fd - VFD_BASE];
     /* Nonblock flag rides above the 16-bit port in a1; a nonblocking
      * connect returns -1/EINPROGRESS and completes via poll. */
     req_t rq = {.op = OP_CONNECT, .fd = fd,
                 .a0 = (int64_t)ntohl(a->sin_addr.s_addr),
                 .a1 = (int64_t)ntohs(a->sin_port) |
-                      ((int64_t)(g_vfd_nonblock[fd - VFD_BASE] != 0) << 32),
+                      ((int64_t)(user_nb || vt_multi()) << 32),
                 .len = 0};
     rep_t rp;
-    return (int)rpc(&rq, &rp);
+    int r = (int)rpc(&rq, &rp);
+    if (user_nb || !vt_multi() || r == 0 || errno != EINPROGRESS)
+      return r;
+    /* Blocking connect under the thread gate: complete via readiness
+     * like a poll(POLLOUT) caller would. */
+    for (;;) {
+      vt_wait_fd(fd, POLLOUT);
+      struct pollfd pf = {.fd = fd, .events = POLLOUT, .revents = 0};
+      if (poll(&pf, 1, 0) > 0) {
+        if (pf.revents & POLLERR) {
+          int soerr = g_vfd_soerr[fd - VFD_BASE];
+          g_vfd_soerr[fd - VFD_BASE] = 0;
+          errno = soerr ? soerr : ECONNREFUSED;
+          return -1;
+        }
+        if (pf.revents & POLLOUT) return 0;
+      }
+    }
   }
   static int (*real_connect)(int, const struct sockaddr *, socklen_t);
   if (!real_connect) real_connect = dlsym(RTLD_NEXT, "connect");
@@ -258,10 +305,18 @@ int listen(int fd, int backlog) {
 
 int accept(int fd, struct sockaddr *addr, socklen_t *alen) {
   if (is_vfd(fd)) {
-    req_t rq = {.op = OP_ACCEPT, .fd = fd,
-                .a0 = g_vfd_nonblock[fd - VFD_BASE], .len = 0};
+    int user_nb = g_vfd_nonblock[fd - VFD_BASE];
     rep_t rp;
-    int64_t r = rpc(&rq, &rp);
+    int64_t r;
+    for (;;) {
+      req_t rq = {.op = OP_ACCEPT, .fd = fd,
+                  .a0 = user_nb || vt_multi(), .len = 0};
+      r = rpc(&rq, &rp);
+      if (r >= 0 || user_nb || !vt_multi() ||
+          (errno != EAGAIN && errno != EWOULDBLOCK))
+        break;
+      vt_wait_fd(fd, POLLIN);
+    }
     if (r >= VFD_BASE && r < VFD_BASE + MAX_VFD) {
       g_vfd_open[r - VFD_BASE] = 1;
       if (addr && alen && *alen >= sizeof(struct sockaddr_in)) {
@@ -281,23 +336,39 @@ int accept(int fd, struct sockaddr *addr, socklen_t *alen) {
 
 static ssize_t vsend(int fd, const void *buf, size_t n, int flags) {
   size_t chunk = n > MAX_DATA ? MAX_DATA : n;
-  req_t rq = {.op = OP_SEND, .fd = fd, .a0 = (int64_t)flags,
-              .a1 = g_vfd_nonblock[fd - VFD_BASE],
-              .len = (uint32_t)chunk};
-  memcpy(rq.data, buf, chunk);
-  rep_t rp;
-  return (ssize_t)rpc(&rq, &rp);
+  int user_nb = g_vfd_nonblock[fd - VFD_BASE];
+  for (;;) {
+    /* Under the thread gate every op probes nonblocking; a would-block
+     * on a BLOCKING socket hands the token off and retries. */
+    req_t rq = {.op = OP_SEND, .fd = fd, .a0 = (int64_t)flags,
+                .a1 = user_nb || vt_multi(),
+                .len = (uint32_t)chunk};
+    memcpy(rq.data, buf, chunk);
+    rep_t rp;
+    ssize_t r = (ssize_t)rpc(&rq, &rp);
+    if (r >= 0 || user_nb || !vt_multi() ||
+        (errno != EAGAIN && errno != EWOULDBLOCK))
+      return r;
+    vt_wait_fd(fd, POLLOUT);
+  }
 }
 
 static ssize_t vrecv(int fd, void *buf, size_t n, int flags) {
   size_t chunk = n > MAX_DATA ? MAX_DATA : n;
-  req_t rq = {.op = OP_RECV, .fd = fd, .a0 = (int64_t)chunk,
-              .a1 = (int64_t)flags | (g_vfd_nonblock[fd - VFD_BASE] ? (1 << 30) : 0),
-              .len = 0};
-  rep_t rp;
-  int64_t r = rpc(&rq, &rp);
-  if (r > 0) memcpy(buf, rp.data, (size_t)r);
-  return (ssize_t)r;
+  int user_nb = g_vfd_nonblock[fd - VFD_BASE];
+  for (;;) {
+    req_t rq = {.op = OP_RECV, .fd = fd, .a0 = (int64_t)chunk,
+                .a1 = (int64_t)flags |
+                      ((user_nb || vt_multi()) ? (1 << 30) : 0),
+                .len = 0};
+    rep_t rp;
+    int64_t r = rpc(&rq, &rp);
+    if (r > 0) memcpy(buf, rp.data, (size_t)r);
+    if (r >= 0 || user_nb || !vt_multi() ||
+        (errno != EAGAIN && errno != EWOULDBLOCK))
+      return (ssize_t)r;
+    vt_wait_fd(fd, POLLIN);
+  }
 }
 
 ssize_t send(int fd, const void *buf, size_t n, int flags) {
@@ -314,14 +385,21 @@ ssize_t sendto(int fd, const void *buf, size_t n, int flags,
       return vsend(fd, buf, n, flags);  /* connected-style send */
     const struct sockaddr_in *a = (const struct sockaddr_in *)addr;
     size_t chunk = n > MAX_DATA ? MAX_DATA : n;
-    req_t rq = {.op = OP_SENDTO, .fd = fd,
-                .a0 = (int64_t)ntohl(a->sin_addr.s_addr),
-                .a1 = (int64_t)ntohs(a->sin_port) |
-                      ((int64_t)(g_vfd_nonblock[fd - VFD_BASE] != 0) << 32),
-                .len = (uint32_t)chunk};
-    memcpy(rq.data, buf, chunk);
-    rep_t rp;
-    return (ssize_t)rpc(&rq, &rp);
+    int user_nb = g_vfd_nonblock[fd - VFD_BASE];
+    for (;;) {
+      req_t rq = {.op = OP_SENDTO, .fd = fd,
+                  .a0 = (int64_t)ntohl(a->sin_addr.s_addr),
+                  .a1 = (int64_t)ntohs(a->sin_port) |
+                        ((int64_t)(user_nb || vt_multi()) << 32),
+                  .len = (uint32_t)chunk};
+      memcpy(rq.data, buf, chunk);
+      rep_t rp;
+      ssize_t r = (ssize_t)rpc(&rq, &rp);
+      if (r >= 0 || user_nb || !vt_multi() ||
+          (errno != EAGAIN && errno != EWOULDBLOCK))
+        return r;
+      vt_wait_fd(fd, POLLOUT);
+    }
   }
   static ssize_t (*real_sendto)(int, const void *, size_t, int,
                                 const struct sockaddr *, socklen_t);
@@ -334,12 +412,20 @@ ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
                  struct sockaddr *addr, socklen_t *alen) {
   if (is_vfd(fd)) {
     size_t chunk = n > MAX_DATA - 8 ? MAX_DATA - 8 : n;
-    req_t rq = {.op = OP_RECVFROM, .fd = fd, .a0 = (int64_t)chunk,
-                .a1 = (int64_t)flags |
-                      (g_vfd_nonblock[fd - VFD_BASE] ? (1 << 30) : 0),
-                .len = 0};
+    int user_nb = g_vfd_nonblock[fd - VFD_BASE];
     rep_t rp;
-    int64_t r = rpc(&rq, &rp);
+    int64_t r;
+    for (;;) {
+      req_t rq = {.op = OP_RECVFROM, .fd = fd, .a0 = (int64_t)chunk,
+                  .a1 = (int64_t)flags |
+                        ((user_nb || vt_multi()) ? (1 << 30) : 0),
+                  .len = 0};
+      r = rpc(&rq, &rp);
+      if (r >= 0 || user_nb || !vt_multi() ||
+          (errno != EAGAIN && errno != EWOULDBLOCK))
+        break;
+      vt_wait_fd(fd, POLLIN);
+    }
     if (r < 0) return (ssize_t)r;
     uint32_t ip = 0, port = 0;
     if (rp.len >= 8) {
@@ -470,6 +556,24 @@ static int tfd_fill(struct pollfd *fds, nfds_t nfds, int64_t now) {
 }
 
 int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
+  if (vt_multi() && g_seq_fd >= 0 && timeout != 0 &&
+      nfds <= MAX_DATA / 8) {
+    /* Thread-gate mode: probe with timeout 0 (the normal body below,
+     * which handles vfd/timerfd/real mixes), hand the token off while
+     * not ready.  The union park watches this thread's whole entry set
+     * plus the earliest timerfd expiry / caller deadline. */
+    int64_t caller_dl = VT_NO_DEADLINE;
+    if (timeout > 0) caller_dl = vnow() + (int64_t)timeout * 1000000LL;
+    for (;;) {
+      int r = poll(fds, nfds, 0);
+      if (r != 0) return r;
+      if (caller_dl != VT_NO_DEADLINE && vnow() >= caller_dl) return 0;
+      /* Record only the CALLER's deadline; the union park folds the
+       * watched timerfds' live expiries itself (so a sibling re-arming
+       * a timer while we are parked retimes the wait). */
+      vt_wait_poll(fds, (int)nfds, caller_dl);
+    }
+  }
   int any_v = 0, any_t = 0;
   int64_t next_exp = (int64_t)1 << 62;
   for (nfds_t i = 0; i < nfds; i++) {
@@ -666,6 +770,12 @@ static ssize_t tfd_read(int fd, void *buf, size_t n) {
     if (t->nonblock) {
       errno = EAGAIN;
       return -1;
+    }
+    if (vt_multi()) {
+      /* WK_TFD: the union park reads the CURRENT expiry from g_tfd, so
+       * a sibling thread re-arming the timer retimes this wait. */
+      vt_wait_tfd(fd - TFD_BASE);
+      continue;
     }
     int64_t wait_ns = t->expiry_ns == 0 ? (int64_t)1 << 62
                                         : t->expiry_ns - now;
@@ -943,9 +1053,14 @@ time_t time(time_t *out) {
 
 int nanosleep(const struct timespec *req, struct timespec *rem) {
   if (g_seq_fd >= 0 && req) {
-    req_t rq = {.op = OP_SLEEP, .fd = -1,
-                .a0 = (int64_t)req->tv_sec * 1000000000LL + req->tv_nsec,
-                .len = 0};
+    int64_t dur = (int64_t)req->tv_sec * 1000000000LL + req->tv_nsec;
+    if (vt_multi()) {
+      int64_t tgt = vnow() + dur;
+      while (vnow() < tgt) vt_wait_sleep(tgt);
+      if (rem) rem->tv_sec = rem->tv_nsec = 0;
+      return 0;
+    }
+    req_t rq = {.op = OP_SLEEP, .fd = -1, .a0 = dur, .len = 0};
     rep_t rp;
     rpc(&rq, &rp);
     if (rem) rem->tv_sec = rem->tv_nsec = 0;
@@ -962,5 +1077,883 @@ int usleep(useconds_t us) {
 unsigned int sleep(unsigned int sec) {
   struct timespec ts = {sec, 0};
   nanosleep(&ts, NULL);
+  return 0;
+}
+
+/* ================= cooperative virtual threads ========================= */
+
+#define MAX_VT 32
+#define MAX_VMX 256
+#define MAX_VPOLL_ENT (MAX_DATA / 8)
+
+enum { WK_RUN = 0, WK_FD, WK_POLL, WK_SLEEP, WK_MUTEX, WK_COND,
+       WK_JOIN, WK_TFD, WK_SEM };
+
+typedef struct {
+  int used, finished, detached;
+  int kind;                  /* WK_* */
+  int wfd;                   /* WK_FD */
+  short wev;
+  struct pollfd *pfds;       /* WK_POLL (caller stack; stable while blocked) */
+  int pnfds;
+  int64_t wake_ns;           /* WK_SLEEP/WK_POLL/WK_COND-timed deadline */
+  void *waddr;               /* WK_MUTEX: mutex; WK_COND: cond;
+                              * WK_JOIN: target slot as intptr */
+  void *(*fn)(void *);
+  void *arg;
+  void *ret;
+  pthread_t os;
+  pthread_cond_t cv;
+} vt_t;
+
+static vt_t g_vt[MAX_VT];
+static volatile int g_vt_on = 0;
+static volatile int g_vt_n = 0;     /* live (unfinished) threads */
+static int g_vt_cur = 0;            /* token holder slot */
+static pthread_mutex_t g_vt_mx = PTHREAD_MUTEX_INITIALIZER;
+static __thread int t_self = 0;
+
+/* Real pthread entry points (we interpose the plugin-facing ones). */
+static int (*real_pt_create)(pthread_t *, const pthread_attr_t *,
+                             void *(*)(void *), void *);
+static int (*real_pt_join)(pthread_t, void **);
+static int (*real_mxl)(pthread_mutex_t *);
+static int (*real_mxu)(pthread_mutex_t *);
+static int (*real_mxt)(pthread_mutex_t *);
+static int (*real_cw)(pthread_cond_t *, pthread_mutex_t *);
+static int (*real_cs)(pthread_cond_t *);
+static int (*real_cb)(pthread_cond_t *);
+
+static void vt_resolve_reals(void) {
+  if (real_pt_create) return;
+  real_pt_create = dlsym(RTLD_NEXT, "pthread_create");
+  real_pt_join = dlsym(RTLD_NEXT, "pthread_join");
+  real_mxl = dlsym(RTLD_NEXT, "pthread_mutex_lock");
+  real_mxu = dlsym(RTLD_NEXT, "pthread_mutex_unlock");
+  real_mxt = dlsym(RTLD_NEXT, "pthread_mutex_trylock");
+  real_cw = dlsym(RTLD_NEXT, "pthread_cond_wait");
+  real_cs = dlsym(RTLD_NEXT, "pthread_cond_signal");
+  real_cb = dlsym(RTLD_NEXT, "pthread_cond_broadcast");
+}
+
+static int vt_multi(void) { return g_vt_on && g_vt_n > 1; }
+
+/* Virtual mutexes: keyed by address; the gate serializes execution, so a
+ * table entry is pure bookkeeping (owner slot + recursion count).  Once a
+ * process is managed, plugin mutexes are ALWAYS virtual -- mixing real
+ * and virtual locking across the first pthread_create would break mutual
+ * exclusion for a mutex held at engagement time. */
+typedef struct { void *addr; int owner; int count; } vmx_t;
+static vmx_t g_vmx[MAX_VMX];
+
+static vmx_t *vmx_get(void *addr) {
+  int free_i = -1;
+  for (int i = 0; i < MAX_VMX; i++) {
+    if (g_vmx[i].addr == addr) return &g_vmx[i];
+    if (!g_vmx[i].addr && free_i < 0) free_i = i;
+  }
+  if (free_i < 0) {
+    fprintf(stderr, "shadow1_shim: virtual-mutex table full (%d)\n",
+            MAX_VMX);
+    _exit(121);
+  }
+  g_vmx[free_i].addr = addr;
+  g_vmx[free_i].owner = -1;
+  g_vmx[free_i].count = 0;
+  return &g_vmx[free_i];
+}
+
+static int vt_next_runnable(int from) {
+  for (int k = 1; k <= MAX_VT; k++) {
+    int i = (from + k) % MAX_VT;
+    if (g_vt[i].used && !g_vt[i].finished && g_vt[i].kind == WK_RUN)
+      return i;
+  }
+  return -1;
+}
+
+/* All threads blocked: one union readiness RPC in the token holder.
+ * Called with g_vt_mx held. */
+static void vt_union_park(void) {
+  req_t rq = {.op = OP_POLL, .fd = -1, .len = 0};
+  int32_t *w = (int32_t *)rq.data;
+  int map_t[MAX_VPOLL_ENT];
+  int nw = 0;
+  int64_t min_deadline = VT_NO_DEADLINE;
+  int n_blocked = 0, n_sync = 0;
+  for (int i = 0; i < MAX_VT; i++) {
+    vt_t *t = &g_vt[i];
+    if (!t->used || t->finished) continue;
+    n_blocked++;
+    switch (t->kind) {
+      case WK_FD:
+        if (nw < MAX_VPOLL_ENT) {
+          w[2 * nw] = t->wfd;
+          w[2 * nw + 1] = t->wev;
+          map_t[nw++] = i;
+        }
+        break;
+      case WK_POLL:
+        for (int j = 0; j < t->pnfds && nw < MAX_VPOLL_ENT; j++) {
+          int fd = t->pfds[j].fd;
+          if (fd >= VFD_BASE && fd < VFD_BASE + MAX_VFD) {
+            w[2 * nw] = fd;
+            w[2 * nw + 1] = t->pfds[j].events;
+            map_t[nw++] = i;
+          } else if (is_tfd(fd) && (t->pfds[j].events & POLLIN)) {
+            tfd_t *tf = &g_tfd[fd - TFD_BASE];
+            if (tf->expiry_ns != 0 && tf->expiry_ns < min_deadline)
+              min_deadline = tf->expiry_ns;
+          }
+        }
+        if (t->wake_ns < min_deadline) min_deadline = t->wake_ns;
+        break;
+      case WK_TFD: {
+        tfd_t *tf = &g_tfd[t->wfd];
+        if (tf->expiry_ns != 0 && tf->expiry_ns < min_deadline)
+          min_deadline = tf->expiry_ns;
+        break;
+      }
+      case WK_SLEEP:
+        if (t->wake_ns < min_deadline) min_deadline = t->wake_ns;
+        break;
+      case WK_COND:
+        if (t->wake_ns && t->wake_ns < min_deadline)
+          min_deadline = t->wake_ns;  /* timedwait */
+        n_sync++;
+        break;
+      default:
+        n_sync++;  /* WK_MUTEX / WK_JOIN: woken only by peers */
+    }
+  }
+  if (nw == 0 && min_deadline == VT_NO_DEADLINE) {
+    fprintf(stderr,
+            "shadow1_shim: DEADLOCK: all %d plugin threads blocked on "
+            "mutex/cond/join with nothing external to wake them\n",
+            n_blocked);
+    _exit(121);
+  }
+  int64_t now = vnow();
+  rep_t rp;
+  if (nw == 0) {
+    req_t sq = {.op = OP_SLEEP, .fd = -1,
+                .a0 = min_deadline - now > 0 ? min_deadline - now : 1,
+                .len = 0};
+    rpc(&sq, &rp);
+  } else {
+    int64_t tmo_ms = -1;
+    if (min_deadline != VT_NO_DEADLINE) {
+      tmo_ms = (min_deadline - now + 999999) / 1000000;
+      if (tmo_ms < 1) tmo_ms = 1;
+      if (tmo_ms > 0x7FFFFFFF) tmo_ms = 0x7FFFFFFF;
+    }
+    rq.a0 = tmo_ms;
+    rq.len = (uint32_t)(nw * 8);
+    int64_t r = rpc(&rq, &rp);
+    if (r >= 0) {
+      const int32_t *rv = (const int32_t *)rp.data;
+      for (int k = 0; k < nw; k++) {
+        int fd = w[2 * k];
+        int soerr = rv[2 * k + 1];
+        if (soerr && fd >= VFD_BASE && fd < VFD_BASE + MAX_VFD)
+          g_vfd_soerr[fd - VFD_BASE] = soerr;
+        if (rv[2 * k] != 0) g_vt[map_t[k]].kind = WK_RUN;
+      }
+    }
+  }
+  now = vnow();
+  for (int i = 0; i < MAX_VT; i++) {
+    vt_t *t = &g_vt[i];
+    if (!t->used || t->finished) continue;
+    if ((t->kind == WK_SLEEP || t->kind == WK_POLL ||
+         (t->kind == WK_COND && t->wake_ns)) &&
+        t->wake_ns != VT_NO_DEADLINE && t->wake_ns <= now)
+      t->kind = WK_RUN;
+    if (t->kind == WK_TFD) {
+      tfd_t *tf = &g_tfd[t->wfd];
+      if (tf->expiry_ns != 0 && tf->expiry_ns <= now) t->kind = WK_RUN;
+    }
+    if (t->kind == WK_POLL)
+      for (int j = 0; j < t->pnfds; j++)
+        if (is_tfd(t->pfds[j].fd) && (t->pfds[j].events & POLLIN)) {
+          tfd_t *tf = &g_tfd[t->pfds[j].fd - TFD_BASE];
+          if (tf->expiry_ns != 0 && tf->expiry_ns <= now)
+            t->kind = WK_RUN;
+        }
+  }
+}
+
+/* Block the calling thread until its wait is satisfied.  The caller has
+ * already recorded its wait kind/payload; g_vt_mx is held on entry and
+ * on exit.  The token is handed round-robin; when nobody is runnable
+ * the holder runs the union park. */
+static void vt_block_locked(void) {
+  for (;;) {
+    if (g_vt[t_self].kind == WK_RUN) return;
+    int nxt = vt_next_runnable(t_self);
+    if (nxt >= 0) {
+      g_vt_cur = nxt;
+      real_cs(&g_vt[nxt].cv);
+      while (g_vt_cur != t_self)
+        real_cw(&g_vt[t_self].cv, &g_vt_mx);
+    } else {
+      vt_union_park();
+    }
+  }
+}
+
+static void vt_wait_fd(int fd, short ev) {
+  vt_resolve_reals();
+  real_mxl(&g_vt_mx);
+  vt_t *t = &g_vt[t_self];
+  t->kind = WK_FD;
+  t->wfd = fd;
+  t->wev = ev;
+  t->wake_ns = VT_NO_DEADLINE;
+  vt_block_locked();
+  real_mxu(&g_vt_mx);
+}
+
+static void vt_wait_sleep(int64_t wake_ns) {
+  vt_resolve_reals();
+  real_mxl(&g_vt_mx);
+  vt_t *t = &g_vt[t_self];
+  t->kind = WK_SLEEP;
+  t->wake_ns = wake_ns;
+  vt_block_locked();
+  real_mxu(&g_vt_mx);
+}
+
+static void vt_wait_poll(struct pollfd *fds, int nfds, int64_t wake_ns) {
+  vt_resolve_reals();
+  real_mxl(&g_vt_mx);
+  vt_t *t = &g_vt[t_self];
+  t->kind = WK_POLL;
+  t->pfds = fds;
+  t->pnfds = nfds;
+  t->wake_ns = wake_ns;
+  vt_block_locked();
+  real_mxu(&g_vt_mx);
+}
+
+static void vt_wait_tfd(int tfd_idx) {
+  vt_resolve_reals();
+  real_mxl(&g_vt_mx);
+  vt_t *t = &g_vt[t_self];
+  t->kind = WK_TFD;
+  t->wfd = tfd_idx;
+  t->wake_ns = VT_NO_DEADLINE;
+  vt_block_locked();
+  real_mxu(&g_vt_mx);
+}
+
+/* Thread exit: wake joiners, hand the token on (running the union park
+ * ourselves if everyone else is blocked -- we are the token holder). */
+static void vt_exit_self(void *ret) {
+  real_mxl(&g_vt_mx);
+  vt_t *t = &g_vt[t_self];
+  t->ret = ret;
+  t->finished = 1;
+  g_vt_n--;
+  if (t->detached) t->used = 0;  /* slot reusable; OS thread self-reaps
+                                  * (pthread_detach real-detached it) */
+  for (int i = 0; i < MAX_VT; i++)
+    if (g_vt[i].used && !g_vt[i].finished && g_vt[i].kind == WK_JOIN &&
+        (intptr_t)g_vt[i].waddr == t_self)
+      g_vt[i].kind = WK_RUN;
+  for (;;) {
+    int nxt = vt_next_runnable(t_self);
+    if (nxt >= 0) {
+      g_vt_cur = nxt;
+      real_cs(&g_vt[nxt].cv);
+      break;
+    }
+    if (g_vt_n == 0) break;        /* nobody left to run */
+    vt_union_park();
+  }
+  real_mxu(&g_vt_mx);
+}
+
+static void *vt_tramp(void *vp) {
+  vt_t *t = (vt_t *)vp;
+  t_self = (int)(t - g_vt);
+  real_mxl(&g_vt_mx);
+  while (g_vt_cur != t_self)
+    real_cw(&t->cv, &g_vt_mx);
+  real_mxu(&g_vt_mx);
+  void *ret = t->fn(t->arg);
+  vt_exit_self(ret);
+  return ret;
+}
+
+int pthread_create(pthread_t *tid, const pthread_attr_t *attr,
+                   void *(*fn)(void *), void *arg) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) return real_pt_create(tid, attr, fn, arg);
+  real_mxl(&g_vt_mx);
+  if (!g_vt_on) {
+    /* Engage the gate: the calling (main) thread takes slot 0. */
+    memset(&g_vt[0], 0, sizeof(g_vt[0]));
+    g_vt[0].used = 1;
+    g_vt[0].kind = WK_RUN;
+    g_vt[0].os = pthread_self();
+    pthread_cond_init(&g_vt[0].cv, NULL);
+    g_vt_cur = 0;
+    g_vt_n = 1;
+    g_vt_on = 1;
+  }
+  int i;
+  for (i = 1; i < MAX_VT; i++)
+    if (!g_vt[i].used) break;
+  if (i >= MAX_VT) {
+    real_mxu(&g_vt_mx);
+    fprintf(stderr, "shadow1_shim: pthread_create: thread table full "
+                    "(%d)\n", MAX_VT);
+    return EAGAIN;
+  }
+  vt_t *t = &g_vt[i];
+  memset(t, 0, sizeof(*t));
+  t->used = 1;
+  t->kind = WK_RUN;
+  t->fn = fn;
+  t->arg = arg;
+  pthread_cond_init(&t->cv, NULL);
+  g_vt_n++;
+  real_mxu(&g_vt_mx);
+  int r = real_pt_create(&t->os, attr, vt_tramp, t);
+  if (r != 0) {
+    real_mxl(&g_vt_mx);
+    t->used = 0;
+    g_vt_n--;
+    real_mxu(&g_vt_mx);
+    return r;
+  }
+  if (tid) *tid = t->os;
+  return 0;
+}
+
+static int vt_find(pthread_t tid) {
+  for (int i = 0; i < MAX_VT; i++)
+    if (g_vt[i].used && pthread_equal(g_vt[i].os, tid)) return i;
+  return -1;
+}
+
+int pthread_join(pthread_t tid, void **ret) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0 || !g_vt_on) return real_pt_join(tid, ret);
+  real_mxl(&g_vt_mx);
+  int i = vt_find(tid);
+  if (i < 0) {
+    real_mxu(&g_vt_mx);
+    return real_pt_join(tid, ret);
+  }
+  while (!g_vt[i].finished) {
+    g_vt[t_self].kind = WK_JOIN;
+    g_vt[t_self].waddr = (void *)(intptr_t)i;
+    vt_block_locked();
+  }
+  if (ret) *ret = g_vt[i].ret;
+  pthread_t os = g_vt[i].os;
+  g_vt[i].used = 0;
+  real_mxu(&g_vt_mx);
+  real_pt_join(os, NULL);  /* reap the finished OS thread */
+  return 0;
+}
+
+int pthread_detach(pthread_t tid) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0 || !g_vt_on) {
+    static int (*real_det)(pthread_t);
+    if (!real_det) real_det = dlsym(RTLD_NEXT, "pthread_detach");
+    return real_det(tid);
+  }
+  static int (*real_det2)(pthread_t);
+  if (!real_det2) real_det2 = dlsym(RTLD_NEXT, "pthread_detach");
+  real_mxl(&g_vt_mx);
+  int i = vt_find(tid);
+  if (i >= 0) {
+    g_vt[i].detached = 1;
+    if (g_vt[i].finished) g_vt[i].used = 0;
+  }
+  real_mxu(&g_vt_mx);
+  real_det2(tid);  /* the OS thread self-reaps on termination */
+  return 0;
+}
+
+int pthread_mutex_lock(pthread_mutex_t *m) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) return real_mxl(m);
+  real_mxl(&g_vt_mx);
+  vmx_t *v = vmx_get(m);
+  for (;;) {
+    if (v->owner < 0 || v->owner == t_self) {
+      v->owner = t_self;
+      v->count++;
+      break;
+    }
+    g_vt[t_self].kind = WK_MUTEX;
+    g_vt[t_self].waddr = m;
+    vt_block_locked();
+  }
+  real_mxu(&g_vt_mx);
+  return 0;
+}
+
+int pthread_mutex_trylock(pthread_mutex_t *m) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) return real_mxt(m);
+  real_mxl(&g_vt_mx);
+  vmx_t *v = vmx_get(m);
+  int r = 0;
+  if (v->owner < 0 || v->owner == t_self) {
+    v->owner = t_self;
+    v->count++;
+  } else {
+    r = EBUSY;
+  }
+  real_mxu(&g_vt_mx);
+  return r;
+}
+
+static void vmx_release(vmx_t *v) {
+  v->owner = -1;
+  v->count = 0;
+  /* wake the first waiter in slot order (deterministic) */
+  for (int i = 0; i < MAX_VT; i++)
+    if (g_vt[i].used && !g_vt[i].finished && g_vt[i].kind == WK_MUTEX &&
+        g_vt[i].waddr == v->addr) {
+      g_vt[i].kind = WK_RUN;
+      break;
+    }
+}
+
+int pthread_mutex_unlock(pthread_mutex_t *m) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) return real_mxu(m);
+  real_mxl(&g_vt_mx);
+  vmx_t *v = vmx_get(m);
+  if (v->owner == t_self) {
+    if (--v->count <= 0) vmx_release(v);
+  }
+  real_mxu(&g_vt_mx);
+  return 0;
+}
+
+int pthread_mutex_destroy(pthread_mutex_t *m) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) {
+    static int (*real_des)(pthread_mutex_t *);
+    if (!real_des) real_des = dlsym(RTLD_NEXT, "pthread_mutex_destroy");
+    return real_des(m);
+  }
+  real_mxl(&g_vt_mx);
+  for (int i = 0; i < MAX_VMX; i++)
+    if (g_vmx[i].addr == (void *)m) {
+      g_vmx[i].addr = NULL;
+      break;
+    }
+  real_mxu(&g_vt_mx);
+  return 0;
+}
+
+static int vt_cond_wait_common(pthread_cond_t *c, pthread_mutex_t *m,
+                               int64_t deadline_ns) {
+  real_mxl(&g_vt_mx);
+  if (!g_vt_on || g_vt_n <= 1) {
+    if (deadline_ns == 0) {
+      fprintf(stderr, "shadow1_shim: DEADLOCK: pthread_cond_wait with no "
+                      "other thread to signal\n");
+      _exit(121);
+    }
+    /* Timed wait, single thread: pure virtual sleep to the deadline. */
+    real_mxu(&g_vt_mx);
+    int64_t now = vnow();
+    if (deadline_ns > now) {
+      struct timespec ts = {.tv_sec = (deadline_ns - now) / 1000000000LL,
+                            .tv_nsec = (deadline_ns - now) % 1000000000LL};
+      nanosleep(&ts, NULL);
+    }
+    return ETIMEDOUT;
+  }
+  vmx_t *v = vmx_get(m);
+  int saved = v->count;
+  if (v->owner == t_self) vmx_release(v);
+  vt_t *t = &g_vt[t_self];
+  t->kind = WK_COND;
+  t->waddr = c;
+  t->wake_ns = deadline_ns;  /* 0 = untimed */
+  vt_block_locked();
+  int timed_out = deadline_ns != 0 && vnow() >= deadline_ns &&
+                  t->waddr != NULL;  /* waddr cleared by signal */
+  /* re-acquire the mutex */
+  for (;;) {
+    if (v->owner < 0) {
+      v->owner = t_self;
+      v->count = saved > 0 ? saved : 1;
+      break;
+    }
+    t->kind = WK_MUTEX;
+    t->waddr = m;
+    vt_block_locked();
+  }
+  real_mxu(&g_vt_mx);
+  return timed_out ? ETIMEDOUT : 0;
+}
+
+int pthread_cond_wait(pthread_cond_t *c, pthread_mutex_t *m) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) return real_cw(c, m);
+  return vt_cond_wait_common(c, m, 0);
+}
+
+int pthread_cond_timedwait(pthread_cond_t *c, pthread_mutex_t *m,
+                           const struct timespec *abs) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) {
+    static int (*real_ctw)(pthread_cond_t *, pthread_mutex_t *,
+                           const struct timespec *);
+    if (!real_ctw) real_ctw = dlsym(RTLD_NEXT, "pthread_cond_timedwait");
+    return real_ctw(c, m, abs);
+  }
+  /* abs is CLOCK_REALTIME, which this shim serves directly from the
+   * virtual clock (clock_gettime above returns vnow()), so the deadline
+   * is already in virtual-ns. */
+  int64_t abs_ns = (int64_t)abs->tv_sec * 1000000000LL + abs->tv_nsec;
+  if (abs_ns < 1) abs_ns = 1;
+  return vt_cond_wait_common(c, m, abs_ns);
+}
+
+static void vt_cond_wake(pthread_cond_t *c, int all) {
+  real_mxl(&g_vt_mx);
+  for (int i = 0; i < MAX_VT; i++)
+    if (g_vt[i].used && !g_vt[i].finished && g_vt[i].kind == WK_COND &&
+        g_vt[i].waddr == (void *)c) {
+      g_vt[i].kind = WK_RUN;
+      g_vt[i].waddr = NULL;  /* signaled (distinguishes from timeout) */
+      if (!all) break;
+    }
+  real_mxu(&g_vt_mx);
+}
+
+int pthread_cond_signal(pthread_cond_t *c) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) return real_cs(c);
+  vt_cond_wake(c, 0);
+  return 0;
+}
+
+int pthread_cond_broadcast(pthread_cond_t *c) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) return real_cb(c);
+  vt_cond_wake(c, 1);
+  return 0;
+}
+
+/* Unsupported thread operations fail loudly (never hang). */
+int pthread_cancel(pthread_t tid) {
+  (void)tid;
+  if (g_seq_fd < 0) {
+    static int (*real_can)(pthread_t);
+    if (!real_can) real_can = dlsym(RTLD_NEXT, "pthread_cancel");
+    return real_can(tid);
+  }
+  fprintf(stderr, "shadow1_shim: pthread_cancel is not supported under "
+                  "the simulation (deterministic cancellation points "
+                  "are not modeled)\n");
+  return ENOSYS;
+}
+
+/* A thread exiting via pthread_exit must leave the gate exactly like a
+ * start-routine return, or it would die holding the token and wedge
+ * every sibling. */
+void pthread_exit(void *ret) {
+  vt_resolve_reals();
+  static void (*real_exit)(void *) __attribute__((noreturn));
+  if (!real_exit) {
+    *(void **)&real_exit = dlsym(RTLD_NEXT, "pthread_exit");
+  }
+  if (g_seq_fd >= 0 && g_vt_on) vt_exit_self(ret);
+  real_exit(ret);
+}
+
+/* Semaphores: real sem_wait would block the OS thread while holding the
+ * token; virtualize them like mutexes (table keyed by address). */
+#define MAX_VSEM 128
+typedef struct { void *addr; int count; } vsem_t;
+static vsem_t g_vsem[MAX_VSEM];
+
+static vsem_t *vsem_get(void *addr, int create_count) {
+  int free_i = -1;
+  for (int i = 0; i < MAX_VSEM; i++) {
+    if (g_vsem[i].addr == addr) return &g_vsem[i];
+    if (!g_vsem[i].addr && free_i < 0) free_i = i;
+  }
+  if (free_i < 0) {
+    fprintf(stderr, "shadow1_shim: virtual-semaphore table full\n");
+    _exit(121);
+  }
+  g_vsem[free_i].addr = addr;
+  g_vsem[free_i].count = create_count;
+  return &g_vsem[free_i];
+}
+
+int sem_init(sem_t *s, int pshared, unsigned value) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) {
+    static int (*real_si)(sem_t *, int, unsigned);
+    if (!real_si) real_si = dlsym(RTLD_NEXT, "sem_init");
+    return real_si(s, pshared, value);
+  }
+  (void)pshared;
+  real_mxl(&g_vt_mx);
+  vsem_t *v = vsem_get(s, 0);
+  v->count = (int)value;
+  real_mxu(&g_vt_mx);
+  return 0;
+}
+
+int sem_wait(sem_t *s) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) {
+    static int (*real_sw)(sem_t *);
+    if (!real_sw) real_sw = dlsym(RTLD_NEXT, "sem_wait");
+    return real_sw(s);
+  }
+  real_mxl(&g_vt_mx);
+  vsem_t *v = vsem_get(s, 0);
+  while (v->count <= 0) {
+    g_vt[t_self].kind = WK_SEM;
+    g_vt[t_self].waddr = s;
+    vt_block_locked();
+  }
+  v->count--;
+  real_mxu(&g_vt_mx);
+  return 0;
+}
+
+int sem_trywait(sem_t *s) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) {
+    static int (*real_st)(sem_t *);
+    if (!real_st) real_st = dlsym(RTLD_NEXT, "sem_trywait");
+    return real_st(s);
+  }
+  real_mxl(&g_vt_mx);
+  vsem_t *v = vsem_get(s, 0);
+  int r = 0;
+  if (v->count > 0) v->count--;
+  else { errno = EAGAIN; r = -1; }
+  real_mxu(&g_vt_mx);
+  return r;
+}
+
+int sem_post(sem_t *s) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) {
+    static int (*real_sp)(sem_t *);
+    if (!real_sp) real_sp = dlsym(RTLD_NEXT, "sem_post");
+    return real_sp(s);
+  }
+  real_mxl(&g_vt_mx);
+  vsem_t *v = vsem_get(s, 0);
+  v->count++;
+  for (int i = 0; i < MAX_VT; i++)
+    if (g_vt[i].used && !g_vt[i].finished && g_vt[i].kind == WK_SEM &&
+        g_vt[i].waddr == (void *)s) {
+      g_vt[i].kind = WK_RUN;
+      break;
+    }
+  real_mxu(&g_vt_mx);
+  return 0;
+}
+
+int sem_destroy(sem_t *s) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) {
+    static int (*real_sd)(sem_t *);
+    if (!real_sd) real_sd = dlsym(RTLD_NEXT, "sem_destroy");
+    return real_sd(s);
+  }
+  real_mxl(&g_vt_mx);
+  for (int i = 0; i < MAX_VSEM; i++)
+    if (g_vsem[i].addr == (void *)s) { g_vsem[i].addr = NULL; break; }
+  real_mxu(&g_vt_mx);
+  return 0;
+}
+
+/* rwlocks: serialized execution makes the read/write distinction moot;
+ * treat both sides as the exclusive virtual mutex keyed by address
+ * (strictly safe: never admits an interleaving real rwlocks would
+ * forbid).  Unmanaged processes keep the real rwlock (the virtual
+ * mutex path only ever uses the ADDRESS, but the unmanaged fallback in
+ * pthread_mutex_lock would dereference it as a mutex). */
+static int vrw_lock(pthread_rwlock_t *rw, const char *real_name,
+                    int try_only) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) {
+    int (*real_fn)(pthread_rwlock_t *) = dlsym(RTLD_NEXT, real_name);
+    return real_fn(rw);
+  }
+  real_mxl(&g_vt_mx);
+  vmx_t *v = vmx_get(rw);
+  int r = 0;
+  for (;;) {
+    if (v->owner < 0 || v->owner == t_self) {
+      v->owner = t_self;
+      v->count++;
+      break;
+    }
+    if (try_only) { r = EBUSY; break; }
+    g_vt[t_self].kind = WK_MUTEX;
+    g_vt[t_self].waddr = rw;
+    vt_block_locked();
+  }
+  real_mxu(&g_vt_mx);
+  return r;
+}
+int pthread_rwlock_rdlock(pthread_rwlock_t *rw) {
+  return vrw_lock(rw, "pthread_rwlock_rdlock", 0);
+}
+int pthread_rwlock_wrlock(pthread_rwlock_t *rw) {
+  return vrw_lock(rw, "pthread_rwlock_wrlock", 0);
+}
+int pthread_rwlock_tryrdlock(pthread_rwlock_t *rw) {
+  return vrw_lock(rw, "pthread_rwlock_tryrdlock", 1);
+}
+int pthread_rwlock_trywrlock(pthread_rwlock_t *rw) {
+  return vrw_lock(rw, "pthread_rwlock_trywrlock", 1);
+}
+int pthread_rwlock_unlock(pthread_rwlock_t *rw) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) {
+    static int (*real_ru)(pthread_rwlock_t *);
+    if (!real_ru) real_ru = dlsym(RTLD_NEXT, "pthread_rwlock_unlock");
+    return real_ru(rw);
+  }
+  real_mxl(&g_vt_mx);
+  vmx_t *v = vmx_get(rw);
+  if (v->owner == t_self && --v->count <= 0) vmx_release(v);
+  real_mxu(&g_vt_mx);
+  return 0;
+}
+
+/* Barriers: count arrivals; the last arrival releases the cohort. */
+#define MAX_VBAR 32
+typedef struct { void *addr; unsigned needed, arrived; } vbar_t;
+static vbar_t g_vbar[MAX_VBAR];
+
+int pthread_barrier_init(pthread_barrier_t *b,
+                         const pthread_barrierattr_t *attr, unsigned n) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) {
+    static int (*real_bi)(pthread_barrier_t *,
+                          const pthread_barrierattr_t *, unsigned);
+    if (!real_bi) real_bi = dlsym(RTLD_NEXT, "pthread_barrier_init");
+    return real_bi(b, attr, n);
+  }
+  (void)attr;
+  real_mxl(&g_vt_mx);
+  int free_i = -1;
+  for (int i = 0; i < MAX_VBAR; i++) {
+    if (g_vbar[i].addr == (void *)b) { free_i = i; break; }
+    if (!g_vbar[i].addr && free_i < 0) free_i = i;
+  }
+  if (free_i < 0) {
+    real_mxu(&g_vt_mx);
+    fprintf(stderr, "shadow1_shim: barrier table full\n");
+    _exit(121);
+  }
+  g_vbar[free_i].addr = b;
+  g_vbar[free_i].needed = n;
+  g_vbar[free_i].arrived = 0;
+  real_mxu(&g_vt_mx);
+  return 0;
+}
+
+int pthread_barrier_wait(pthread_barrier_t *b) {
+  vt_resolve_reals();
+  if (g_seq_fd < 0) {
+    static int (*real_bw)(pthread_barrier_t *);
+    if (!real_bw) real_bw = dlsym(RTLD_NEXT, "pthread_barrier_wait");
+    return real_bw(b);
+  }
+  real_mxl(&g_vt_mx);
+  vbar_t *v = NULL;
+  for (int i = 0; i < MAX_VBAR; i++)
+    if (g_vbar[i].addr == (void *)b) v = &g_vbar[i];
+  if (!v) {
+    real_mxu(&g_vt_mx);
+    fprintf(stderr, "shadow1_shim: pthread_barrier_wait on uninitialized "
+                    "barrier\n");
+    _exit(121);
+  }
+  if (++v->arrived >= v->needed) {
+    v->arrived = 0;
+    for (int i = 0; i < MAX_VT; i++)
+      if (g_vt[i].used && !g_vt[i].finished &&
+          g_vt[i].kind == WK_COND && g_vt[i].waddr == (void *)b)
+        g_vt[i].kind = WK_RUN;
+    real_mxu(&g_vt_mx);
+    return PTHREAD_BARRIER_SERIAL_THREAD;
+  }
+  g_vt[t_self].kind = WK_COND;  /* barrier waiters ride the cond kind */
+  g_vt[t_self].waddr = b;
+  g_vt[t_self].wake_ns = 0;
+  vt_block_locked();
+  real_mxu(&g_vt_mx);
+  return 0;
+}
+
+/* pthread_once: the real one parks waiters on a futex; under the gate a
+ * blocked init routine would wedge them.  Serial execution makes a flag
+ * table sufficient (the init body itself may block virtually). */
+#define MAX_VONCE 128
+static struct { void *addr; int state; } g_vonce[MAX_VONCE];
+
+int pthread_once(pthread_once_t *ctl, void (*init)(void)) {
+  if (g_seq_fd < 0) {
+    static int (*real_on)(pthread_once_t *, void (*)(void));
+    if (!real_on) real_on = dlsym(RTLD_NEXT, "pthread_once");
+    return real_on(ctl, init);
+  }
+  vt_resolve_reals();
+  real_mxl(&g_vt_mx);
+  int slot = -1;
+  for (int i = 0; i < MAX_VONCE; i++) {
+    if (g_vonce[i].addr == (void *)ctl) { slot = i; break; }
+    if (!g_vonce[i].addr && slot < 0) slot = i;
+  }
+  if (slot < 0) {
+    real_mxu(&g_vt_mx);
+    fprintf(stderr, "shadow1_shim: pthread_once table full\n");
+    _exit(121);
+  }
+  if (g_vonce[slot].addr == (void *)ctl && g_vonce[slot].state == 2) {
+    real_mxu(&g_vt_mx);
+    return 0;
+  }
+  if (g_vonce[slot].addr == (void *)ctl && g_vonce[slot].state == 1) {
+    /* another thread is inside init (it blocked virtually): wait on the
+     * control address like a cond */
+    while (g_vonce[slot].state == 1) {
+      g_vt[t_self].kind = WK_COND;
+      g_vt[t_self].waddr = ctl;
+      g_vt[t_self].wake_ns = 0;
+      vt_block_locked();
+    }
+    real_mxu(&g_vt_mx);
+    return 0;
+  }
+  g_vonce[slot].addr = ctl;
+  g_vonce[slot].state = 1;
+  real_mxu(&g_vt_mx);
+  init();
+  real_mxl(&g_vt_mx);
+  g_vonce[slot].state = 2;
+  for (int i = 0; i < MAX_VT; i++)
+    if (g_vt[i].used && !g_vt[i].finished && g_vt[i].kind == WK_COND &&
+        g_vt[i].waddr == (void *)ctl)
+      g_vt[i].kind = WK_RUN;
+  real_mxu(&g_vt_mx);
   return 0;
 }

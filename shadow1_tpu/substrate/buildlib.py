@@ -39,7 +39,7 @@ def _build(src: pathlib.Path, out_name: str, compiler: str,
 
 def shim_path() -> str:
     return _build(_NATIVE / "shim" / "shadow1_shim.c", "shadow1_shim",
-                  "cc", ["-ldl"])
+                  "cc", ["-ldl", "-lpthread"])
 
 
 def sequencer_path() -> str:
@@ -53,7 +53,8 @@ def build_binary(src: pathlib.Path, name: str) -> str:
     out = _CACHE / f"{name}-{tag}"
     if not out.exists():
         tmp = _CACHE / f".{name}-{tag}.{os.getpid()}"
-        subprocess.run(["cc", "-O1", "-o", str(tmp), str(src)],
+        subprocess.run(["cc", "-O1", "-o", str(tmp), str(src),
+                        "-lpthread"],
                        check=True, capture_output=True)
         os.rename(tmp, out)
     return str(out)

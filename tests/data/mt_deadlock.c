@@ -1,0 +1,30 @@
+/* Classic two-mutex deadlock.  Under the shim's cooperative gate this
+ * must terminate with a DIAGNOSTIC (exit 121), never hang the
+ * sequencer: both threads end up WK_MUTEX with nothing external to
+ * wake them, which the union park detects. */
+#include <pthread.h>
+#include <stdio.h>
+#include <time.h>
+
+static pthread_mutex_t m1 = PTHREAD_MUTEX_INITIALIZER;
+static pthread_mutex_t m2 = PTHREAD_MUTEX_INITIALIZER;
+
+static void *b(void *arg) {
+  (void)arg;
+  pthread_mutex_lock(&m2);
+  struct timespec ts = {0, 1000000};
+  nanosleep(&ts, NULL); /* let main take m1 */
+  pthread_mutex_lock(&m1); /* blocks forever */
+  return NULL;
+}
+
+int main(void) {
+  pthread_mutex_lock(&m1);
+  pthread_t t;
+  pthread_create(&t, NULL, b, NULL);
+  struct timespec ts = {0, 2000000};
+  nanosleep(&ts, NULL); /* let b take m2 */
+  pthread_mutex_lock(&m2); /* deadlock */
+  printf("unreachable\n");
+  return 0;
+}

@@ -1,0 +1,124 @@
+/* Multi-threaded plugin: a worker pool over a shared virtual socket.
+ *
+ * Exercises the shim's cooperative thread gate (the rpth analog,
+ * reference src/external/rpth/pth_lib.c:98-146): pthread_create/join, a
+ * mutex-protected job queue, a cond-based startup handshake, mutex-
+ * serialized blocking socket IO, and per-thread virtual-time sleeps.
+ * Output (per-worker job counts + stream checksum) depends on the
+ * thread schedule, so byte-identical stdout across two runs proves the
+ * schedule is deterministic.
+ *
+ * usage: mt_workers <ip> <port> <jobs>
+ */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <pthread.h>
+#include <semaphore.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#define NW 3
+#define MSGLEN 64
+
+static int g_sock;
+static int g_next_job, g_max_jobs;
+static pthread_mutex_t g_qmx = PTHREAD_MUTEX_INITIALIZER;
+static pthread_mutex_t g_iomx = PTHREAD_MUTEX_INITIALIZER;
+static pthread_mutex_t g_smx = PTHREAD_MUTEX_INITIALIZER;
+static pthread_cond_t g_scv = PTHREAD_COND_INITIALIZER;
+static int g_started;
+static pthread_barrier_t g_bar;
+static sem_t g_iosem;           /* bounds concurrent IO attempts */
+static unsigned long long g_sum[NW];
+static int g_count[NW];
+
+static void *worker(void *vp) {
+  int id = (int)(long)vp;
+  pthread_mutex_lock(&g_smx);
+  g_started++;
+  pthread_cond_signal(&g_scv);
+  pthread_mutex_unlock(&g_smx);
+  pthread_barrier_wait(&g_bar);
+  unsigned char buf[MSGLEN], rsp[MSGLEN];
+  for (;;) {
+    pthread_mutex_lock(&g_qmx);
+    if (g_next_job >= g_max_jobs) {
+      pthread_mutex_unlock(&g_qmx);
+      break;
+    }
+    int j = g_next_job++;
+    pthread_mutex_unlock(&g_qmx);
+    for (int i = 0; i < MSGLEN; i++)
+      buf[i] = (unsigned char)(j * 7 + i);
+    sem_wait(&g_iosem);
+    pthread_mutex_lock(&g_iomx);
+    size_t off = 0;
+    while (off < MSGLEN) {
+      ssize_t w = write(g_sock, buf + off, MSGLEN - off);
+      if (w <= 0) { fprintf(stderr, "write fail\n"); exit(3); }
+      off += (size_t)w;
+    }
+    off = 0;
+    while (off < MSGLEN) {
+      ssize_t r = read(g_sock, rsp + off, MSGLEN - off);
+      if (r <= 0) { fprintf(stderr, "read fail\n"); exit(4); }
+      off += (size_t)r;
+    }
+    pthread_mutex_unlock(&g_iomx);
+    sem_post(&g_iosem);
+    unsigned long long s = 0;
+    for (int i = 0; i < MSGLEN; i++) s = s * 131 + rsp[i];
+    g_sum[id] ^= s + (unsigned long long)j;
+    g_count[id]++;
+    /* virtual-time think time so workers interleave across windows */
+    struct timespec ts = {0, 2000000}; /* 2ms */
+    nanosleep(&ts, NULL);
+  }
+  return (void *)(long)id;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) return 2;
+  g_max_jobs = atoi(argv[3]);
+  g_sock = socket(AF_INET, SOCK_STREAM, 0);
+  struct sockaddr_in a = {0};
+  a.sin_family = AF_INET;
+  a.sin_port = htons((uint16_t)atoi(argv[2]));
+  a.sin_addr.s_addr = inet_addr(argv[1]);
+  if (connect(g_sock, (struct sockaddr *)&a, sizeof a) != 0) {
+    fprintf(stderr, "connect fail\n");
+    return 5;
+  }
+  pthread_barrier_init(&g_bar, NULL, NW + 1);
+  sem_init(&g_iosem, 0, 2);
+  pthread_t tid[NW];
+  for (long i = 0; i < NW; i++)
+    if (pthread_create(&tid[i], NULL, worker, (void *)i) != 0) {
+      fprintf(stderr, "pthread_create fail\n");
+      return 6;
+    }
+  /* cond handshake: wait until every worker checked in */
+  pthread_mutex_lock(&g_smx);
+  while (g_started < NW)
+    pthread_cond_wait(&g_scv, &g_smx);
+  pthread_mutex_unlock(&g_smx);
+  pthread_barrier_wait(&g_bar);  /* releases the cohort together */
+  for (int i = 0; i < NW; i++) {
+    void *ret = NULL;
+    pthread_join(tid[i], &ret);
+    if ((long)ret != i) { fprintf(stderr, "join ret mismatch\n"); return 7; }
+  }
+  unsigned long long total = 0;
+  int jobs = 0;
+  for (int i = 0; i < NW; i++) {
+    printf("worker %d: %d jobs sum %llu\n", i, g_count[i], g_sum[i]);
+    total ^= g_sum[i];
+    jobs += g_count[i];
+  }
+  printf("mt_workers ok jobs=%d total=%llu\n", jobs, total);
+  return jobs == g_max_jobs ? 0 : 8;
+}

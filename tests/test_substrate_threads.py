@@ -1,0 +1,116 @@
+"""Real MULTI-THREADED plugins under the substrate (the rpth analog).
+
+The reference runs threaded plugins via a cooperative userspace
+scheduler (src/external/rpth/, ~90 pthread_* mappings in
+src/main/host/process.c); the shim's equivalent is a token gate over
+real OS threads (native/shim/shadow1_shim.c, cooperative virtual
+threads).  These tests prove the VERDICT round-4 "done" bar: a worker
+pool over virtual sockets runs byte-exact and deterministic across two
+runs, and an unsupported/deadlocked state fails with a clear diagnostic
+instead of hanging.
+"""
+
+import pathlib
+
+import jax.numpy as jnp
+
+import shadow1_tpu
+from shadow1_tpu.apps import echo
+from shadow1_tpu.core import simtime
+from shadow1_tpu.core.params import make_net_params
+from shadow1_tpu.core.state import make_sim_state
+from shadow1_tpu.routing.synthetic import uniform_full_mesh
+from shadow1_tpu.substrate import Substrate, bridge, buildlib
+from shadow1_tpu.transport import tcp
+
+MS = simtime.SIMTIME_ONE_MILLISECOND
+SEC = simtime.SIMTIME_ONE_SECOND
+
+SERVER_PORT = 7777
+SERVER_IP = "10.0.0.1"
+JOBS = 18
+
+
+def _world(seed=1):
+    def _build():
+        lat, rel = uniform_full_mesh(2, 5 * MS)
+        params = make_net_params(
+            latency_ns=lat, reliability=rel,
+            host_vertex=jnp.arange(2),
+            bw_up_Bps=jnp.full(2, 1 << 30),
+            bw_down_Bps=jnp.full(2, 1 << 30),
+            seed=seed, stop_time=60 * SEC)
+        state = make_sim_state(2, sock_slots=8, pool_capacity=1 << 10)
+        state = state.replace(
+            socks=tcp.listen(state.socks, host=0, slot=0, port=SERVER_PORT))
+        state = state.replace(app=echo.init_state([True, False]))
+        return state, params
+
+    state, params = shadow1_tpu.build_on_host(_build)
+    return state, params, echo.EchoServer()
+
+
+def _binary(name):
+    src = pathlib.Path(__file__).parent / "data" / f"{name}.c"
+    return buildlib.build_binary(src, name)
+
+
+def _ip_int(s):
+    a, b, c, d = (int(x) for x in s.split("."))
+    return (a << 24) | (b << 16) | (c << 8) | d
+
+
+def _run_workers(tmpdir, seed=1):
+    state, params, app = _world(seed)
+    sub = Substrate(resolve_ip={_ip_int(SERVER_IP): 0}.get,
+                    workdir=str(tmpdir))
+
+    def echo_content(host, vs, offset, n):
+        return bytes(vs.sent[offset:offset + n])
+
+    sub.content_provider = echo_content
+    p = sub.spawn(1, [_binary("mt_workers"), SERVER_IP, str(SERVER_PORT),
+                      str(JOBS)])
+    out = bridge.run(sub, state, params, app, 60 * SEC)
+    stdout = (pathlib.Path(sub.workdir) / "proc-0.stdout").read_text()
+    return sub, p, out, stdout
+
+
+class TestThreadedPlugins:
+    def test_worker_pool_end_to_end(self, tmp_path):
+        sub, p, out, stdout = _run_workers(tmp_path / "w")
+        assert p.exited, "threaded client never finished"
+        assert p.exit_code == 0, f"rc={p.exit_code}\n{stdout}"
+        assert f"mt_workers ok jobs={JOBS}" in stdout
+        # The full request/response stream crossed the simulated network.
+        assert int(out.socks.bytes_recv[0].sum()) == JOBS * 64
+        assert int(out.err) == 0
+        # Work was actually spread over the pool: in virtual time every
+        # worker's 2ms think overlaps the others', so with 18 jobs no
+        # worker can end up with zero.
+        for w in range(3):
+            assert f"worker {w}: 0 jobs" not in stdout
+
+    def test_schedule_is_deterministic_byte_exact(self, tmp_path):
+        _s1, p1, out1, stdout1 = _run_workers(tmp_path / "a")
+        _s2, p2, out2, stdout2 = _run_workers(tmp_path / "b")
+        assert p1.exit_code == 0 and p2.exit_code == 0
+        # Per-worker job counts + checksums depend on the cooperative
+        # schedule; byte-equality across runs is the determinism oracle.
+        assert stdout1 == stdout2
+        assert int(out1.hosts.pkts_sent.sum()) == \
+            int(out2.hosts.pkts_sent.sum())
+        assert int(out1.now) == int(out2.now)
+
+    def test_deadlock_diagnoses_instead_of_hanging(self, tmp_path):
+        state, params, app = _world()
+        sub = Substrate(resolve_ip={_ip_int(SERVER_IP): 0}.get,
+                        workdir=str(tmp_path))
+        p = sub.spawn(1, [_binary("mt_deadlock")])
+        bridge.run(sub, state, params, app, 10 * SEC)
+        assert p.exited, "deadlocked process not reaped"
+        assert p.exit_code == 121, f"expected diagnostic exit, rc=" \
+            f"{p.exit_code}"
+        # the sequencer merges stderr into proc-N.stdout
+        outlog = (pathlib.Path(sub.workdir) / "proc-0.stdout").read_text()
+        assert "DEADLOCK" in outlog
