@@ -44,6 +44,7 @@
 #include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/un.h>
 #include <sys/types.h>
 #include <time.h>
 #include <unistd.h>
@@ -174,6 +175,33 @@ static int is_vfd(int fd) {
   return fd >= VFD_BASE && fd < VFD_BASE + MAX_VFD && g_vfd_open[fd - VFD_BASE];
 }
 
+/* ---- low fd aliases ---------------------------------------------------
+ * Protocol vfd ids are >= 1<<20 (collision-free routing by value), but
+ * real programs put fds in fd_sets (select) and assume small numbers.
+ * Each vfd therefore RESERVES a real kernel fd (a dup of /dev/null) and
+ * the plugin sees that small number; interposed entry points promote
+ * alias -> vfd.  Closing releases both.  The reference keeps the same
+ * shape as shadow<->OS handle maps (host.c:57-105). */
+#define MAX_ALIAS 4096
+static int g_alias2vfd[MAX_ALIAS];
+static int unix_path_port(const char *path);
+
+static int vfd_promote(int fd) {
+  if (fd >= 0 && fd < MAX_ALIAS && g_alias2vfd[fd]) return g_alias2vfd[fd];
+  return fd;
+}
+
+static int alias_install(int64_t r) {
+  if (!(r >= VFD_BASE && r < VFD_BASE + MAX_VFD)) return (int)r;
+  int a = open("/dev/null", O_RDONLY | O_CLOEXEC);
+  if (a < 0 || a >= MAX_ALIAS) {
+    if (a >= 0) real_close(a);
+    return (int)r;  /* fall back to the raw vfd id */
+  }
+  g_alias2vfd[a] = (int)r;
+  return a;
+}
+
 /* ---- cooperative virtual threads (the rpth analog) -------------------
  *
  * The reference runs real multi-threaded plugins by replacing libpthread
@@ -226,7 +254,10 @@ static int64_t vnow(void) {
 /* ---- sockets ---- */
 
 int socket(int domain, int type, int protocol) {
-  if (g_seq_fd >= 0 && domain == AF_INET) {
+  /* AF_UNIX sockets virtualize as loopback TCP/UDP on the process's own
+   * host (path -> stable port; reference socket.h:47-78 unix-path map),
+   * keeping them inside virtual time instead of leaking to the kernel. */
+  if (g_seq_fd >= 0 && (domain == AF_INET || domain == AF_UNIX)) {
     req_t rq = {.op = OP_SOCKET, .fd = -1, .a0 = type, .a1 = protocol,
                 .len = 0};
     rep_t rp;
@@ -234,6 +265,7 @@ int socket(int domain, int type, int protocol) {
     if (r >= VFD_BASE && r < VFD_BASE + MAX_VFD) {
       g_vfd_open[r - VFD_BASE] = 1;
       g_vfd_nonblock[r - VFD_BASE] = (type & SOCK_NONBLOCK) != 0;
+      return alias_install(r);
     }
     return (int)r;
   }
@@ -243,6 +275,15 @@ int socket(int domain, int type, int protocol) {
 }
 
 int connect(int fd, const struct sockaddr *addr, socklen_t alen) {
+  fd = vfd_promote(fd);
+  if (is_vfd(fd) && addr && addr->sa_family == AF_UNIX) {
+    const struct sockaddr_un *u = (const struct sockaddr_un *)addr;
+    struct sockaddr_in a = {0};
+    a.sin_family = AF_INET;
+    a.sin_addr.s_addr = htonl(0x7F000001);  /* self (bridge loopback) */
+    a.sin_port = htons((uint16_t)unix_path_port(u->sun_path));
+    return connect(fd, (const struct sockaddr *)&a, sizeof a);
+  }
   if (is_vfd(fd) && addr && addr->sa_family == AF_INET) {
     const struct sockaddr_in *a = (const struct sockaddr_in *)addr;
     int user_nb = g_vfd_nonblock[fd - VFD_BASE];
@@ -279,6 +320,14 @@ int connect(int fd, const struct sockaddr *addr, socklen_t alen) {
 }
 
 int bind(int fd, const struct sockaddr *addr, socklen_t alen) {
+  fd = vfd_promote(fd);
+  if (is_vfd(fd) && addr && addr->sa_family == AF_UNIX) {
+    const struct sockaddr_un *u = (const struct sockaddr_un *)addr;
+    req_t rq = {.op = OP_BIND, .fd = fd, .a0 = 0,
+                .a1 = (int64_t)unix_path_port(u->sun_path), .len = 0};
+    rep_t rp;
+    return (int)rpc(&rq, &rp);
+  }
   if (is_vfd(fd) && addr && addr->sa_family == AF_INET) {
     const struct sockaddr_in *a = (const struct sockaddr_in *)addr;
     req_t rq = {.op = OP_BIND, .fd = fd,
@@ -293,6 +342,7 @@ int bind(int fd, const struct sockaddr *addr, socklen_t alen) {
 }
 
 int listen(int fd, int backlog) {
+  fd = vfd_promote(fd);
   if (is_vfd(fd)) {
     req_t rq = {.op = OP_LISTEN, .fd = fd, .a0 = backlog, .len = 0};
     rep_t rp;
@@ -304,6 +354,7 @@ int listen(int fd, int backlog) {
 }
 
 int accept(int fd, struct sockaddr *addr, socklen_t *alen) {
+  fd = vfd_promote(fd);
   if (is_vfd(fd)) {
     int user_nb = g_vfd_nonblock[fd - VFD_BASE];
     rep_t rp;
@@ -326,6 +377,7 @@ int accept(int fd, struct sockaddr *addr, socklen_t *alen) {
         *alen = sizeof(a);
         memcpy(addr, &a, sizeof(a));
       }
+      return alias_install(r);
     }
     return (int)r;
   }
@@ -372,6 +424,7 @@ static ssize_t vrecv(int fd, void *buf, size_t n, int flags) {
 }
 
 ssize_t send(int fd, const void *buf, size_t n, int flags) {
+  fd = vfd_promote(fd);
   if (is_vfd(fd)) return vsend(fd, buf, n, flags);
   static ssize_t (*real_send)(int, const void *, size_t, int);
   if (!real_send) real_send = dlsym(RTLD_NEXT, "send");
@@ -380,6 +433,7 @@ ssize_t send(int fd, const void *buf, size_t n, int flags) {
 
 ssize_t sendto(int fd, const void *buf, size_t n, int flags,
                const struct sockaddr *addr, socklen_t alen) {
+  fd = vfd_promote(fd);
   if (is_vfd(fd)) {
     if (!addr || addr->sa_family != AF_INET)
       return vsend(fd, buf, n, flags);  /* connected-style send */
@@ -410,6 +464,7 @@ ssize_t sendto(int fd, const void *buf, size_t n, int flags,
 /* Reply payload: {u32 src_ip, u32 src_port} header + datagram bytes. */
 ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
                  struct sockaddr *addr, socklen_t *alen) {
+  fd = vfd_promote(fd);
   if (is_vfd(fd)) {
     size_t chunk = n > MAX_DATA - 8 ? MAX_DATA - 8 : n;
     int user_nb = g_vfd_nonblock[fd - VFD_BASE];
@@ -452,24 +507,39 @@ ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
 }
 
 ssize_t recv(int fd, void *buf, size_t n, int flags) {
+  fd = vfd_promote(fd);
   if (is_vfd(fd)) return vrecv(fd, buf, n, flags);
   static ssize_t (*real_recv)(int, void *, size_t, int);
   if (!real_recv) real_recv = dlsym(RTLD_NEXT, "recv");
   return real_recv(fd, buf, n, flags);
 }
 
+static ssize_t efd_read(int fd, void *buf, size_t n);
+static ssize_t efd_write(int fd, const void *buf, size_t n);
+static int is_efd_fwd(int fd);
+
 ssize_t read(int fd, void *buf, size_t n) {
+  fd = vfd_promote(fd);
   if (is_vfd(fd)) return vrecv(fd, buf, n, 0);
   if (is_tfd(fd)) return tfd_read(fd, buf, n);
+  if (is_efd_fwd(fd)) return efd_read(fd, buf, n);
   return real_read(fd, buf, n);
 }
 
 ssize_t write(int fd, const void *buf, size_t n) {
+  fd = vfd_promote(fd);
   if (is_vfd(fd)) return vsend(fd, buf, n, 0);
+  if (is_efd_fwd(fd)) return efd_write(fd, buf, n);
   return real_write(fd, buf, n);
 }
 
 int close(int fd) {
+  if (fd >= 0 && fd < MAX_ALIAS && g_alias2vfd[fd]) {
+    int v = g_alias2vfd[fd];
+    g_alias2vfd[fd] = 0;
+    real_close(fd);        /* release the reserved kernel fd */
+    fd = v;
+  }
   if (is_vfd(fd)) {
     g_vfd_open[fd - VFD_BASE] = 0;
     req_t rq = {.op = OP_CLOSE, .fd = fd, .len = 0};
@@ -488,6 +558,7 @@ int close(int fd) {
 }
 
 int setsockopt(int fd, int level, int name, const void *val, socklen_t len) {
+  fd = vfd_promote(fd);
   if (is_vfd(fd)) return 0; /* accepted, modeled elsewhere */
   static int (*real_so)(int, int, int, const void *, socklen_t);
   if (!real_so) real_so = dlsym(RTLD_NEXT, "setsockopt");
@@ -495,6 +566,7 @@ int setsockopt(int fd, int level, int name, const void *val, socklen_t len) {
 }
 
 int getsockopt(int fd, int level, int name, void *val, socklen_t *len) {
+  fd = vfd_promote(fd);
   if (is_vfd(fd)) {
     if (level == SOL_SOCKET && name == SO_ERROR && val && len &&
         *len >= sizeof(int)) {
@@ -513,6 +585,7 @@ int getsockopt(int fd, int level, int name, void *val, socklen_t *len) {
 }
 
 int fcntl(int fd, int cmd, ...) {
+  fd = vfd_promote(fd);
   va_list ap;
   va_start(ap, cmd);
   long arg = va_arg(ap, long);
@@ -555,7 +628,7 @@ static int tfd_fill(struct pollfd *fds, nfds_t nfds, int64_t now) {
   return n;
 }
 
-int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
+static int poll_impl(struct pollfd *fds, nfds_t nfds, int timeout) {
   if (vt_multi() && g_seq_fd >= 0 && timeout != 0 &&
       nfds <= MAX_DATA / 8) {
     /* Thread-gate mode: probe with timeout 0 (the normal body below,
@@ -565,7 +638,7 @@ int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
     int64_t caller_dl = VT_NO_DEADLINE;
     if (timeout > 0) caller_dl = vnow() + (int64_t)timeout * 1000000LL;
     for (;;) {
-      int r = poll(fds, nfds, 0);
+      int r = poll_impl(fds, nfds, 0);
       if (r != 0) return r;
       if (caller_dl != VT_NO_DEADLINE && vnow() >= caller_dl) return 0;
       /* Record only the CALLER's deadline; the union park folds the
@@ -680,6 +753,24 @@ int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
   return total;
 }
 
+int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
+  if (g_seq_fd >= 0 && nfds > 0 && nfds <= MAX_DATA / 8) {
+    struct pollfd tr[MAX_DATA / 8];
+    int any = 0;
+    for (nfds_t i = 0; i < nfds; i++) {
+      tr[i] = fds[i];
+      tr[i].fd = vfd_promote(fds[i].fd);
+      if (tr[i].fd != fds[i].fd) any = 1;
+    }
+    if (any) {
+      int r = poll_impl(tr, nfds, timeout);
+      for (nfds_t i = 0; i < nfds; i++) fds[i].revents = tr[i].revents;
+      return r;
+    }
+  }
+  return poll_impl(fds, nfds, timeout);
+}
+
 /* ---- timerfd (shim-local against the virtual clock) ---- */
 
 int timerfd_create(int clockid, int flags) {
@@ -786,6 +877,7 @@ static ssize_t tfd_read(int fd, void *buf, size_t n) {
 }
 
 int shutdown(int fd, int how) {
+  fd = vfd_promote(fd);
   if (is_vfd(fd)) {
     req_t rq = {.op = OP_CLOSE, .fd = fd, .a0 = 1 /* half-close */,
                 .len = 0};
@@ -866,18 +958,22 @@ int pipe(int fds[2]) {
   memcpy(&wfd, rp.data, sizeof wfd);
   fds[0] = (int)r;
   fds[1] = wfd;
-  if (fds[0] >= VFD_BASE && fds[0] < VFD_BASE + MAX_VFD)
+  if (fds[0] >= VFD_BASE && fds[0] < VFD_BASE + MAX_VFD) {
     g_vfd_open[fds[0] - VFD_BASE] = 1;
-  if (fds[1] >= VFD_BASE && fds[1] < VFD_BASE + MAX_VFD)
+    fds[0] = alias_install(fds[0]);
+  }
+  if (fds[1] >= VFD_BASE && fds[1] < VFD_BASE + MAX_VFD) {
     g_vfd_open[fds[1] - VFD_BASE] = 1;
+    fds[1] = alias_install(fds[1]);
+  }
   return 0;
 }
 
 int pipe2(int fds[2], int flags) {
   int r = pipe(fds);
   if (r == 0 && g_seq_fd >= 0 && (flags & O_NONBLOCK)) {
-    g_vfd_nonblock[fds[0] - VFD_BASE] = 1;
-    g_vfd_nonblock[fds[1] - VFD_BASE] = 1;
+    g_vfd_nonblock[vfd_promote(fds[0]) - VFD_BASE] = 1;
+    g_vfd_nonblock[vfd_promote(fds[1]) - VFD_BASE] = 1;
   }
   return r;
 }
@@ -1956,4 +2052,346 @@ int pthread_once(pthread_once_t *ctl, void (*init)(void)) {
       g_vt[i].kind = WK_RUN;
   real_mxu(&g_vt_mx);
   return 0;
+}
+
+/* ================= syscall-surface breadth (round 5) =================== */
+
+/* select/pselect lower onto poll(), inheriting virtual time, the thread
+ * gate, and the bridge's readiness model (reference process_emu_select
+ * family). */
+#include <sys/select.h>
+
+int select(int nfds, fd_set *rd, fd_set *wr, fd_set *ex,
+           struct timeval *tv) {
+  if (g_seq_fd < 0) {
+    static int (*real_sel)(int, fd_set *, fd_set *, fd_set *,
+                           struct timeval *);
+    if (!real_sel) real_sel = dlsym(RTLD_NEXT, "select");
+    return real_sel(nfds, rd, wr, ex, tv);
+  }
+  /* Simulated sockets are handed out as LOW alias fds precisely so
+   * they fit fd_set; poll() promotes them. */
+  struct pollfd pf[FD_SETSIZE];
+  int np = 0;
+  for (int fd = 0; fd < nfds && fd < FD_SETSIZE; fd++) {
+    short ev = 0;
+    if (rd && FD_ISSET(fd, rd)) ev |= POLLIN;
+    if (wr && FD_ISSET(fd, wr)) ev |= POLLOUT;
+    if (ex && FD_ISSET(fd, ex)) ev |= POLLPRI;
+    if (!ev) continue;
+    pf[np].fd = fd;
+    pf[np].events = ev;
+    pf[np].revents = 0;
+    np++;
+  }
+  int timeout = -1;
+  if (tv) {
+    long long ms = (long long)tv->tv_sec * 1000 + tv->tv_usec / 1000;
+    if (ms > 0x7FFFFFFF) ms = 0x7FFFFFFF;
+    timeout = (int)ms;
+  }
+  int r = poll(pf, (nfds_t)np, timeout);
+  if (r < 0) return r;
+  if (rd) FD_ZERO(rd);
+  if (wr) FD_ZERO(wr);
+  if (ex) FD_ZERO(ex);
+  int total = 0;
+  for (int k = 0; k < np; k++) {
+    int fd = pf[k].fd;
+    int hit = 0;
+    if (pf[k].revents & (POLLIN | POLLHUP | POLLERR)) {
+      if (rd) { FD_SET(fd, rd); hit = 1; }
+    }
+    if (pf[k].revents & (POLLOUT | POLLERR)) {
+      if (wr) { FD_SET(fd, wr); hit = 1; }
+    }
+    if (pf[k].revents & POLLPRI) {
+      if (ex) { FD_SET(fd, ex); hit = 1; }
+    }
+    total += hit;
+  }
+  return total;
+}
+
+int pselect(int nfds, fd_set *rd, fd_set *wr, fd_set *ex,
+            const struct timespec *ts, const sigset_t *sig) {
+  (void)sig;
+  if (g_seq_fd < 0) {
+    static int (*real_ps)(int, fd_set *, fd_set *, fd_set *,
+                          const struct timespec *, const sigset_t *);
+    if (!real_ps) real_ps = dlsym(RTLD_NEXT, "pselect");
+    return real_ps(nfds, rd, wr, ex, ts, sig);
+  }
+  struct timeval tv, *tvp = NULL;
+  if (ts) {
+    tv.tv_sec = ts->tv_sec;
+    tv.tv_usec = ts->tv_nsec / 1000;
+    tvp = &tv;
+  }
+  return select(nfds, rd, wr, ex, tvp);
+}
+
+/* writev/readv/sendmsg/recvmsg: iovec fronts over the existing
+ * stream/datagram ops (reference process_emu_writev family). */
+#include <sys/uio.h>
+
+ssize_t writev(int fd, const struct iovec *iov, int iovcnt) {
+  fd = vfd_promote(fd);
+  if (!is_vfd(fd)) {
+    static ssize_t (*real_wv)(int, const struct iovec *, int);
+    if (!real_wv) real_wv = dlsym(RTLD_NEXT, "writev");
+    return real_wv(fd, iov, iovcnt);
+  }
+  ssize_t total = 0;
+  for (int i = 0; i < iovcnt; i++) {
+    size_t off = 0;
+    while (off < iov[i].iov_len) {
+      ssize_t w = vsend(fd, (const char *)iov[i].iov_base + off,
+                        iov[i].iov_len - off, 0);
+      if (w <= 0)
+        return total > 0 ? total : w;   /* partial like Linux */
+      off += (size_t)w;
+      total += w;
+      if ((size_t)w < iov[i].iov_len - (off - (size_t)w))
+        return total;                   /* short write: stop */
+    }
+  }
+  return total;
+}
+
+ssize_t readv(int fd, const struct iovec *iov, int iovcnt) {
+  fd = vfd_promote(fd);
+  if (!is_vfd(fd)) {
+    static ssize_t (*real_rv)(int, const struct iovec *, int);
+    if (!real_rv) real_rv = dlsym(RTLD_NEXT, "readv");
+    return real_rv(fd, iov, iovcnt);
+  }
+  ssize_t total = 0;
+  for (int i = 0; i < iovcnt; i++) {
+    if (iov[i].iov_len == 0) continue;
+    ssize_t r = vrecv(fd, iov[i].iov_base, iov[i].iov_len, 0);
+    if (r <= 0) return total > 0 ? total : r;
+    total += r;
+    if ((size_t)r < iov[i].iov_len) return total;  /* stream drained */
+  }
+  return total;
+}
+
+ssize_t sendmsg(int fd, const struct msghdr *msg, int flags) {
+  fd = vfd_promote(fd);
+  if (!is_vfd(fd)) {
+    static ssize_t (*real_sm)(int, const struct msghdr *, int);
+    if (!real_sm) real_sm = dlsym(RTLD_NEXT, "sendmsg");
+    return real_sm(fd, msg, flags);
+  }
+  /* Coalesce the iovec (datagrams must go as one unit; streams don't
+   * care).  Control messages are not modeled. */
+  size_t total = 0;
+  for (size_t i = 0; i < msg->msg_iovlen; i++)
+    total += msg->msg_iov[i].iov_len;
+  if (total > MAX_DATA) total = MAX_DATA;
+  static __thread unsigned char g_coal[MAX_DATA];
+  size_t off = 0;
+  for (size_t i = 0; i < msg->msg_iovlen && off < total; i++) {
+    size_t n = msg->msg_iov[i].iov_len;
+    if (n > total - off) n = total - off;
+    memcpy(g_coal + off, msg->msg_iov[i].iov_base, n);
+    off += n;
+  }
+  if (msg->msg_name &&
+      ((struct sockaddr *)msg->msg_name)->sa_family == AF_INET)
+    return sendto(fd, g_coal, off, flags,
+                  (const struct sockaddr *)msg->msg_name,
+                  msg->msg_namelen);
+  return vsend(fd, g_coal, off, flags);
+}
+
+ssize_t recvmsg(int fd, struct msghdr *msg, int flags) {
+  fd = vfd_promote(fd);
+  if (!is_vfd(fd)) {
+    static ssize_t (*real_rm)(int, struct msghdr *, int);
+    if (!real_rm) real_rm = dlsym(RTLD_NEXT, "recvmsg");
+    return real_rm(fd, msg, flags);
+  }
+  static __thread unsigned char g_coal[MAX_DATA];
+  size_t want = 0;
+  for (size_t i = 0; i < msg->msg_iovlen; i++)
+    want += msg->msg_iov[i].iov_len;
+  if (want > MAX_DATA) want = MAX_DATA;
+  ssize_t r;
+  if (msg->msg_name) {
+    socklen_t alen = msg->msg_namelen;
+    r = recvfrom(fd, g_coal, want, flags,
+                 (struct sockaddr *)msg->msg_name, &alen);
+    msg->msg_namelen = alen;
+  } else {
+    r = vrecv(fd, g_coal, want, flags);
+  }
+  if (r <= 0) return r;
+  size_t off = 0;
+  for (size_t i = 0; i < msg->msg_iovlen && off < (size_t)r; i++) {
+    size_t n = msg->msg_iov[i].iov_len;
+    if (n > (size_t)r - off) n = (size_t)r - off;
+    memcpy(msg->msg_iov[i].iov_base, g_coal + off, n);
+    off += n;
+  }
+  msg->msg_flags = 0;
+  return r;
+}
+
+/* eventfd: shim-local counter object (like timerfd).  Readiness changes
+ * only via sibling threads of the same process, so wakes ride the
+ * thread gate (write marks waiting readers runnable). */
+#include <sys/eventfd.h>
+
+#define EFD_VBASE (TFD_BASE + MAX_TFD)
+#define MAX_EFD 64
+
+typedef struct {
+  int used, nonblock, semaphore;
+  uint64_t count;
+} efd_t;
+
+static efd_t g_efd[MAX_EFD];
+
+static int is_efd(int fd) {
+  return fd >= EFD_VBASE && fd < EFD_VBASE + MAX_EFD &&
+         g_efd[fd - EFD_VBASE].used;
+}
+
+static int is_efd_fwd(int fd) { return is_efd(fd); }
+
+int eventfd(unsigned int initval, int flags) {
+  if (g_seq_fd < 0) {
+    static int (*real_efd)(unsigned int, int);
+    if (!real_efd) real_efd = dlsym(RTLD_NEXT, "eventfd");
+    return real_efd(initval, flags);
+  }
+  for (int i = 0; i < MAX_EFD; i++)
+    if (!g_efd[i].used) {
+      g_efd[i].used = 1;
+      g_efd[i].count = initval;
+      g_efd[i].nonblock = (flags & EFD_NONBLOCK) != 0;
+      g_efd[i].semaphore = (flags & EFD_SEMAPHORE) != 0;
+      return EFD_VBASE + i;
+    }
+  errno = EMFILE;
+  return -1;
+}
+
+static ssize_t efd_read(int fd, void *buf, size_t n) {
+  if (n < 8) { errno = EINVAL; return -1; }
+  efd_t *e = &g_efd[fd - EFD_VBASE];
+  for (;;) {
+    if (e->count > 0) {
+      uint64_t v = e->semaphore ? 1 : e->count;
+      e->count -= v;
+      memcpy(buf, &v, 8);
+      return 8;
+    }
+    if (e->nonblock) { errno = EAGAIN; return -1; }
+    if (vt_multi()) {
+      /* sem-style wait keyed by the efd object; efd_write wakes us */
+      real_mxl(&g_vt_mx);
+      g_vt[t_self].kind = WK_SEM;
+      g_vt[t_self].waddr = e;
+      vt_block_locked();
+      real_mxu(&g_vt_mx);
+      continue;
+    }
+    /* Single-threaded read on an empty eventfd can never be satisfied:
+     * park forever in virtual time (Linux blocks forever too). */
+    req_t rq = {.op = OP_SLEEP, .fd = -1, .a0 = (int64_t)1 << 62,
+                .len = 0};
+    rep_t rp;
+    rpc(&rq, &rp);
+  }
+}
+
+static ssize_t efd_write(int fd, const void *buf, size_t n) {
+  if (n < 8) { errno = EINVAL; return -1; }
+  efd_t *e = &g_efd[fd - EFD_VBASE];
+  uint64_t v;
+  memcpy(&v, buf, 8);
+  e->count += v;
+  if (g_vt_on) {
+    vt_resolve_reals();
+    real_mxl(&g_vt_mx);
+    for (int i = 0; i < MAX_VT; i++) {
+      vt_t *t = &g_vt[i];
+      if (!t->used || t->finished) continue;
+      if (t->kind == WK_SEM && t->waddr == (void *)e) t->kind = WK_RUN;
+      if (t->kind == WK_POLL)
+        for (int j = 0; j < t->pnfds; j++)
+          if (t->pfds[j].fd == fd) t->kind = WK_RUN;
+    }
+    real_mxu(&g_vt_mx);
+  }
+  return 8;
+}
+
+/* Deterministic rand: the reference routes rand() to the host Random so
+ * every run draws the same sequence regardless of libc internals
+ * (process.c rand emulation).  Seeded per process by the substrate via
+ * SHADOW1_RAND_SEED. */
+static uint64_t g_rand_state;
+static int g_rand_init;
+
+static void vrand_init(void) {
+  if (g_rand_init) return;
+  const char *s = getenv("SHADOW1_RAND_SEED");
+  uint64_t seed = s ? (uint64_t)strtoull(s, NULL, 10) : 1;
+  g_rand_state = seed * 0x9E3779B97F4A7C15ULL + 1;
+  g_rand_init = 1;
+}
+
+static uint64_t vrand_next(void) {
+  vrand_init();
+  uint64_t x = g_rand_state;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  g_rand_state = x;
+  return x;
+}
+
+int rand(void) {
+  if (g_seq_fd < 0) {
+    static int (*real_rand)(void);
+    if (!real_rand) real_rand = dlsym(RTLD_NEXT, "rand");
+    return real_rand();
+  }
+  return (int)(vrand_next() >> 33);  /* 31-bit non-negative */
+}
+
+long random(void) {
+  if (g_seq_fd < 0) {
+    static long (*real_random)(void);
+    if (!real_random) real_random = dlsym(RTLD_NEXT, "random");
+    return real_random();
+  }
+  return (long)(vrand_next() >> 33);
+}
+
+void srand(unsigned seed) {
+  if (g_seq_fd < 0) {
+    static void (*real_srand)(unsigned);
+    if (!real_srand) real_srand = dlsym(RTLD_NEXT, "srand");
+    real_srand(seed);
+    return;
+  }
+  g_rand_state = (uint64_t)seed * 0x9E3779B97F4A7C15ULL + 1;
+  g_rand_init = 1;
+}
+
+void srandom(unsigned seed) { srand(seed); }
+
+/* AF_UNIX in virtual time: path-named sockets become loopback TCP on
+ * the process's own host; the path hashes to a stable high port
+ * (reference keeps a unix-path -> port map, host.c:57-105 +
+ * socket.h:47-78). */
+static int unix_path_port(const char *path) {
+  uint32_t hsh = 2166136261u;
+  for (const char *c = path; *c; c++) hsh = (hsh ^ (uint8_t)*c) * 16777619u;
+  return 61000 + (int)(hsh % 4000);
 }

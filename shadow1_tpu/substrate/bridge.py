@@ -555,7 +555,13 @@ class Substrate:
                 vs.udp_peer = (int(a0), int(a1) & 0xFFFF)
                 vs.connected = True
                 return (0, 0, b"")
-            dst = self.resolve_ip(int(a0))
+            # ip 0 or 127.0.0.1: the process's own host (loopback; also
+            # how the shim virtualizes AF_UNIX paths -- reference maps
+            # unix-path sockets onto ports, socket.h:47-78).
+            if int(a0) in (0, 0x7F000001):
+                dst = h
+            else:
+                dst = self.resolve_ip(int(a0))
             if dst is None:
                 return (-1, _ECONNREFUSED, b"")
             nonblock = bool(a1 >> 32)
