@@ -2086,7 +2086,11 @@ int select(int nfds, fd_set *rd, fd_set *wr, fd_set *ex,
   }
   int timeout = -1;
   if (tv) {
-    long long ms = (long long)tv->tv_sec * 1000 + tv->tv_usec / 1000;
+    /* Round sub-millisecond timeouts UP: truncation turned a 100us
+     * select loop into timeout=0 (pure poll), which spins without
+     * consuming virtual time and can trip the sequencer wedge
+     * watchdog.  A nonzero timeout always lowers to >= 1ms. */
+    long long ms = (long long)tv->tv_sec * 1000 + (tv->tv_usec + 999) / 1000;
     if (ms > 0x7FFFFFFF) ms = 0x7FFFFFFF;
     timeout = (int)ms;
   }
@@ -2125,7 +2129,10 @@ int pselect(int nfds, fd_set *rd, fd_set *wr, fd_set *ex,
   struct timeval tv, *tvp = NULL;
   if (ts) {
     tv.tv_sec = ts->tv_sec;
-    tv.tv_usec = ts->tv_nsec / 1000;
+    /* Round up like select(): a sub-microsecond timeout must not
+     * become a zero-timeout spin. */
+    tv.tv_usec = (ts->tv_nsec + 999) / 1000;
+    if (tv.tv_usec >= 1000000) { tv.tv_sec += 1; tv.tv_usec -= 1000000; }
     tvp = &tv;
   }
   return select(nfds, rd, wr, ex, tvp);

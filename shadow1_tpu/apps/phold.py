@@ -42,10 +42,13 @@ class Phold:
     # self, so the loopback insert path traces away too.
     uses_tcp = False
     may_loopback = False
-    # Arrival batching at 2: with send batching absorbing the send
-    # chains, the per-window long pole is the arrival tail (Poisson max
-    # ~10 arrivals/host/window at 16k hosts).  rx_batch=4 alone measured
-    # as a net loss (+30% step cost for -12% steps), but 2 rounds paired
+    # Arrival batching (rx_batch, an __init__ arg) defaults to 1: serial
+    # per-arrival stepping, bitwise-equal across configs, so event counts
+    # are apples-to-apples between runs.  Benchmarks opt into rx_batch=2
+    # explicitly: with send batching absorbing the send chains, the
+    # per-window long pole is the arrival tail (Poisson max ~10
+    # arrivals/host/window at 16k hosts).  rx_batch=4 alone measured as a
+    # net loss (+30% step cost for -12% steps), but 2 rounds paired
     # with tx lanes is the measured sweet spot.  SEMANTICS NOTE: batched
     # arrivals re-arm their forwards from the batch instant t_post (>=
     # each arrival's own time, so causality holds) and their rng draws
@@ -53,7 +56,7 @@ class Phold:
     # deterministic for a fixed config but NOT bitwise-equal to
     # rx_batch=1 stepping (measured: ~1% send-count shift).  Send-lane
     # batching alone IS bitwise-equal to serial stepping.
-    rx_batch = 2
+    #
     # SEND batching is where phold's steps go: within a window every
     # arrival for a host is already in its inbox (conservative
     # invariant), so pending sends due strictly before min(next own
@@ -68,17 +71,21 @@ class Phold:
     app_tx_lanes = 4
     wants_window_end = True
 
-    def __init__(self, mean_delay_ns: int, sock_slot: int = 0):
+    def __init__(self, mean_delay_ns: int, sock_slot: int = 0,
+                 rx_batch: int = 1):
         self.mean_delay_ns = int(mean_delay_ns)
         self.sock_slot = int(sock_slot)
+        self.rx_batch = int(rx_batch)
 
     def __hash__(self):
-        return hash(("phold", self.mean_delay_ns, self.sock_slot))
+        return hash(("phold", self.mean_delay_ns, self.sock_slot,
+                     self.rx_batch))
 
     def __eq__(self, other):
         return (isinstance(other, Phold)
                 and other.mean_delay_ns == self.mean_delay_ns
-                and other.sock_slot == self.sock_slot)
+                and other.sock_slot == self.sock_slot
+                and other.rx_batch == self.rx_batch)
 
     # -- engine hooks -------------------------------------------------------
 

@@ -79,12 +79,28 @@ def _parser():
                    help="event-log ring capacity (0 = auto: 64k, grown to "
                         "1M under global debug so a full drain interval "
                         "fits)")
+    r.add_argument("--profile", action="store_true",
+                   help="profile the run: write trace.json (Chrome "
+                        "trace-event format; open in chrome://tracing or "
+                        "ui.perfetto.dev) and metrics.json (per-phase "
+                        "p50/p95 wall times, transfer bytes, JIT compile "
+                        "count) to the data directory and print a phase "
+                        "summary table (see docs/observability.md)")
     r.add_argument("--quiet", action="store_true")
     return p
 
 
 def run_config(args) -> int:
     from .config import assemble
+
+    profiler = None
+    if args.profile:
+        if not args.data_directory:
+            print("error: --profile requires --data-directory",
+                  file=sys.stderr)
+            return 2
+        from . import trace
+        profiler = trace.install(trace.Profiler(sync=True))
 
     t_wall = time.perf_counter()
     asm = assemble.load(args.config, seed=args.seed,
@@ -194,6 +210,11 @@ def run_config(args) -> int:
             print(f"[shadow1-tpu] {len(asm.real_procs)} real process(es) "
                   f"under the substrate", file=sys.stderr)
 
+    if profiler is not None:
+        from . import trace
+        # Device-side per-window counters, fetched once per drain point.
+        state = trace.ensure_counters(state)
+
     t = int(state.now)
     hb_next = 0
     while t < stop:
@@ -211,6 +232,8 @@ def run_config(args) -> int:
             hb_next = t + tracker.sample_interval_ns
         if drain is not None:
             drain.drain(state)
+        if profiler is not None:
+            trace.fetch_counters(state, profiler)
     jax.block_until_ready(state)
     wall = time.perf_counter() - t_wall
 
@@ -270,6 +293,19 @@ def run_config(args) -> int:
             and not _scheduled_stop(p))
         summary["processes_running_at_stop"] = sum(
             1 for p in procs if not p.exited)
+    if profiler is not None:
+        import os as _os2
+        trace.fetch_counters(state, profiler)
+        trace_path = _os2.path.join(args.data_directory, "trace.json")
+        metrics_path = _os2.path.join(args.data_directory, "metrics.json")
+        profiler.write_trace(trace_path)
+        m = profiler.write_metrics(
+            metrics_path, extra={"simulated_seconds": t / SEC})
+        summary["profile"] = {"trace": trace_path, "metrics": metrics_path,
+                              "compile_count": m["compile"]["count"]}
+        if not args.quiet:
+            print(profiler.summary_table(), file=sys.stderr)
+        trace.install(None)
     print(json.dumps(summary))
     if substrate is not None and summary["processes_failed"]:
         return 3

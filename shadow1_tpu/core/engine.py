@@ -397,6 +397,17 @@ def _exchange_body(state: SimState, params) -> SimState:
         if params.pds_trail else ib.status,
     )
 
+    # Profiler counter block (trace.py), present only when a run opted
+    # in: packets moved this exchange + peak destination-slab occupancy.
+    if state.tr is not None:
+        fit = jnp.minimum(total, n_free)                # [H] movers placed
+        occ = jnp.max(ki - n_free + fit)                # [H] -> max slots used
+        state = state.replace(tr=state.tr.replace(
+            exchanges=state.tr.exchanges + 1,
+            pkts_exchanged=state.tr.pkts_exchanged
+            + jnp.sum(fit.astype(I64)),
+            occ_max=jnp.maximum(state.tr.occ_max, occ.astype(I32))))
+
     # Movers leave the outbox whether they fit or overflowed.  Shed pure
     # ACKs are accounted as thinning; DATA/control overflow is a counted
     # drop and raises the capacity escape-hatch flag.
@@ -1214,10 +1225,20 @@ CHUNK_NS = 2 * simtime.SIMTIME_ONE_SECOND
 
 def run_chunked(state: SimState, params, app, t_target: int,
                 chunk_ns: int = CHUNK_NS):
-    """Host-side loop of bounded `run_until` launches up to t_target."""
+    """Host-side loop of bounded `run_until` launches up to t_target.
+
+    When a profiler is active (trace.install), each launch is recorded
+    as a `device_step` span; in sync mode the launch is blocked on so
+    the span measures device execution rather than async dispatch."""
+    from .. import trace
+
     t = int(state.now)
     t_target = int(t_target)
+    prof = trace.current()
     while t < t_target:
         t = min(t + chunk_ns, t_target)
-        state = run_until(state, params, app, t)
+        with prof.span("device_step", t_ns=t):
+            state = run_until(state, params, app, t)
+            if prof.sync:
+                jax.block_until_ready(state)
     return state

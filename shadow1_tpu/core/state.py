@@ -701,6 +701,37 @@ def make_log_ring(capacity: int = 1 << 16) -> LogRing:
 
 
 # ---------------------------------------------------------------------------
+# Trace counter block (runtime profiling; trace.py)
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class TraceCounters:
+    """Device-side runtime counters for the profiler (trace.py): scalars
+    accumulated inside the compiled step and fetched ONCE per drain, so
+    profiling costs one extra small transfer per chunk, not per window.
+    Present in SimState only when tracing is on (like cap/log), so
+    unprofiled runs trace without any counter cost."""
+
+    exchanges: jnp.ndarray       # i64 boundary exchanges that moved packets
+    pkts_exchanged: jnp.ndarray  # i64 packets forwarded outbox -> inbox
+    occ_max: jnp.ndarray         # i32 max inbox-slab occupancy seen (slots)
+
+    def occupancy_frac(self, state) -> float:
+        """Peak inbox-slab fill fraction (host-side convenience)."""
+        ki = state.inbox.capacity // state.hosts.num_hosts
+        return float(self.occ_max) / max(ki, 1)
+
+
+def make_trace_counters() -> TraceCounters:
+    return TraceCounters(
+        exchanges=jnp.asarray(0, I64),
+        pkts_exchanged=jnp.asarray(0, I64),
+        occ_max=jnp.asarray(0, I32),
+    )
+
+
+# ---------------------------------------------------------------------------
 # Whole-simulation state
 # ---------------------------------------------------------------------------
 
@@ -726,6 +757,7 @@ class SimState:
     log: any = struct.field(pytree_node=True, default=None)  # LogRing | None
     # Per-host log level mask (LOG_*), only consulted when log is set.
     log_level: any = struct.field(pytree_node=True, default=None)  # [H] i32
+    tr: any = struct.field(pytree_node=True, default=None)  # TraceCounters | None
     # Telemetry (reference scheduler built-in timers, scheduler.c:266-268):
     n_steps: jnp.ndarray = struct.field(default=None)    # i64 micro-steps
     n_windows: jnp.ndarray = struct.field(default=None)  # i64 windows run

@@ -20,6 +20,7 @@ import os
 
 import numpy as np
 
+from . import trace
 from .core import simtime
 from .core.state import (SOCK_FREE, SOCK_TCP, SOCK_UDP, STAGE_FREE,
                          STAGE_IN_FLIGHT, STAGE_RX_QUEUED, STAGE_TX_QUEUED)
@@ -86,10 +87,15 @@ class Tracker:
         self._last_t = 0  # _last rows advance per written heartbeat row
 
     def heartbeat(self, state, now_ns: int):
+        with trace.current().span("heartbeat", t_ns=int(now_ns)):
+            self._heartbeat(state, now_ns)
+
+    def _heartbeat(self, state, now_ns: int):
         # ONE device buffer, ONE transfer: per-buffer fetches each cost a
         # full round trip on a tunneled backend (~0.1-1s), and heartbeats
         # fire once per simulated second.
         packed = np.asarray(_pack_heartbeat(state.hosts))
+        trace.current().transfer(packed.nbytes, count=1)
         n = len(_FIELDS)
         cur = {f: packed[i] for i, f in enumerate(_FIELDS)}
         txq, rxq = packed[n], packed[n + 1]
@@ -231,12 +237,16 @@ class LogDrain:
         self._f = open(path, "w")
 
     def drain(self, state):
+        with trace.current().span("log_drain"):
+            return self._drain(state)
+
+    def _drain(self, state):
         import jax
         lg = state.log
         if lg is None:
             return 0
-        total = int(jax.device_get(lg.total))
-        lost = int(jax.device_get(lg.lost))
+        total, lost = (int(v) for v in jax.device_get((lg.total, lg.lost)))
+        trace.current().transfer(16, count=1)
         if lost > self._lost_reported:
             self._f.write(f"[log] WARNING: {lost - self._lost_reported} "
                           f"records lost inside oversized appends\n")
@@ -245,6 +255,8 @@ class LogDrain:
             return 0
         t, host, code, arg = jax.device_get(
             (lg.time, lg.host, lg.code, lg.arg))
+        trace.current().transfer(
+            t.nbytes + host.nbytes + code.nbytes + arg.nbytes, count=1)
         c = t.shape[0]
         new = total - self._last_total
         if new <= 0:

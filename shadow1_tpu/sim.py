@@ -32,12 +32,18 @@ def build_phold(num_hosts: int,
                 pool_capacity: int = 1 << 14,
                 bw_up_Bps: int = 1 << 30,
                 bw_down_Bps: int = 1 << 30,
-                bootstrap_end: int = 0):
+                bootstrap_end: int = 0,
+                rx_batch: int = 1):
     """A phold benchmark world on a uniform full-mesh topology.
 
     The topology is capped at 256 vertices with hosts striped across them
     (all pair latencies are identical anyway), so the [V,V] routing
-    matrices stay small however many hosts the benchmark scales to."""
+    matrices stay small however many hosts the benchmark scales to.
+
+    rx_batch > 1 enables arrival batching (faster, but the trajectory is
+    not bitwise-equal to serial stepping; see apps/phold.py).  The
+    default is the apples-to-apples serial semantics; benchmark entry
+    points opt into batching explicitly."""
     if num_hosts < 2:
         raise ValueError("phold needs at least 2 hosts (every message is "
                          "forwarded to a different host)")
@@ -74,7 +80,8 @@ def build_phold(num_hosts: int,
     # so it runs there -- it is only a handful of ops.
     state = state.replace(app=phold_app.init_state(
         num_hosts, params, msgs_per_host, mean_delay_ns))
-    app = phold_app.Phold(mean_delay_ns=mean_delay_ns, sock_slot=0)
+    app = phold_app.Phold(mean_delay_ns=mean_delay_ns, sock_slot=0,
+                          rx_batch=rx_batch)
     return state, params, app
 
 
@@ -165,9 +172,25 @@ def build_gossip(num_hosts: int = 500,
     return state, params, gossip_app.Gossip()
 
 
-def run(state, params, app, until=None):
+def run(state, params, app, until=None, profiler=None):
+    """Run to `until` (default: params.stop_time).
+
+    With `profiler` (a trace.Profiler), the run is profiled: the
+    profiler is installed, device counters ride the state, and the run
+    executes through the chunked launcher so device spans are recorded.
+    """
     t = params.stop_time if until is None else until
-    return engine.run_until(state, params, app, t)
+    if profiler is None:
+        return engine.run_until(state, params, app, t)
+    from . import trace
+    trace.install(profiler)
+    try:
+        state = trace.ensure_counters(state)
+        state = engine.run_chunked(state, params, app, int(t))
+        trace.fetch_counters(state, profiler)
+        return state
+    finally:
+        trace.install(None)
 
 
 def build_onion(num_circuits: int,

@@ -47,7 +47,7 @@ from dataclasses import dataclass, field
 import jax
 import numpy as np
 
-from .. import build_on_host
+from .. import build_on_host, trace
 from ..core import simtime
 from ..transport import tcp
 from . import buildlib
@@ -287,6 +287,10 @@ class Substrate:
     def sync(self, state, params, now_ns: int):
         """Publish the clock, run every runnable process until it blocks,
         apply the produced socket ops.  Returns the updated state."""
+        with trace.current().span("bridge_sync", t_ns=int(now_ns)):
+            return self._sync(state, params, now_ns)
+
+    def _sync(self, state, params, now_ns: int):
         self._lib.seq_settime(self.handle, EMULATED_EPOCH_NS + now_ns)
         # Due deferred spawns become real processes this sync (ordered by
         # (start, queue position) for determinism).
@@ -356,11 +360,13 @@ class Substrate:
                  "udp_head", "udp_count", "udp_src", "udp_sport",
                  "udp_len", "udp_payload")
         vals = jax.device_get(tuple(getattr(socks, n) for n in names))
+        trace.current().transfer(sum(v.nbytes for v in vals), count=1)
         regs = dict(zip(names, vals))
         tx = self._find_tx(state)
         self._has_tx = tx is not None
         if tx is not None:
             counts, heads = jax.device_get((tx.count, tx.head))
+            trace.current().transfer(counts.nbytes + heads.nbytes, count=1)
             self._tx_inflight = {h: int(c) for h, c in enumerate(counts)}
             self._tx_base = dict(self._tx_inflight)  # count at fetch time
             self._tx_head = {h: int(v) for h, v in enumerate(heads)}
@@ -420,10 +426,15 @@ class Substrate:
         a1 = ctypes.c_int64()
         data = (ctypes.c_uint8 * MAX_DATA)()
         length = ctypes.c_uint32()
-        r = self._lib.seq_wait_request(self.handle, p.proc_id, timeout_ms,
-                                       ctypes.byref(op), ctypes.byref(fd),
-                                       ctypes.byref(a0), ctypes.byref(a1),
-                                       data, ctypes.byref(length))
+        # The shim RPC: wall time from handing control to the process
+        # until its next syscall arrives (the substrate path's per-RPC
+        # latency; histogrammed by the profiler as `bridge_rpc`).
+        with trace.current().span("bridge_rpc", proc=p.proc_id):
+            r = self._lib.seq_wait_request(
+                self.handle, p.proc_id, timeout_ms,
+                ctypes.byref(op), ctypes.byref(fd),
+                ctypes.byref(a0), ctypes.byref(a1),
+                data, ctypes.byref(length))
         if r == 0:
             return 0, int(a0.value)
         if r == 1:
@@ -1215,7 +1226,11 @@ def run(substrate: Substrate, state, params, app, t_target: int,
         t_next = min(t + sync_interval_ns, t_target)
         if wake is not None:
             t_next = min(max(wake, t + 1), t_next)
-        state = engine.run_until(state, params, app, t_next)
+        prof = trace.current()
+        with prof.span("device_step", t_ns=t_next):
+            state = engine.run_until(state, params, app, t_next)
+            if prof.sync:
+                jax.block_until_ready(state)
         t = t_next
         state = substrate.sync(state, params, t)
     return state

@@ -1,0 +1,327 @@
+"""Runtime tracing & metrics: where wall-time goes inside a run.
+
+`observe.py` watches the *simulated* world (heartbeats, pcap, drops);
+this module watches the *simulator*.  A `Profiler` records host-side
+phase spans (device launches, tracker/log drains, substrate syncs,
+bridge RPCs), device->host transfer volume, and JIT compile events (via
+JAX's monitoring hook), while a device-side `TraceCounters` block
+(core/state.py) accumulates per-window aggregates -- packets exchanged,
+peak inbox-slab occupancy -- inside the compiled step so they cost one
+extra scalar fetch per drain, not per window.
+
+Three artifacts per profiled run:
+
+* ``trace.json`` -- Chrome trace-event format; open in chrome://tracing
+  or https://ui.perfetto.dev.  Phase spans are duration events; device
+  counter snapshots are counter tracks.
+* ``metrics.json`` -- aggregates: per-phase count/total/p50/p95/max,
+  transfer bytes, compile count, device counters.
+* a one-screen summary table (``Profiler.summary_table()``).
+
+The module-level `install()/current()` pair keeps hook sites cheap:
+engine/observe/bridge call ``trace.current().span(...)``, which is a
+no-op singleton unless a run installed a real Profiler.  Hot compiled
+code never consults the profiler -- device-side counting is opted into
+by putting a TraceCounters block on the state (``ensure_counters``),
+the same present-or-None pattern as the capture and log rings.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+# JAX's backend-compile duration event (jax._src.dispatch
+# BACKEND_COMPILE_EVENT): fires once per XLA compile, i.e. on every
+# compile-cache miss.  Resolved lazily so a rename in a future JAX only
+# degrades compile attribution, never breaks the profiler.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+# ---------------------------------------------------------------------------
+# Null profiler: the installed-by-default no-op
+# ---------------------------------------------------------------------------
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullProfiler:
+    """Inactive profiler: every hook is a constant-time no-op."""
+
+    enabled = False
+    sync = False
+
+    def span(self, name, **args):
+        return _NULL_SPAN
+
+    def transfer(self, nbytes, count=1):
+        pass
+
+    def compile_event(self, dur_s):
+        pass
+
+    def counter_sample(self, values):
+        pass
+
+
+_NULL = NullProfiler()
+_active = _NULL
+_hook_installed = False
+
+
+def current():
+    """The active profiler (a NullProfiler unless a run installed one)."""
+    return _active
+
+
+def install(prof):
+    """Install `prof` as the process-wide active profiler (None/falsy
+    restores the no-op).  Returns the now-active profiler."""
+    global _active
+    _active = prof if prof else _NULL
+    if _active.enabled:
+        _ensure_compile_hook()
+    return _active
+
+
+def _ensure_compile_hook():
+    """Register ONE process-global JAX event listener that forwards
+    backend-compile durations to whatever profiler is active.  JAX has no
+    per-listener unregister, so the listener is permanent and dispatches
+    through `current()`."""
+    global _hook_installed
+    if _hook_installed:
+        return
+    try:
+        from jax._src import monitoring
+
+        def _on_event(event, dur_s, **kw):
+            p = _active
+            if p.enabled and event == _COMPILE_EVENT:
+                p.compile_event(dur_s)
+
+        monitoring.register_event_duration_secs_listener(_on_event)
+        _hook_installed = True
+    except Exception:  # noqa: BLE001 - compile attribution is best-effort
+        pass
+
+
+# ---------------------------------------------------------------------------
+# The real profiler
+# ---------------------------------------------------------------------------
+
+
+class _Span:
+    __slots__ = ("prof", "name", "args", "t0")
+
+    def __init__(self, prof, name, args):
+        self.prof = prof
+        self.name = name
+        self.args = args
+        self.t0 = 0.0
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        p = self.prof
+        t0 = self.t0
+        p.events.append((self.name, t0 - p.t0,
+                         time.perf_counter() - t0, self.args))
+        return False
+
+
+class Profiler:
+    """Host-side run profiler.
+
+    sync=True makes the engine's chunk loop block_until_ready after each
+    device launch so `device_step` spans measure execution rather than
+    async dispatch (full --profile mode).  sync=False records spans
+    without extra synchronization -- the cheap mode bench.py uses.
+    """
+
+    enabled = True
+
+    def __init__(self, sync: bool = True):
+        self.sync = sync
+        self.t0 = time.perf_counter()
+        self.events = []        # (name, t_rel_s, dur_s, args|None)
+        self.transfer_bytes = 0
+        self.transfer_count = 0
+        self.compiles = []      # (t_rel_s, dur_s)
+        self.counter_samples = []   # (t_rel_s, {name: value})
+
+    # -- recording hooks ----------------------------------------------------
+
+    def span(self, name, **args):
+        """Context manager timing one phase occurrence."""
+        return _Span(self, name, args or None)
+
+    def transfer(self, nbytes, count=1):
+        """Account a device->host transfer of `nbytes` over `count`
+        fetch round trips."""
+        self.transfer_bytes += int(nbytes)
+        self.transfer_count += int(count)
+
+    def compile_event(self, dur_s):
+        self.compiles.append((time.perf_counter() - self.t0 - dur_s,
+                              float(dur_s)))
+
+    def counter_sample(self, values: dict):
+        """Record a snapshot of (already-fetched) device counters."""
+        self.counter_samples.append((time.perf_counter() - self.t0,
+                                     dict(values)))
+
+    # -- aggregation --------------------------------------------------------
+
+    def metrics(self) -> dict:
+        """Aggregate recorded data: per-phase percentiles + totals."""
+        by_phase = {}
+        for name, _t, dur, _a in self.events:
+            by_phase.setdefault(name, []).append(dur)
+        phases = {}
+        for name, durs in sorted(by_phase.items()):
+            durs = sorted(durs)
+            phases[name] = {
+                "count": len(durs),
+                "total_s": round(sum(durs), 6),
+                "p50_ms": round(_pct(durs, 50) * 1e3, 3),
+                "p95_ms": round(_pct(durs, 95) * 1e3, 3),
+                "max_ms": round(durs[-1] * 1e3, 3),
+            }
+        out = {
+            "wall_s": round(time.perf_counter() - self.t0, 3),
+            "phases": phases,
+            "transfers": {"bytes": self.transfer_bytes,
+                          "count": self.transfer_count},
+            "compile": {"count": len(self.compiles),
+                        "total_s": round(sum(d for _t, d in self.compiles),
+                                         3)},
+        }
+        if self.counter_samples:
+            out["device_counters"] = self.counter_samples[-1][1]
+        return out
+
+    # -- artifacts ----------------------------------------------------------
+
+    def trace_events(self) -> list:
+        """The run as Chrome trace-event dicts (ts/dur in microseconds)."""
+        tids = {}
+
+        def tid(name):
+            return tids.setdefault(name, len(tids) + 1)
+
+        evs = []
+        for name, t, dur, args in sorted(self.events, key=lambda e: e[1]):
+            e = {"name": name, "cat": "run", "ph": "X", "pid": 1,
+                 "tid": tid(name), "ts": round(t * 1e6, 3),
+                 "dur": round(dur * 1e6, 3)}
+            if args:
+                e["args"] = args
+            evs.append(e)
+        for t, dur in self.compiles:
+            evs.append({"name": "jit_compile", "cat": "jit", "ph": "X",
+                        "pid": 1, "tid": tid("jit_compile"),
+                        "ts": round(t * 1e6, 3),
+                        "dur": round(dur * 1e6, 3)})
+        for t, values in self.counter_samples:
+            for k, v in values.items():
+                evs.append({"name": k, "cat": "counters", "ph": "C",
+                            "pid": 1, "ts": round(t * 1e6, 3),
+                            "args": {k: v}})
+        meta = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": i,
+                 "args": {"name": n}} for n, i in tids.items()]
+        return meta + evs
+
+    def write_trace(self, path: str):
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.trace_events(),
+                       "displayTimeUnit": "ms"}, f)
+
+    def write_metrics(self, path: str, extra: dict | None = None):
+        m = self.metrics()
+        if extra:
+            m.update(extra)
+        with open(path, "w") as f:
+            json.dump(m, f, indent=2)
+        return m
+
+    def summary_table(self) -> str:
+        """One-screen end-of-run phase breakdown."""
+        m = self.metrics()
+        lines = [f"{'phase':<16s} {'count':>7s} {'total_s':>9s} "
+                 f"{'p50_ms':>9s} {'p95_ms':>9s} {'max_ms':>9s}"]
+        for name, p in m["phases"].items():
+            lines.append(f"{name:<16s} {p['count']:>7d} "
+                         f"{p['total_s']:>9.3f} {p['p50_ms']:>9.3f} "
+                         f"{p['p95_ms']:>9.3f} {p['max_ms']:>9.3f}")
+        t = m["transfers"]
+        c = m["compile"]
+        lines.append(f"transfers: {t['bytes']} bytes in {t['count']} "
+                     f"fetches; jit compiles: {c['count']} "
+                     f"({c['total_s']:.1f}s); wall: {m['wall_s']:.3f}s")
+        dc = m.get("device_counters")
+        if dc:
+            lines.append("device: " + ", ".join(
+                f"{k}={v}" for k, v in dc.items()))
+        return "\n".join(lines)
+
+
+def _pct(sorted_vals, q):
+    """Nearest-rank percentile of an ascending list."""
+    if not sorted_vals:
+        return 0.0
+    i = max(0, min(len(sorted_vals) - 1,
+                   int(round(q / 100 * len(sorted_vals) + 0.5)) - 1))
+    return sorted_vals[i]
+
+
+# ---------------------------------------------------------------------------
+# Device-counter helpers (the TraceCounters block on SimState)
+# ---------------------------------------------------------------------------
+
+
+def ensure_counters(state):
+    """Return `state` with a TraceCounters block installed (idempotent).
+    Changes the state pytree structure, so jitted engine calls recompile
+    once for the counted variant."""
+    if state.tr is None:
+        from .core.state import make_trace_counters
+        state = state.replace(tr=make_trace_counters())
+    return state
+
+
+def fetch_counters(state, profiler=None) -> dict:
+    """ONE device->host fetch of the telemetry scalars + counter block,
+    recorded as a counter sample (and a transfer) on `profiler` (default:
+    the active one).  Safe to call whether or not counters are installed.
+    """
+    import jax
+
+    vals = [state.n_steps, state.n_windows, state.n_events]
+    names = ["microsteps", "windows", "events"]
+    if state.tr is not None:
+        vals += [state.tr.exchanges, state.tr.pkts_exchanged,
+                 state.tr.occ_max]
+        names += ["exchanges", "pkts_exchanged", "inbox_occ_max"]
+    fetched = jax.device_get(vals)
+    out = {n: int(v) for n, v in zip(names, fetched)}
+    if state.tr is not None:
+        ki = state.inbox.capacity // state.hosts.num_hosts
+        out["inbox_occ_frac"] = round(out["inbox_occ_max"] / max(ki, 1), 4)
+    p = profiler if profiler is not None else _active
+    p.transfer(sum(getattr(v, "nbytes", 8) for v in fetched), count=1)
+    p.counter_sample(out)
+    return out

@@ -601,7 +601,7 @@ def process_arrivals(state, params, em, tick_t, pkt, mask,
     # tcp.c:192-205, tcp_retransmit_tally.cc:177-285): fold the advertised
     # blocks into the sender scoreboard; retransmission skips them.
     # HEADER-PREDICTION GATE: the insert's sort+merge pass is ~1.3-2ms at
-    # 10k hosts (tools/stepprof_onion.py round-4 profile: the two
+    # 10k hosts (round-4 phase profile, now tools/phaseprof.py: the two
     # scoreboard inserts were ~all of the 13.7ms rx phase), while segments
     # actually CARRYING SACK blocks only exist after loss.  Skip the whole
     # pass unless some arrival advertises a block; the skip is exact --

@@ -1,0 +1,131 @@
+"""observe.py coverage: LogDrain ring-wrap overflow and Tracker
+per-host heartbeat cadence.
+
+Both drive the host-side drain/diff logic directly with hand-built
+device blocks -- no engine runs -- so these are cheap tier-1 tests.
+"""
+
+import types
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow1_tpu import observe
+from shadow1_tpu.core import simtime
+from shadow1_tpu.core.state import I32, I64, make_host_table, make_log_ring
+
+SEC = simtime.SIMTIME_ONE_SECOND
+
+
+def _ring_with(records, capacity):
+    """LogRing holding `records` appended in order: record i lands at
+    slot i % capacity, exactly as the device-side append does."""
+    ring = make_log_ring(capacity)
+    t = np.zeros(capacity, np.int64)
+    host = np.zeros(capacity, np.int32)
+    code = np.zeros(capacity, np.int32)
+    arg = np.zeros(capacity, np.int32)
+    for i, (t_ns, h, c, a) in enumerate(records):
+        t[i % capacity] = t_ns
+        host[i % capacity] = h
+        code[i % capacity] = c
+        arg[i % capacity] = a
+    return ring.replace(time=jnp.asarray(t), host=jnp.asarray(host),
+                        code=jnp.asarray(code), arg=jnp.asarray(arg),
+                        total=jnp.asarray(len(records), I64))
+
+
+class TestLogDrainOverflow:
+    def test_ring_wrap_reports_lost_and_keeps_survivors(self, tmp_path):
+        # 12 appends into a capacity-8 ring between drains: the first 4
+        # are overwritten; the drain must say so and emit the surviving
+        # 8 in sim-time order with correct host/arg decoding.
+        cap = 8
+        recs = [(i * SEC, i % 2, 5, i) for i in range(12)]
+        state = types.SimpleNamespace(log=_ring_with(recs, cap))
+        drain = observe.LogDrain(str(tmp_path / "sim.log"), ["a", "b"])
+        n = drain.drain(state)
+        drain.close()
+        assert n == 12  # all appends accounted for, including lost ones
+        lines = (tmp_path / "sim.log").read_text().splitlines()
+        assert lines[0] == f"[log] WARNING: 4 records lost (ring capacity {cap})"
+        body = lines[1:]
+        assert len(body) == cap
+        # Survivors are records 4..11, sim-time ordered.
+        for line, i in zip(body, range(4, 12)):
+            assert line.startswith(f"[{i:13.9f}] [{'ab'[i % 2]}] ")
+            assert f"from host {i}" in line
+
+    def test_no_overflow_no_warning(self, tmp_path):
+        recs = [(i * SEC, 0, 6, i) for i in range(5)]
+        state = types.SimpleNamespace(log=_ring_with(recs, 8))
+        drain = observe.LogDrain(str(tmp_path / "sim.log"), ["a"])
+        assert drain.drain(state) == 5
+        drain.close()
+        lines = (tmp_path / "sim.log").read_text().splitlines()
+        assert len(lines) == 5
+        assert not any("WARNING" in ln for ln in lines)
+
+    def test_incremental_drain_counts(self, tmp_path):
+        # Second drain only emits the delta; re-draining an unchanged
+        # ring is a no-op.
+        recs = [(i * SEC, 0, 6, i) for i in range(3)]
+        drain = observe.LogDrain(str(tmp_path / "sim.log"), ["a"])
+        assert drain.drain(
+            types.SimpleNamespace(log=_ring_with(recs, 8))) == 3
+        more = recs + [(i * SEC, 0, 6, i) for i in range(3, 5)]
+        grown = types.SimpleNamespace(log=_ring_with(more, 8))
+        assert drain.drain(grown) == 2
+        assert drain.drain(grown) == 0
+        drain.close()
+        assert len((tmp_path / "sim.log").read_text().splitlines()) == 5
+
+    def test_oversized_append_lost_counter(self, tmp_path):
+        # lg.lost counts records the DEVICE dropped because one append
+        # exceeded capacity; reported once per increment.
+        ring = _ring_with([(SEC, 0, 6, 1)], 8).replace(
+            lost=jnp.asarray(3, I64))
+        state = types.SimpleNamespace(log=ring)
+        drain = observe.LogDrain(str(tmp_path / "sim.log"), ["a"])
+        drain.drain(state)
+        drain.drain(state)  # same lost count: no duplicate warning
+        drain.close()
+        lines = (tmp_path / "sim.log").read_text().splitlines()
+        warns = [ln for ln in lines if "WARNING" in ln]
+        assert warns == [
+            "[log] WARNING: 3 records lost inside oversized appends"]
+
+
+def _state_with_bytes(n, per_host_bytes):
+    hosts = make_host_table(n).replace(
+        bytes_sent=jnp.asarray(per_host_bytes, I64))
+    return types.SimpleNamespace(hosts=hosts)
+
+
+class TestTrackerCadence:
+    def test_per_host_cadence_accumulates_deltas(self, tmp_path):
+        # Host h1 on a 5s cadence must accumulate 5s of deltas per row
+        # (rate stays 100 B/s), not lose the skipped seconds' deltas
+        # (which would read 20 B/s) nor double-count them.
+        tr = observe.Tracker(str(tmp_path), ["h0", "h1"], interval_s=1,
+                             per_host_interval_s=[0, 5])
+        for t in range(1, 7):  # 100 B/s per host, sampled each second
+            tr.heartbeat(_state_with_bytes(2, [100 * t, 100 * t]),
+                         t * SEC)
+        rows = {}
+        for line in open(tr.path).readlines()[1:]:
+            cols = line.strip().split(",")
+            rows.setdefault(cols[1], []).append(
+                (float(cols[0]), float(cols[2])))
+        assert len(rows["h0"]) == 6  # global 1s cadence: a row per beat
+        assert all(rate == 100.0 for _t, rate in rows["h0"])
+        # h1: first row at t=1 (dt=1s), next at t=6 (dt=5s, delta=500).
+        assert [t for t, _ in rows["h1"]] == [1.0, 6.0]
+        assert [r for _, r in rows["h1"]] == [100.0, 100.0]
+
+    def test_sample_interval_tracks_finest_host(self, tmp_path):
+        # A host asking for finer-than-global rows drives the run-loop
+        # sampling cadence (else it silently got the coarse cadence).
+        tr = observe.Tracker(str(tmp_path), ["a", "b"], interval_s=5,
+                             per_host_interval_s=[1, 0])
+        assert tr.sample_interval_ns == SEC

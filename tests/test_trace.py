@@ -1,0 +1,242 @@
+"""Tracing/metrics subsystem tests (trace.py + --profile + benchdiff).
+
+Validates the three profiler artifacts -- trace.json (Chrome
+trace-event format), metrics.json (per-phase aggregates), summary
+table -- plus the device-side counter block's neutrality (counters
+must not change the simulated trajectory) and the benchdiff gate
+(nonzero exit on an injected regression).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from shadow1_tpu import sim, trace
+from shadow1_tpu.core import simtime
+
+SEC = simtime.SIMTIME_ONE_SECOND
+MS = simtime.SIMTIME_ONE_MILLISECOND
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_phold(**kw):
+    return sim.build_phold(num_hosts=8, msgs_per_host=2,
+                           mean_delay_ns=10 * MS, stop_time=SEC,
+                           pool_capacity=8 * 8, **kw)
+
+
+def _validate_chrome_trace(doc):
+    """Well-formed Chrome trace-event JSON: the checks Perfetto's loader
+    relies on (events list; X events carry ts+dur; C events carry args).
+    """
+    assert isinstance(doc, dict) and isinstance(doc["traceEvents"], list)
+    assert doc["traceEvents"], "empty trace"
+    for e in doc["traceEvents"]:
+        assert isinstance(e["name"], str) and e["ph"] in ("X", "C", "M")
+        if e["ph"] == "X":
+            assert isinstance(e["ts"], (int, float))
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        if e["ph"] == "C":
+            assert isinstance(e["args"], dict)
+    return doc
+
+
+class TestProfiler:
+    def test_spans_and_metrics(self):
+        prof = trace.Profiler()
+        with prof.span("phase_a"):
+            pass
+        for _ in range(3):
+            with prof.span("phase_b", detail=1):
+                pass
+        prof.transfer(1024, count=2)
+        m = prof.metrics()
+        assert m["phases"]["phase_a"]["count"] == 1
+        b = m["phases"]["phase_b"]
+        assert b["count"] == 3
+        assert 0 <= b["p50_ms"] <= b["p95_ms"] <= b["max_ms"]
+        assert m["transfers"] == {"bytes": 1024, "count": 2}
+        assert "count" in m["compile"]
+        table = prof.summary_table()
+        assert "phase_b" in table and "transfers: 1024 bytes" in table
+
+    def test_compile_hook_counts_jit_compiles(self):
+        prof = trace.install(trace.Profiler())
+        try:
+            # A fresh computation forces a backend compile (in-process jit
+            # caches are cleared per test module by conftest, and tiny
+            # compiles sit below the persistent-cache threshold).
+            f = jax.jit(lambda x: (x * 3 + 1).sum())
+            f(jnp.arange(37)).block_until_ready()
+        finally:
+            trace.install(None)
+        assert len(prof.compiles) >= 1
+        assert all(d >= 0 for _t, d in prof.compiles)
+
+    def test_null_profiler_is_default_and_inert(self):
+        p = trace.current()
+        assert not p.enabled
+        with p.span("x"):
+            p.transfer(10)
+
+
+class TestProfiledRun:
+    def test_phold_profile_artifacts(self, tmp_path):
+        state, params, app = _tiny_phold()
+        prof = trace.Profiler()
+        out = sim.run(state, params, app, until=200 * MS, profiler=prof)
+        assert trace.current() is not prof, "profiler must uninstall"
+        assert int(out.n_steps) > 0
+
+        # Device counter block: fetched, coherent, in the metrics.
+        m = prof.metrics()
+        dc = m["device_counters"]
+        assert dc["microsteps"] == int(out.n_steps)
+        assert dc["windows"] == int(out.n_windows)
+        assert dc["exchanges"] >= 1
+        assert dc["pkts_exchanged"] >= 1
+        assert 0 < dc["inbox_occ_max"] <= out.inbox.capacity // 8
+        assert 0 < dc["inbox_occ_frac"] <= 1
+
+        # Host-side phases: at least one device_step span, p50<=p95<=max.
+        ds = m["phases"]["device_step"]
+        assert ds["count"] >= 1
+        assert ds["p50_ms"] <= ds["p95_ms"] <= ds["max_ms"]
+        assert m["transfers"]["bytes"] > 0
+
+        # Artifacts round-trip.
+        tp, mp = tmp_path / "trace.json", tmp_path / "metrics.json"
+        prof.write_trace(str(tp))
+        prof.write_metrics(str(mp))
+        doc = _validate_chrome_trace(json.loads(tp.read_text()))
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "device_step" in names
+        assert "microsteps" in names  # counter track
+        m2 = json.loads(mp.read_text())
+        for key in ("phases", "transfers", "compile", "wall_s"):
+            assert key in m2
+
+    def test_counters_do_not_change_trajectory(self):
+        state, params, app = _tiny_phold()
+        plain = sim.run(state, params, app, until=200 * MS)
+        counted = sim.run(trace.ensure_counters(state), params, app,
+                          until=200 * MS)
+        assert int(plain.n_steps) == int(counted.n_steps)
+        assert jnp.array_equal(plain.app.sent, counted.app.sent)
+        assert jnp.array_equal(plain.app.recv, counted.app.recv)
+        assert jnp.array_equal(plain.hosts.pkts_recv,
+                               counted.hosts.pkts_recv)
+
+    def test_rx_batch_is_explicit_and_hash_distinct(self):
+        _s, _p, serial = _tiny_phold()
+        _s2, _p2, batched = _tiny_phold(rx_batch=2)
+        assert serial.rx_batch == 1, "phold defaults to serial arrivals"
+        assert batched.rx_batch == 2
+        assert hash(serial) != hash(batched) and serial != batched
+
+
+class TestProfileCli:
+    def test_tgen_profile_run(self, tmp_path):
+        from shadow1_tpu import cli
+
+        cfg = os.path.join(REPO, "examples", "tgen-2host",
+                           "shadow.config.xml")
+        rc = cli.main(["run", cfg, "--stop-time", "4", "--quiet",
+                       "--data-directory", str(tmp_path), "--profile"])
+        assert rc == 0
+        doc = _validate_chrome_trace(
+            json.loads((tmp_path / "trace.json").read_text()))
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "device_step" in names and "heartbeat" in names
+        m = json.loads((tmp_path / "metrics.json").read_text())
+        for key in ("phases", "transfers", "compile", "device_counters"):
+            assert key in m
+        for p in m["phases"].values():
+            for k in ("count", "total_s", "p50_ms", "p95_ms", "max_ms"):
+                assert k in p
+        assert m["transfers"]["bytes"] > 0
+        assert m["device_counters"]["microsteps"] > 0
+
+    def test_profile_requires_data_directory(self, capsys):
+        from shadow1_tpu import cli
+
+        cfg = os.path.join(REPO, "examples", "tgen-2host",
+                           "shadow.config.xml")
+        rc = cli.main(["run", cfg, "--profile"])
+        assert rc == 2
+
+
+def _benchdiff():
+    spec = importlib.util.spec_from_file_location(
+        "benchdiff", os.path.join(REPO, "tools", "benchdiff.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestBenchDiff:
+    OLD = {"metric": "phold_events_per_sec", "value": 1000.0,
+           "wall_sec": 10.0, "events_per_microstep": 40.0,
+           "profile": {"phases": {"device_step": {
+               "count": 5, "total_s": 9.0, "p50_ms": 100.0,
+               "p95_ms": 120.0, "max_ms": 130.0}}}}
+
+    def _write(self, tmp_path, name, data):
+        p = tmp_path / name
+        p.write_text(json.dumps(data))
+        return str(p)
+
+    def test_flags_injected_20pct_slowdown(self, tmp_path):
+        new = json.loads(json.dumps(self.OLD))
+        new["value"] = 800.0          # -20% throughput
+        new["wall_sec"] = 12.0        # +20% wall
+        bd = _benchdiff()
+        rc = bd.main([self._write(tmp_path, "old.json", self.OLD),
+                      self._write(tmp_path, "new.json", new),
+                      "--threshold", "10"])
+        assert rc == 1
+
+    def test_passes_when_within_threshold(self, tmp_path):
+        new = json.loads(json.dumps(self.OLD))
+        new["value"] = 980.0  # -2%
+        bd = _benchdiff()
+        rc = bd.main([self._write(tmp_path, "old.json", self.OLD),
+                      self._write(tmp_path, "new.json", new),
+                      "--threshold", "10"])
+        assert rc == 0
+
+    def test_improvement_never_flags(self, tmp_path):
+        new = json.loads(json.dumps(self.OLD))
+        new["value"] = 2000.0   # +100% throughput
+        new["wall_sec"] = 5.0   # -50% wall
+        new["profile"]["phases"]["device_step"]["p50_ms"] = 50.0
+        bd = _benchdiff()
+        rc = bd.main([self._write(tmp_path, "old.json", self.OLD),
+                      self._write(tmp_path, "new.json", new)])
+        assert rc == 0
+
+    def test_phase_regression_in_metrics_files(self, tmp_path):
+        old = {"wall_s": 10.0, "phases": {"device_step": {
+            "count": 5, "total_s": 9.0, "p50_ms": 100.0, "p95_ms": 120.0,
+            "max_ms": 130.0}}}
+        new = json.loads(json.dumps(old))
+        new["phases"]["device_step"]["p50_ms"] = 125.0  # +25%
+        bd = _benchdiff()
+        rc = bd.main([self._write(tmp_path, "m0.json", old),
+                      self._write(tmp_path, "m1.json", new),
+                      "--threshold", "20"])
+        assert rc == 1
+
+    def test_unwraps_recorded_bench_json(self, tmp_path):
+        wrapped = {"exit_code": 0, "parsed": self.OLD}
+        new = json.loads(json.dumps(self.OLD))
+        new["value"] = 700.0
+        bd = _benchdiff()
+        rc = bd.main([self._write(tmp_path, "r.json", wrapped),
+                      self._write(tmp_path, "n.json", new)])
+        assert rc == 1

@@ -1,0 +1,118 @@
+"""Diff two bench/metrics JSON files and flag regressions.
+
+The one supported path for cross-round performance comparison (replaces
+the ad-hoc stepprof scripts):
+
+    python tools/benchdiff.py OLD.json NEW.json [--threshold PCT]
+
+Accepts any of:
+
+* a bench.py output line ({"metric", "value", "wall_sec", ...}),
+* a recorded BENCH_r{N}.json (the same JSON under a "parsed" key),
+* a metrics.json written by a --profile run (trace.Profiler.metrics()).
+
+Direction-aware comparison: throughput metrics (events/sec) regress when
+they go DOWN; latency/wall metrics (wall_sec, per-phase p50/p95) regress
+when they go UP.  Any regression beyond --threshold percent prints a
+flagged row and exits nonzero, so CI / future rounds can gate on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# metric-name suffix -> direction ("up" = bigger is better).
+_HIGHER_BETTER = ("events_per_sec", "value", "vs_baseline",
+                  "events_per_microstep")
+_LOWER_BETTER = ("wall_sec", "wall_s", "p50_ms", "p95_ms", "max_ms",
+                 "total_s", "compile_s")
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    # Recorded BENCH_r{N}.json wraps bench.py's line under "parsed".
+    if isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    return data
+
+
+def _flatten(d: dict, prefix: str = "") -> dict:
+    """Flatten nested dicts to dotted scalar paths, numbers only."""
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "."))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[key] = float(v)
+    return out
+
+
+def _direction(name: str):
+    """'up' (bigger better), 'down' (smaller better), or None (info)."""
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf in _HIGHER_BETTER:
+        return "up"
+    if leaf in _LOWER_BETTER:
+        return "down"
+    return None
+
+
+def diff(old: dict, new: dict, threshold_pct: float):
+    """Compare shared numeric metrics; return (rows, regressions).
+
+    rows: (name, old, new, pct_change, flag) for every shared directional
+    metric; regressions: the flagged subset."""
+    fo, fn = _flatten(old), _flatten(new)
+    rows, regressions = [], []
+    for name in sorted(set(fo) & set(fn)):
+        d = _direction(name)
+        if d is None:
+            continue
+        a, b = fo[name], fn[name]
+        if a == 0:
+            continue
+        pct = (b - a) / abs(a) * 100
+        worse = -pct if d == "up" else pct
+        flag = worse > threshold_pct
+        rows.append((name, a, b, pct, flag))
+        if flag:
+            regressions.append((name, a, b, pct))
+    return rows, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two bench/metrics JSONs; exit 1 on regression")
+    ap.add_argument("old", help="baseline JSON (bench line, BENCH_r{N}, "
+                                "or metrics.json)")
+    ap.add_argument("new", help="candidate JSON")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    help="regression threshold in percent (default 10)")
+    args = ap.parse_args(argv)
+
+    old, new = _load(args.old), _load(args.new)
+    rows, regressions = diff(old, new, args.threshold)
+    if not rows:
+        print("benchdiff: no shared directional metrics between the two "
+              "files", file=sys.stderr)
+        return 2
+
+    w = max(len(r[0]) for r in rows)
+    print(f"{'metric':<{w}s} {'old':>14s} {'new':>14s} {'change':>9s}")
+    for name, a, b, pct, flag in rows:
+        mark = "  <-- REGRESSION" if flag else ""
+        print(f"{name:<{w}s} {a:>14.3f} {b:>14.3f} {pct:>+8.1f}%{mark}")
+    if regressions:
+        print(f"\n{len(regressions)} regression(s) beyond "
+              f"{args.threshold:.0f}%", file=sys.stderr)
+        return 1
+    print(f"\nno regressions beyond {args.threshold:.0f}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

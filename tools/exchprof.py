@@ -57,7 +57,7 @@ def main():
         num_hosts=NUM_HOSTS, msgs_per_host=4,
         mean_delay_ns=10 * simtime.SIMTIME_ONE_MILLISECOND,
         stop_time=10 * simtime.SIMTIME_ONE_SECOND,
-        pool_capacity=NUM_HOSTS * 8)
+        pool_capacity=NUM_HOSTS * 8, rx_batch=2)  # bench world config
     state = engine.run_until(state, params, app,
                              50 * simtime.SIMTIME_ONE_MILLISECOND)
     jax.block_until_ready(state)

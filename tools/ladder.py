@@ -58,7 +58,8 @@ def rung_tgen(path: str, warm_s: int = 1):
 def rung_phold():
     s, p, a = sim.build_phold(num_hosts=16384, msgs_per_host=4,
                               stop_time=10 * SEC,
-                              pool_capacity=16384 * 8)
+                              pool_capacity=16384 * 8,
+                              rx_batch=2)  # measured ladder config
     res, out = _measure(s, p, a, 1, 2)
     res["events"] = int(out.app.sent.sum() + out.app.recv.sum())
     return res

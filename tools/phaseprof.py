@@ -1,0 +1,253 @@
+"""Phase-level profile of the engine micro-step: the one supported
+slope/ablation harness (consolidates the former stepprof, stepprof2 and
+stepprof_onion scripts).
+
+Two attribution methods over the same busy-state worlds:
+
+* subsets -- time while-loops of increasing phase subsets (slope method,
+  50 vs 200 iterations); each phase's cost is the delta from the
+  previous subset.  Fast, but partial graphs can fuse differently than
+  the real step.
+* ablate -- time the FULL micro-step with single phases no-op'd
+  (monkeypatched before trace), so each phase's cost is a delta from the
+  same full-step baseline.  Slower, more faithful.
+
+Also times the window-boundary exchange as its own forced loop.
+
+    python tools/phaseprof.py --world phold --hosts 16384
+    python tools/phaseprof.py --world onion --circuits 2000 --method ablate
+
+For whole-run wall-time attribution (device launches vs drains vs
+compiles) use `--profile` on the CLI or trace.Profiler instead; this
+tool is for intra-step phase cost on a live backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import shadow1_tpu  # noqa: F401  (x64)
+import jax
+import jax.numpy as jnp
+
+from shadow1_tpu import sim
+from shadow1_tpu.core import emit, engine, simtime
+
+I32, I64 = jnp.int32, jnp.int64
+SEC = simtime.SIMTIME_ONE_SECOND
+MS = simtime.SIMTIME_ONE_MILLISECOND
+
+
+def timeloop(name, state0, params, app, body, iters_pair=(50, 200),
+             trials=3):
+    """Slope-time `body` (state, t_h) -> (state, t_h): ms per iteration
+    from the (iters_pair[1] - iters_pair[0]) wall-time difference."""
+    res = {}
+    for iters in iters_pair:
+        def run(st, th):
+            def cond(c):
+                return c[0] < iters
+
+            def b(c):
+                i, s, t = c
+                s, t = body(s, t)
+                return i + 1, s, t
+
+            return jax.lax.while_loop(cond, b,
+                                      (jnp.asarray(0, I32), st, th))
+
+        jf = jax.jit(run)
+        th0, _ = engine._scan_all(state0, params, app)
+        out = jf(state0, th0)
+        np.asarray(out[1].now)
+        ts = []
+        for trial in range(trials):
+            st2 = state0.replace(now=state0.now + trial)
+            t0 = time.perf_counter()
+            out = jf(st2, th0)
+            np.asarray(out[1].now)
+            ts.append(time.perf_counter() - t0)
+        res[iters] = min(ts)
+    slope = (res[iters_pair[1]] - res[iters_pair[0]]) \
+        / (iters_pair[1] - iters_pair[0]) * 1e3
+    print(f"{name:44s} {slope:8.3f} ms/iter", flush=True)
+    return slope
+
+
+def _build(args):
+    if args.world == "phold":
+        state, params, app = sim.build_phold(
+            num_hosts=args.hosts, msgs_per_host=4,
+            mean_delay_ns=10 * MS, stop_time=10 * SEC,
+            pool_capacity=args.hosts * 8, rx_batch=2)
+        warm_t = 50 * MS
+        we = jnp.asarray(10 * SEC, I64)
+    else:
+        state, params, app = sim.build_onion(
+            num_circuits=args.circuits, bytes_per_circuit=1 << 20,
+            pool_slab=64, stop_time=120 * SEC)
+        # Into the busy phase: clients started, streams flowing.
+        warm_t = args.warm_ms * MS
+        we = jnp.asarray(120 * SEC, I64)
+    state = engine.run_until(state, params, app, warm_t)
+    jax.block_until_ready(state)
+    print(f"world={args.world} hosts={state.hosts.num_hosts} "
+          f"steps_so_far={int(state.n_steps)}")
+    return state, params, app, we
+
+
+def _subset_bodies(state, params, app, we):
+    """(name, body) pairs of increasing phase subsets, world-aware."""
+    h = state.hosts.num_hosts
+    uses_tcp = engine._uses_tcp(app)
+    if uses_tcp:
+        from shadow1_tpu.transport import tcp as tcp_mod
+        n_lanes = emit.NUM_SLOTS + max(0, int(getattr(app, "rx_batch", 1))
+                                       - 1)
+    else:
+        n_lanes = emit.SLOT_APP + max(1, int(getattr(app, "app_tx_lanes",
+                                                     1)))
+
+    def scan(s):
+        return engine._scan_all(s, params, app)
+
+    def base(s, th):
+        active = th < we
+        tick = jnp.where(active, th, we)
+        return s, emit.empty(h, n_lanes), tick, active
+
+    def v_scan(s, th):
+        s = s.replace(hosts=s.hosts.replace(
+            t_resume=jnp.minimum(s.hosts.t_resume, th)))
+        th2, _ = scan(s)
+        return s, th2
+
+    def stack(*stages):
+        """Body running rx + the given post-rx stages, then scan."""
+        def body(s, th):
+            s, em, tick, active = base(s, th)
+            s, em, _d, tp = engine._rx_phase(s, params, em, tick, active,
+                                             app, we)
+            for st in stages:
+                s, em = st(s, em, tp, active)
+            th2, _ = scan(s)
+            return s, th2
+        return body
+
+    def s_app(s, em, tp, active):
+        if getattr(app, "wants_window_end", False):
+            return app.on_tick(s, params, em, tp, active, window_end=we)
+        return app.on_tick(s, params, em, tp, active)
+
+    def s_stage(s, em, tp, active):
+        s, _p = engine._stage_emissions(s, params, em, tp, active, app)
+        return s, em
+
+    def v_full(s, th):
+        s = engine._microstep_core(s, params, app, th, we)
+        th2, _ = scan(s)
+        return s, th2
+
+    out = [("scan only", v_scan), ("+ rx_phase", stack())]
+    if uses_tcp:
+        def s_timers(s, em, tp, active):
+            return tcp_mod.run_timers(s, params, em, tp, active)
+
+        def s_tx(s, em, tp, active):
+            return tcp_mod.transmit(s, params, em, tp, active)
+
+        out += [("+ tcp timers", stack(s_timers)),
+                ("+ app on_tick", stack(s_timers, s_app)),
+                ("+ tcp transmit", stack(s_timers, s_app, s_tx)),
+                ("+ stage_emissions", stack(s_timers, s_app, s_tx,
+                                            s_stage))]
+    else:
+        out += [("+ app on_tick", stack(s_app)),
+                ("+ stage_emissions", stack(s_app, s_stage))]
+    out.append(("full microstep (+tx_drain)", v_full))
+    return out
+
+
+def run_subsets(state, params, app, we):
+    t = {}
+    prev = None
+    for name, body in _subset_bodies(state, params, app, we):
+        t[name] = timeloop(name, state, params, app, body)
+        if prev is not None:
+            print(f"{'':44s} {t[name] - prev:+8.3f} delta")
+        prev = t[name]
+    return t
+
+
+def run_ablate(state, params, app, we):
+    """Full-step baseline minus single-phase no-ops (patched before
+    trace), so each cost is a delta from the SAME fused graph."""
+    def v_full(s, th):
+        s = engine._microstep_core(s, params, app, th, we)
+        th2, _ = engine._scan_all(s, params, app)
+        return s, th2
+
+    base = timeloop("full microstep + scan", state, params, app, v_full)
+
+    def with_patches(patches):
+        saved = {name: getattr(engine, name) for name in patches}
+        for name, fn in patches.items():
+            setattr(engine, name, fn)
+        try:
+            return timeloop(f"full - {'/'.join(patches)}", state, params,
+                            app, v_full)
+        finally:
+            for name, fn in saved.items():
+                setattr(engine, name, fn)
+
+    no_tx = with_patches({"_tx_drain":
+                          lambda s, params, tick_t, active: s})
+    no_stage = with_patches({"_stage_emissions":
+                             lambda s, params, em, tick_t, active, app:
+                             (s, jnp.zeros_like(em.valid))})
+    no_rx = with_patches({"_rx_phase":
+                          lambda s, params, em, tick_t, active, app, we2:
+                          (s, em, jnp.zeros(
+                              (s.hosts.num_hosts,), I32), tick_t)})
+
+    print(f"{'=> tx_drain':44s} {base - no_tx:8.3f} ms")
+    print(f"{'=> stage_emissions':44s} {base - no_stage:8.3f} ms")
+    print(f"{'=> rx_phase':44s} {base - no_rx:8.3f} ms")
+
+
+def run_exchange(state, params, app):
+    def v_exch(s, th):
+        s = engine._exchange_body(s, params)
+        # data dependence so iterations don't collapse
+        s = s.replace(now=s.now + 1)
+        return s, th
+
+    timeloop("exchange_body (forced)", state, params, app, v_exch)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--world", choices=("phold", "onion"), default="phold")
+    ap.add_argument("--hosts", type=int, default=16384,
+                    help="phold world size")
+    ap.add_argument("--circuits", type=int, default=2000,
+                    help="onion world size (hosts = 5 x circuits)")
+    ap.add_argument("--warm-ms", type=int, default=500,
+                    help="sim-ms to advance before timing (busy state)")
+    ap.add_argument("--method", choices=("subsets", "ablate", "both"),
+                    default="subsets")
+    args = ap.parse_args(argv)
+
+    state, params, app, we = _build(args)
+    if args.method in ("subsets", "both"):
+        run_subsets(state, params, app, we)
+    if args.method in ("ablate", "both"):
+        run_ablate(state, params, app, we)
+    run_exchange(state, params, app)
+
+
+if __name__ == "__main__":
+    main()
