@@ -56,7 +56,7 @@ MEAN_DELAY_NS = 10 * simtime.SIMTIME_ONE_MILLISECOND
 SIM_SECONDS = 2
 
 
-def main():
+def main(churn: float | None = None, churn_downtime_s: float = 5.0):
     # The benchmark opts into arrival batching explicitly (rx_batch=2,
     # the measured sweet spot); the app default is serial rx_batch=1.
     # The batching config rides the JSON so recorded rounds are
@@ -69,6 +69,16 @@ def main():
         pool_capacity=NUM_HOSTS * 8,
         rx_batch=2,
     )
+
+    # Optional fault injection (--churn): measures the engine under host
+    # flapping.  The netem settings ride the config block so benchdiff
+    # refuses to compare a churned run against a clean one.
+    netem_cfg = None
+    if churn:
+        state, params = sim.add_churn(state, params, churn,
+                                      mean_down_s=churn_downtime_s)
+        netem_cfg = {"churn_rate": churn,
+                     "churn_downtime_s": churn_downtime_s}
 
     # Always-on cheap counters (trace.py): the device-side block adds
     # per-window aggregates to every recorded BENCH JSON, and the async
@@ -126,6 +136,7 @@ def main():
             "sim_seconds": SIM_SECONDS,
             "rx_batch": app.rx_batch,
             "app_tx_lanes": int(getattr(app, "app_tx_lanes", 1)),
+            "netem": netem_cfg,
         },
         "profile": {
             "phases": metrics["phases"],
@@ -137,14 +148,22 @@ def main():
 
 
 if __name__ == "__main__":
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--churn", type=float, default=None, metavar="RATE",
+                    help="run under netem chaos: mean host flaps per "
+                         "second (recorded in the JSON config block)")
+    ap.add_argument("--churn-downtime", type=float, default=5.0,
+                    metavar="SECONDS", help="mean down-time per flap")
+    ns = ap.parse_args()
     # The TPU tunnel's compile service occasionally drops a request
     # ("response body closed", "TPU device error"); one retry rides out
     # such transients so a flaky RPC doesn't record a failed round.
     try:
-        main()
+        main(ns.churn, ns.churn_downtime)
     except Exception:  # noqa: BLE001
         import traceback
         print("bench attempt 1 failed; retrying", file=sys.stderr)
         traceback.print_exc()
         time.sleep(20)
-        main()
+        main(ns.churn, ns.churn_downtime)

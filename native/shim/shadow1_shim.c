@@ -117,6 +117,11 @@ static int g_vfd_nonblock[MAX_VFD];
  * nonblocking connect's failure is observable the way libc callers
  * expect: poll -> POLLERR/POLLOUT -> getsockopt(SO_ERROR). */
 static int g_vfd_soerr[MAX_VFD];
+/* Extra aliases per vfd beyond the first (dup/dup2/dup3): close() only
+ * tears the bridge socket down (OP_CLOSE) when the LAST alias goes --
+ * the reference refcounts descriptor handles the same way
+ * (descriptor.c ref/unref). */
+static int g_vfd_refs[MAX_VFD];
 
 typedef struct {
   int used;
@@ -517,6 +522,8 @@ ssize_t recv(int fd, void *buf, size_t n, int flags) {
 static ssize_t efd_read(int fd, void *buf, size_t n);
 static ssize_t efd_write(int fd, const void *buf, size_t n);
 static int is_efd_fwd(int fd);
+static int efd_poll_fill(struct pollfd *fds, nfds_t nfds);
+static void efd_release(int fd);
 
 ssize_t read(int fd, void *buf, size_t n) {
   fd = vfd_promote(fd);
@@ -541,6 +548,10 @@ int close(int fd) {
     fd = v;
   }
   if (is_vfd(fd)) {
+    if (g_vfd_refs[fd - VFD_BASE] > 0) {
+      g_vfd_refs[fd - VFD_BASE]--;  /* another alias still references it */
+      return 0;
+    }
     g_vfd_open[fd - VFD_BASE] = 0;
     req_t rq = {.op = OP_CLOSE, .fd = fd, .len = 0};
     rep_t rp;
@@ -554,7 +565,85 @@ int close(int fd) {
     g_tfd[fd - TFD_BASE].used = 0;  /* timerfd is shim-local */
     return 0;
   }
+  if (is_efd_fwd(fd)) {
+    efd_release(fd);  /* eventfd is shim-local */
+    return 0;
+  }
   return real_close(fd);
+}
+
+/* dup family over virtual sockets: each duplicate is one more low-fd
+ * alias of the same vfd; the bridge socket survives until the LAST
+ * alias closes (g_vfd_refs).  Shim-local timerfd/eventfd/epoll objects
+ * have no alias machinery -- duplicating one fails loudly rather than
+ * handing back a kernel fd that routes nowhere. */
+static int shimlocal_nodup(int fd, const char *who) {
+  if (is_tfd(fd) || is_efd_fwd(fd) ||
+      (fd >= EPFD_BASE && fd < EPFD_BASE + MAX_EPFD)) {
+    fprintf(stderr, "[shadow1-shim] %s(%d): duplicating a virtual "
+                    "timerfd/eventfd/epoll fd is not supported\n",
+            who, fd);
+    errno = EBADF;
+    return 1;
+  }
+  return 0;
+}
+
+int dup(int fd) {
+  int v = vfd_promote(fd);
+  if (is_vfd(v)) {
+    int a = alias_install((int64_t)v);  /* may fall back to the raw id */
+    g_vfd_refs[v - VFD_BASE]++;
+    return a;
+  }
+  if (shimlocal_nodup(v, "dup")) return -1;
+  static int (*real_dup)(int);
+  if (!real_dup) real_dup = dlsym(RTLD_NEXT, "dup");
+  return real_dup(fd);
+}
+
+static int dup2_impl(int oldfd, int newfd, const char *who) {
+  int v = vfd_promote(oldfd);
+  if (is_vfd(v)) {
+    if (newfd == oldfd) return newfd;
+    if (newfd < 0 || newfd >= MAX_ALIAS) {
+      errno = EBADF;
+      return -1;
+    }
+    if (vfd_promote(newfd) == v) return newfd;  /* already that alias */
+    close(newfd);  /* releases whatever lived there (alias or real) */
+    /* Pin the target number with a reserved kernel fd, then point the
+     * alias table at the vfd. */
+    int nul = open("/dev/null", O_RDONLY | O_CLOEXEC);
+    if (nul != newfd) {
+      static int (*real_dup2)(int, int);
+      if (!real_dup2) real_dup2 = dlsym(RTLD_NEXT, "dup2");
+      if (nul < 0 || real_dup2(nul, newfd) < 0) {
+        if (nul >= 0) real_close(nul);
+        errno = EBADF;
+        return -1;
+      }
+      real_close(nul);
+    }
+    g_alias2vfd[newfd] = v;
+    g_vfd_refs[v - VFD_BASE]++;
+    return newfd;
+  }
+  if (shimlocal_nodup(v, who)) return -1;
+  static int (*real_d2)(int, int);
+  if (!real_d2) real_d2 = dlsym(RTLD_NEXT, "dup2");
+  return real_d2(oldfd, newfd);
+}
+
+int dup2(int oldfd, int newfd) { return dup2_impl(oldfd, newfd, "dup2"); }
+
+int dup3(int oldfd, int newfd, int flags) {
+  (void)flags;  /* O_CLOEXEC is moot: exec under the shim is refused */
+  if (oldfd == newfd) {
+    errno = EINVAL;
+    return -1;
+  }
+  return dup2_impl(oldfd, newfd, "dup3");
 }
 
 int setsockopt(int fd, int level, int name, const void *val, socklen_t len) {
@@ -647,7 +736,7 @@ static int poll_impl(struct pollfd *fds, nfds_t nfds, int timeout) {
       vt_wait_poll(fds, (int)nfds, caller_dl);
     }
   }
-  int any_v = 0, any_t = 0;
+  int any_v = 0, any_t = 0, any_e = 0;
   int64_t next_exp = (int64_t)1 << 62;
   for (nfds_t i = 0; i < nfds; i++) {
     /* A CLOSED vfd (in range, g_vfd_open cleared) must still route to
@@ -656,6 +745,8 @@ static int poll_impl(struct pollfd *fds, nfds_t nfds, int timeout) {
      * forever where Linux returns POLLNVAL immediately. */
     if (fds[i].fd >= VFD_BASE && fds[i].fd < VFD_BASE + MAX_VFD)
       any_v = 1;
+    else if (is_efd_fwd(fds[i].fd))
+      any_e = 1;
     else if (is_tfd(fds[i].fd)) {
       any_t = 1;
       tfd_t *t = &g_tfd[fds[i].fd - TFD_BASE];
@@ -673,7 +764,7 @@ static int poll_impl(struct pollfd *fds, nfds_t nfds, int timeout) {
     if (!real_poll) real_poll = dlsym(RTLD_NEXT, "poll");
     return real_poll(fds, nfds, timeout);
   }
-  if (!any_v && !any_t) {
+  if (!any_v && !any_t && !any_e) {
     if (timeout != 0) {
       /* No simulated fds but a wait was requested: sleeping must
        * consume VIRTUAL time (a real sleep here stops the virtual clock
@@ -693,9 +784,11 @@ static int poll_impl(struct pollfd *fds, nfds_t nfds, int timeout) {
     return real_poll0(fds, nfds, 0);
   }
 
-  /* Effective timeout: a pending timerfd expiry bounds the wait. */
+  /* Effective timeout: a pending timerfd expiry (or an already-ready
+   * local eventfd) bounds the wait. */
   int64_t now = any_t ? vnow() : 0;
   int t_ready = any_t ? tfd_fill(fds, nfds, now) : 0;
+  int e_ready = any_e ? efd_poll_fill(fds, nfds) : 0;
   int eff_timeout = timeout;
   if (any_t) {
     if (t_ready > 0) eff_timeout = 0;
@@ -706,31 +799,37 @@ static int poll_impl(struct pollfd *fds, nfds_t nfds, int timeout) {
       if (timeout < 0 || ms < timeout) eff_timeout = (int)ms;
     }
   }
+  if (e_ready > 0) eff_timeout = 0;
 
   if (!any_v) {
-    /* Timerfd-only wait: park in virtual time until the expiry (or the
-     * caller's timeout), then re-evaluate.  Non-simulated entries in
-     * the set report not-ready. */
+    /* Timerfd/eventfd-only wait: park in virtual time until the expiry
+     * (or the caller's timeout), then re-evaluate.  An empty eventfd
+     * cannot fire here (single-threaded: only a sibling could write it;
+     * gated threads take the vt_multi branch above), so it parks like
+     * an unarmed timerfd.  Non-simulated entries report not-ready. */
     for (nfds_t i = 0; i < nfds; i++)
-      if (!is_tfd(fds[i].fd)) fds[i].revents = 0;
-    if (t_ready > 0 || eff_timeout == 0) return t_ready;
+      if (!is_tfd(fds[i].fd) && !is_efd_fwd(fds[i].fd))
+        fds[i].revents = 0;
+    if (t_ready + e_ready > 0 || eff_timeout == 0)
+      return t_ready + e_ready;
     req_t rq = {.op = OP_SLEEP, .fd = -1,
                 .a0 = eff_timeout < 0 ? (int64_t)1 << 62
                                       : (int64_t)eff_timeout * 1000000LL,
                 .len = 0};
     rep_t rp;
     rpc(&rq, &rp);
-    return tfd_fill(fds, nfds, vnow());
+    return (any_t ? tfd_fill(fds, nfds, vnow()) : 0) +
+           (any_e ? efd_poll_fill(fds, nfds) : 0);
   }
 
-  /* Marshal ONLY simulated-socket entries; timerfds are local and real
-   * fds are reported not-ready by the bridge contract. */
+  /* Marshal ONLY simulated-socket entries; timerfds/eventfds are local
+   * and real fds are reported not-ready by the bridge contract. */
   req_t rq = {.op = OP_POLL, .fd = -1, .a0 = eff_timeout, .len = 0};
   int32_t *w = (int32_t *)rq.data;
   int widx[MAX_DATA / 8];
   int nw = 0;
   for (nfds_t i = 0; i < nfds; i++) {
-    if (is_tfd(fds[i].fd)) continue;
+    if (is_tfd(fds[i].fd) || is_efd_fwd(fds[i].fd)) continue;
     w[2 * nw] = fds[i].fd;
     w[2 * nw + 1] = fds[i].events;
     widx[nw++] = (int)i;
@@ -750,6 +849,7 @@ static int poll_impl(struct pollfd *fds, nfds_t nfds, int timeout) {
       g_vfd_soerr[p->fd - VFD_BASE] = soerr;
   }
   if (any_t) total += tfd_fill(fds, nfds, vnow());
+  if (any_e) total += efd_poll_fill(fds, nfds);
   return total;
 }
 
@@ -2268,6 +2368,25 @@ static int is_efd(int fd) {
 
 static int is_efd_fwd(int fd) { return is_efd(fd); }
 
+static void efd_release(int fd) { g_efd[fd - EFD_VBASE].used = 0; }
+
+/* Poll readiness for shim-local eventfds: POLLIN while the counter is
+ * nonzero; always writable (the 0xff..fe overflow block is not
+ * modeled).  Mirrors tfd_fill: fills revents for efd entries only and
+ * returns how many are ready. */
+static int efd_poll_fill(struct pollfd *fds, nfds_t nfds) {
+  int n = 0;
+  for (nfds_t i = 0; i < nfds; i++) {
+    if (!is_efd(fds[i].fd)) continue;
+    efd_t *e = &g_efd[fds[i].fd - EFD_VBASE];
+    fds[i].revents = 0;
+    if ((fds[i].events & POLLIN) && e->count > 0) fds[i].revents |= POLLIN;
+    if (fds[i].events & POLLOUT) fds[i].revents |= POLLOUT;
+    if (fds[i].revents) n++;
+  }
+  return n;
+}
+
 int eventfd(unsigned int initval, int flags) {
   if (g_seq_fd < 0) {
     static int (*real_efd)(unsigned int, int);
@@ -2394,11 +2513,31 @@ void srand(unsigned seed) {
 void srandom(unsigned seed) { srand(seed); }
 
 /* AF_UNIX in virtual time: path-named sockets become loopback TCP on
- * the process's own host; the path hashes to a stable high port
- * (reference keeps a unix-path -> port map, host.c:57-105 +
- * socket.h:47-78). */
+ * the process's own host (reference keeps a unix-path -> port map,
+ * host.c:57-105 + socket.h:47-78).  Distinct paths MUST get distinct
+ * ports -- a silent hash collision cross-wires two unrelated sockets --
+ * so the FNV hash only seeds the probe into an open-addressed path
+ * table whose slot index IS the port offset (a path keeps its port for
+ * the process lifetime); exhaustion aborts loudly instead of wrapping. */
+#define UPP_SLOTS 512
+#define UPP_PORT_BASE 61000
+static char g_upp_path[UPP_SLOTS][108];  /* sizeof(sun_path) */
+static unsigned char g_upp_used[UPP_SLOTS];
+
 static int unix_path_port(const char *path) {
   uint32_t hsh = 2166136261u;
   for (const char *c = path; *c; c++) hsh = (hsh ^ (uint8_t)*c) * 16777619u;
-  return 61000 + (int)(hsh % 4000);
+  for (uint32_t probe = 0; probe < UPP_SLOTS; probe++) {
+    int i = (int)((hsh + probe) % UPP_SLOTS);
+    if (!g_upp_used[i]) {
+      g_upp_used[i] = 1;
+      snprintf(g_upp_path[i], sizeof g_upp_path[i], "%s", path);
+      return UPP_PORT_BASE + i;
+    }
+    if (strncmp(g_upp_path[i], path, sizeof g_upp_path[i] - 1) == 0)
+      return UPP_PORT_BASE + i;
+  }
+  fprintf(stderr, "[shadow1-shim] FATAL: AF_UNIX path->port table full "
+                  "(%d distinct paths); raise UPP_SLOTS\n", UPP_SLOTS);
+  abort();
 }

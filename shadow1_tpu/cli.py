@@ -64,6 +64,19 @@ def _parser():
                         "silently overwritten on wrap (each packet now "
                         "costs up to two records: send + receive "
                         "direction, hence the doubled default)")
+    r.add_argument("--netem", metavar="EVENTS.json", default=None,
+                   help="network-dynamics schedule: JSON events file "
+                        "(link_down/up, host_down/up, latency_scale, "
+                        "loss, partition, bandwidth_scale; host names "
+                        "resolve against the config's DNS) applied "
+                        "inside the device step -- see docs/netem.md")
+    r.add_argument("--churn", type=float, metavar="RATE", default=None,
+                   help="seeded chaos mode: every host flaps down at "
+                        "RATE times per second on average (exponential "
+                        "up/down times, bitwise reproducible per --seed)")
+    r.add_argument("--churn-downtime", type=float, default=5.0,
+                   metavar="SECONDS",
+                   help="mean down-time per chaos flap (default 5s)")
     r.add_argument("--heartbeat-frequency", type=int, default=1,
                    help="heartbeat interval in sim seconds (0 = off)")
     r.add_argument("--log-level", choices=("off", "warning", "debug"),
@@ -125,6 +138,28 @@ def run_config(args) -> int:
                           per_host_interval_s=asm.heartbeat_freq_s)
 
     state, params, app = asm.state, asm.params, asm.app
+
+    # Network dynamics: merge the config's <netem> section (already
+    # installed by assemble) with the CLI's --netem/--churn additions into
+    # one schedule and reinstall.  Reinstalling over an already-shrunk
+    # lookahead can only shrink it further -- conservative, never wrong.
+    if args.netem or args.churn is not None:
+        from . import netem as netem_mod
+        tl = asm.netem if asm.netem is not None else netem_mod.timeline()
+        if args.netem:
+            add = netem_mod.load_json(
+                args.netem,
+                resolve=lambda n: asm.dns.resolve_name(n).host_index)
+            tl.events.extend(add.events)
+            tl.groups.update(add.groups)
+        if args.churn is not None:
+            tl.chaos(params.seed_key, len(asm.hostnames), args.churn,
+                     mean_down_s=args.churn_downtime, t_end=int(stop))
+        state, params = netem_mod.install(
+            state.replace(nm=None), params, tl)
+        if not args.quiet:
+            print(f"[shadow1-tpu] netem: {tl.describe()}", file=sys.stderr)
+
     want_pcap = args.pcap or (asm.pcap_mask is not None
                               and asm.pcap_mask.any())
     if want_pcap:
@@ -256,6 +291,12 @@ def run_config(args) -> int:
         "acks_thinned": int(jnp.sum(state.hosts.acks_thinned)),
         "err_flags": int(state.err),
     }
+    if state.nm is not None:
+        summary["netem"] = {
+            "events_applied": int(state.nm.cursor),
+            "packets_killed": int(state.nm.killed),
+            "hosts_down_at_stop": int(jnp.sum(state.nm.host_up == 0)),
+        }
     if want_pcap and args.data_directory:
         import os as _os
         from .observe import write_pcap

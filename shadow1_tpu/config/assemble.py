@@ -61,6 +61,7 @@ class Assembled:
     heartbeat_freq_s: object = None  # [H] i64, 0 = default
     loglevels: list = None          # per-host loglevel strings
     real_procs: list = None   # [(host_index, argv, start_ns, stop_ns|None)]
+    netem: object = None      # netem.Timeline installed on state, or None
 
 
 def _expand_hosts(cfg):
@@ -332,6 +333,24 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
         return state.replace(app=tg_state)
 
     state = _pkg.build_on_host(_build_state)
+
+    # --- netem (<netem> section): fault/dynamics schedule -----------------
+    netem_tl = None
+    if cfg.netem is not None:
+        from .. import netem as _netem
+        spec = cfg.netem
+        netem_tl = _netem.load_json(
+            {"events": spec.events, "groups": spec.groups},
+            resolve=lambda n: dns.resolve_name(n).host_index)
+        if spec.churn_rate:
+            end_s = (spec.churn_end_s if spec.churn_end_s is not None
+                     else cfg.stoptime_s)
+            netem_tl.chaos(params.seed_key, h, spec.churn_rate,
+                           mean_down_s=spec.churn_downtime_s,
+                           t_start=int(spec.churn_start_s * SEC),
+                           t_end=int(end_s * SEC))
+        state, params = _netem.install(state, params, netem_tl)
+
     if real_procs:
         from ..apps.compose import Stacked
         from ..substrate import devapp
@@ -347,7 +366,7 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
                      stop_time=cfg.stoptime_s * SEC,
                      pcap_mask=pcap_mask, pcap_dirs=pcap_dirs,
                      heartbeat_freq_s=hb_freq, loglevels=loglevels,
-                     real_procs=real_procs)
+                     real_procs=real_procs, netem=netem_tl)
 
 
 def load(path: str, **kw) -> Assembled:

@@ -29,6 +29,9 @@ _HOST_ATTRS = {"id", "quantity", "iphint", "citycodehint", "countrycodehint",
 _PROCESS_ATTRS = {"plugin", "starttime", "time", "stoptime", "arguments",
                   "preload"}
 _PLUGIN_ATTRS = {"id", "path", "startsymbol"}
+_NETEM_ATTRS = {"churnrate", "churndowntime", "churnstart", "churnend"}
+_NETEM_EVENT_ATTRS = {"time", "kind", "a", "b", "value", "groups"}
+_NETEM_GROUP_ATTRS = {"host", "id"}
 
 
 def _warn_unknown(tag, el, known):
@@ -82,6 +85,22 @@ class PluginSpec:
 
 
 @dataclasses.dataclass
+class NetemSpec:
+    """<netem> fault/dynamics section (docs/netem.md).  `events` uses the
+    same schema as the --netem JSON file ({"time", "kind", "a", "b",
+    "value", "groups"}, time in seconds, hosts by name); `groups` maps
+    host name -> partition group id.  Churn attributes switch on seeded
+    chaos mode over every host."""
+
+    events: list = dataclasses.field(default_factory=list)
+    groups: dict = dataclasses.field(default_factory=dict)
+    churn_rate: float | None = None       # flaps/host/second
+    churn_downtime_s: float = 5.0         # mean down-time
+    churn_start_s: float = 0.0
+    churn_end_s: float | None = None      # default: stoptime
+
+
+@dataclasses.dataclass
 class ShadowConfig:
     stoptime_s: int
     bootstrap_end_s: int
@@ -92,6 +111,7 @@ class ShadowConfig:
     environment: str | None = None
     preload_path: str | None = None
     base_dir: str = "."
+    netem: NetemSpec | None = None
 
     def topology_source(self) -> str:
         """What routing/graphml.load accepts: inline XML or a path."""
@@ -126,6 +146,7 @@ def parse(path_or_xml: str) -> ShadowConfig:
     topo_path = topo_cdata = None
     plugins: dict = {}
     hosts: list = []
+    netem_spec = None
     for el in root:
         if el.tag == "topology":
             p = el.get("path")
@@ -139,6 +160,34 @@ def parse(path_or_xml: str) -> ShadowConfig:
             pid = el.get("id")
             plugins[pid] = PluginSpec(id=pid, path=el.get("path") or "",
                                       startsymbol=el.get("startsymbol"))
+        elif el.tag == "netem":
+            _warn_unknown("netem", el, _NETEM_ATTRS)
+            cr = el.get("churnrate")
+            netem_spec = NetemSpec(
+                churn_rate=float(cr) if cr is not None else None,
+                churn_downtime_s=float(el.get("churndowntime") or 5.0),
+                churn_start_s=float(el.get("churnstart") or 0.0),
+                churn_end_s=(float(el.get("churnend"))
+                             if el.get("churnend") else None),
+            )
+            for ne in el:
+                if ne.tag == "event":
+                    _warn_unknown("event", ne, _NETEM_EVENT_ATTRS)
+                    ev = {"time": float(ne.get("time") or 0),
+                          "kind": ne.get("kind")}
+                    for k in ("a", "b"):
+                        if ne.get(k) is not None:
+                            ev[k] = ne.get(k)
+                    if ne.get("value") is not None:
+                        ev["value"] = float(ne.get("value"))
+                    if ne.get("groups"):
+                        ev["groups"] = [int(g) for g in
+                                        ne.get("groups").split(",") if g]
+                    netem_spec.events.append(ev)
+                elif ne.tag == "group":
+                    _warn_unknown("group", ne, _NETEM_GROUP_ATTRS)
+                    netem_spec.groups[ne.get("host")] = \
+                        int(ne.get("id") or 0)
         elif el.tag == "host" or el.tag == "node":  # "node" = legacy alias
             _warn_unknown(el.tag, el, _HOST_ATTRS)
             procs = []
@@ -185,4 +234,5 @@ def parse(path_or_xml: str) -> ShadowConfig:
         environment=root.get("environment"),
         preload_path=root.get("preload"),
         base_dir=base,
+        netem=netem_spec,
     )

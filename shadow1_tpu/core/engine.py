@@ -75,7 +75,12 @@ from .state import (ERR_POOL_OVERFLOW, I32, I64, U32, PROTO_TCP, PROTO_UDP,
                     MCOL_STAGE, MCOL_STATUS,
                     LOG_WARNING, LOG_DEBUG, LOG_DROP_INET, LOG_DROP_ROUTER,
                     LOG_DROP_TAIL, LOG_DROP_POOL, LOG_DELIVER, LOG_SEND,
+                    LOG_NETEM_DOWN,
                     enc_lo, enc_hi, dec_i64, SimState)
+# Fault/dynamics overlay operators (netem/apply.py).  Every call site
+# guards on `state.nm is None` (a trace-time pytree check), so worlds
+# without a fault schedule compile the overlay away entirely.
+from ..netem import apply as netem_apply
 
 INV = simtime.SIMTIME_INVALID
 
@@ -559,8 +564,9 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
     ids = jnp.arange(ki, dtype=I32)[None, :]
     rows = jnp.arange(h, dtype=I32)
     boot = tick_t < params.bootstrap_end
+    bw_dn = netem_apply.rate(state.nm, params.bw_down_Bps)
     tokens, last = nic.refill(hosts.tokens_rx, hosts.last_refill_rx,
-                              params.bw_down_Bps, tick_t, active)
+                              bw_dn, tick_t, active)
     hosts = hosts.replace(last_refill_rx=last)
     if d_rounds > 1:
         span = simtime.SIMTIME_ONE_MILLISECOND
@@ -618,7 +624,7 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
         # at its arrival time is funded here too).
         if r > 0:
             tokens, last = nic.refill(tokens, hosts.last_refill_rx,
-                                      params.bw_down_Bps, t_eff, have)
+                                      bw_dn, t_eff, have)
             hosts = hosts.replace(last_refill_rx=last)
         size = _wire_bytes(pkt.proto, pkt.length).astype(I64) * nic.SCALE
         loop = pkt.src == rows
@@ -631,6 +637,15 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
         hosts, drop = nic.codel_dequeue(hosts, funded & ~loop, t_eff,
                                         sojourn, backlog_after)
         deliver = funded & ~drop
+        # Netem delivery gate: a packet reaching a DOWN destination is
+        # lost at the interface (in-flight packets when the host crashed,
+        # plus loopback sends that bypass the staging drop).  The slot
+        # still frees (funded), so nothing strands.
+        if state.nm is not None:
+            nm_kill = deliver & ~netem_apply.alive(state.nm)
+            deliver = deliver & ~nm_kill
+        else:
+            nm_kill = None
 
         tokens = tokens - jnp.where(funded & ~free_pass, size, 0)
         hosts = hosts.replace(tokens_rx=tokens)
@@ -652,12 +667,17 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
             pkts_dropped_router=hosts.pkts_dropped_router +
             jnp.where(drop, 1, 0),
         )
+        if nm_kill is not None:
+            hosts = hosts.replace(
+                pkts_dropped_inet=hosts.pkts_dropped_inet +
+                jnp.where(nm_kill, 1, 0))
+            state = state.replace(nm=state.nm.replace(
+                killed=state.nm.killed + jnp.sum(nm_kill)))
 
         if r == d_rounds - 1:
             # Wake-ups: backlog remains -> re-tick now; starved -> when
             # tokens accrue for this packet.
-            t_tok = tick_t + nic.time_until(size - tokens,
-                                            params.bw_down_Bps)
+            t_tok = tick_t + nic.time_until(size - tokens, bw_dn)
             t_res = jnp.where(
                 have & ~funded, t_tok,
                 jnp.where(funded & (hosts.rx_queued > 0), tick_t,
@@ -682,6 +702,9 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
                                     rows2, src_col)
             state = _log_append(state, drop, LOG_DROP_ROUTER, LOG_WARNING,
                                 t_eff, rows, pkt.src)
+            if nm_kill is not None:
+                state = _log_append(state, nm_kill, LOG_NETEM_DOWN,
+                                    LOG_WARNING, t_eff, rows, pkt.src)
             state = _log_append(state, deliver, LOG_DELIVER, LOG_DEBUG,
                                 t_eff, rows, pkt.src)
 
@@ -822,6 +845,14 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     vs = jnp.broadcast_to(params.host_vertex[:, None], (h, e))
     vd = params.host_vertex[jnp.clip(em.dst, 0, params.host_vertex.shape[0] - 1)]
     lat, rel = _route(params, vs, vd, src2, ctr2)
+    if state.nm is not None:
+        # Fault overlay BEFORE the loopback override: blocked pairs
+        # (endpoint down / link down / partitioned) get rel 0.0 and die
+        # through the ordinary reliability drop below; loopback stays
+        # exempt from link faults.
+        rel_base = rel
+        lat, rel = netem_apply.route_overlay(state.nm, src2, em.dst,
+                                             lat, rel)
     loop = em.dst == src2
     lat = jnp.where(loop, simtime.SIMTIME_ONE_NANOSECOND, lat)
     rel = jnp.where(loop, 1.0, rel)
@@ -830,6 +861,13 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     u = rng.keyed_uniform(drop_key, src2, ctr2.astype(jnp.uint32),
                           (ctr2 >> 32).astype(jnp.uint32))
     dropped = valid & (u >= rel)
+    if state.nm is not None:
+        # Injected-fault kills: dropped here but the BASE draw would have
+        # survived -- exactly the packets netem killed (blocked pairs or
+        # added loss), separated from baseline wire unreliability.
+        nm_kill = dropped & (u < rel_base)
+        state = state.replace(nm=state.nm.replace(
+            killed=state.nm.killed + jnp.sum(nm_kill)))
     live = valid & ~dropped
     lb = live & loop if _may_loopback(app) else jnp.zeros_like(live)
     nl = live & ~lb
@@ -854,7 +892,8 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     # in TX_QUEUED for _tx_drain (FIFO is preserved because any backlog
     # forces parking).
     tokens, last = nic.refill(hosts.tokens_tx, hosts.last_refill_tx,
-                              params.bw_up_Bps, tick_t, active)
+                              netem_apply.rate(state.nm, params.bw_up_Bps),
+                              tick_t, active)
     sizes = _wire_bytes(em.proto, em.length).astype(I64) * nic.SCALE
     sizes_nl = jnp.where(placed, sizes, 0)
     prefix = jnp.cumsum(sizes_nl, axis=1)
@@ -1040,8 +1079,9 @@ def _tx_drain(state: SimState, params, tick_t, active):
     have = slot_of_host >= 0
     slot = jnp.clip(slot_of_host, 0, pool.capacity - 1)
 
+    bw_up = netem_apply.rate(state.nm, params.bw_up_Bps)
     tokens, last = nic.refill(hosts.tokens_tx, hosts.last_refill_tx,
-                              params.bw_up_Bps, tick_t, active)
+                              bw_up, tick_t, active)
     # One packed row gather for every field of the chosen packet.
     row = pool.blk[slot]                                 # [H, OCOLS]
     size = _wire_bytes(row[:, ICOL_PROTO], row[:, ICOL_LEN]).astype(I64) \
@@ -1071,7 +1111,7 @@ def _tx_drain(state: SimState, params, tick_t, active):
         tokens_tx=tokens, last_refill_tx=last,
         tx_queued=hosts.tx_queued - jnp.where(funded, 1, 0).astype(I32))
 
-    t_tok = tick_t + nic.time_until(size - tokens, params.bw_up_Bps)
+    t_tok = tick_t + nic.time_until(size - tokens, bw_up)
     t_res = jnp.where(
         have & ~funded, t_tok,
         jnp.where(funded & (hosts.tx_queued > 0), tick_t,
@@ -1194,6 +1234,12 @@ def run_until(state: SimState, params, app, t_target):
         t_h, gmin = scan(st)
         ws = jnp.maximum(st.now, gmin)
         we = jnp.minimum(ws + params.min_latency_ns, t_target)
+        if st.nm is not None:
+            # Apply every fault event inside this window before any of
+            # its ticks: an event takes effect at the start of the
+            # conservative window containing its timestamp (install()
+            # already shrank the lookahead for sub-1.0 latency scales).
+            st = st.replace(nm=netem_apply.advance(st.nm, we))
 
         def icond(icarry):
             _s, _th, g = icarry
@@ -1213,6 +1259,11 @@ def run_until(state: SimState, params, app, t_target):
     state, _, _, _ = jax.lax.while_loop(
         window_cond, window_body,
         (state, t_h0, gmin0, _outbox_pending(state)))
+    if state.nm is not None:
+        # Catch up through idle spans the window loop skipped, so the
+        # cursor (and every counter derived from it) is canonical at
+        # t_target regardless of how the run was chunked.
+        state = state.replace(nm=netem_apply.advance(state.nm, t_target))
     return state.replace(now=t_target)
 
 

@@ -28,6 +28,7 @@ PURPOSE_HOST_APP = 2
 PURPOSE_ATTACH = 3
 PURPOSE_JITTER = 4
 PURPOSE_SCHED = 5
+PURPOSE_CHAOS = 6   # netem churn process draws (netem/timeline.py)
 
 
 def root_key(seed: int) -> jax.Array:

@@ -665,6 +665,7 @@ LOG_DROP_POOL = 4      # slab-capacity drop (capacity escape hatch)
 LOG_DELIVER = 5        # packet delivered to a socket
 LOG_SEND = 6           # packet placed on the wire
 LOG_ACK_THIN = 7       # pure ACKs shed at exchange overflow (not an error)
+LOG_NETEM_DOWN = 8     # delivery killed: destination host is netem-down
 
 
 @struct.dataclass
@@ -758,6 +759,10 @@ class SimState:
     # Per-host log level mask (LOG_*), only consulted when log is set.
     log_level: any = struct.field(pytree_node=True, default=None)  # [H] i32
     tr: any = struct.field(pytree_node=True, default=None)  # TraceCounters | None
+    # Network dynamics / fault injection (netem/state.py): present only
+    # when a fault schedule is installed, so static worlds compile the
+    # whole overlay away.
+    nm: any = struct.field(pytree_node=True, default=None)  # NetemBlock | None
     # Telemetry (reference scheduler built-in timers, scheduler.c:266-268):
     n_steps: jnp.ndarray = struct.field(default=None)    # i64 micro-steps
     n_windows: jnp.ndarray = struct.field(default=None)  # i64 windows run

@@ -1,0 +1,19 @@
+"""Device-resident network dynamics & fault injection.
+
+Schedules `{time, kind, a, b, value}` events (link/host up-down, latency
+and loss scaling, partitions, bandwidth scaling) on a sorted device
+block carried by `SimState.nm`, applied *inside* the jitted engine step
+with zero host round-trips.  See docs/netem.md.
+
+    from shadow1_tpu import netem
+    tl = netem.timeline().link_down(0, 1, at=2 * SEC).link_up(0, 1, at=4 * SEC)
+    state, params = netem.install(state, params, tl)
+"""
+
+from .state import (EV_BW_SCALE, EV_HOST_DOWN, EV_HOST_UP,  # noqa: F401
+                    EV_LINK_DOWN, EV_LINK_LAT, EV_LINK_LOSS, EV_LINK_UP,
+                    EV_PARTITION, KIND_BY_NAME, KIND_NAMES, LOSS_ONE,
+                    SCALE_ONE, NetemBlock, make_netem_block)
+from .timeline import (Timeline, install, load_json,  # noqa: F401
+                       timeline)
+from . import apply  # noqa: F401

@@ -1,0 +1,163 @@
+"""Jit-safe netem operators: cursor advance + overlay consultation.
+
+Everything here traces into the engine step.  `advance` runs once per
+conservative window (a `lax.while_loop` that usually does zero
+iterations); `route_overlay` / `alive` / `rate` are a few masked
+gathers on the staging and delivery hot paths.  All operators are exact
+identities when the overlay is neutral -- see netem/state.py's
+bitwise-neutrality contract.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .state import (EV_BW_SCALE, EV_HOST_DOWN, EV_HOST_UP, EV_LINK_DOWN,
+                    EV_LINK_LAT, EV_LINK_LOSS, EV_LINK_UP, EV_PARTITION,
+                    LOSS_ONE, SCALE_ONE, NetemBlock)
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+
+def _apply_one(nm: NetemBlock) -> NetemBlock:
+    """Apply the event at the cursor and advance it."""
+    i = jnp.clip(nm.cursor, 0, nm.n_events - 1)
+    k = nm.ev_kind[i]
+    a = nm.ev_a[i]
+    b = nm.ev_b[i]
+    v = nm.ev_val[i]
+    is_global = a < 0
+
+    hids = jnp.arange(nm.host_up.shape[0], dtype=I32)
+    sel_a = hids == a
+
+    host_up = nm.host_up
+    host_up = jnp.where((k == EV_HOST_DOWN) & sel_a, 0, host_up)
+    host_up = jnp.where((k == EV_HOST_UP) & sel_a, 1, host_up)
+
+    part_mask = jnp.where(k == EV_PARTITION, v, nm.part_mask)
+
+    bw_sel = (k == EV_BW_SCALE) & (is_global | sel_a)
+    bw = jnp.where(bw_sel, jnp.maximum(v, 1), nm.bw_x1000)
+
+    lat = jnp.where((k == EV_LINK_LAT) & is_global, jnp.maximum(v, 1),
+                    nm.lat_x1000)
+    loss = jnp.where((k == EV_LINK_LOSS) & is_global,
+                     jnp.clip(v, 0, LOSS_ONE), nm.loss_x1e6)
+
+    nm = nm.replace(host_up=host_up, part_mask=part_mask, bw_x1000=bw,
+                    lat_x1000=lat, loss_x1e6=loss)
+
+    if nm.n_links > 0:
+        mn = jnp.minimum(a, b)
+        mx = jnp.maximum(a, b)
+        osel = (nm.ov_a == mn) & (nm.ov_b == mx) & ~is_global
+        nm = nm.replace(
+            ov_lat_x1000=jnp.where(osel & (k == EV_LINK_LAT),
+                                   jnp.maximum(v, 1), nm.ov_lat_x1000),
+            ov_loss_x1e6=jnp.where(osel & (k == EV_LINK_LOSS),
+                                   jnp.clip(v, 0, LOSS_ONE),
+                                   nm.ov_loss_x1e6),
+            ov_down=jnp.where(osel & (k == EV_LINK_DOWN), 1,
+                              jnp.where(osel & (k == EV_LINK_UP), 0,
+                                        nm.ov_down)),
+        )
+    return nm.replace(cursor=nm.cursor + 1)
+
+
+def advance(nm: NetemBlock, bound) -> NetemBlock:
+    """Apply every event with time < bound (the window's end): an event
+    takes effect for the whole conservative window containing it.  The
+    engine also advances to t_target at the end of each launch, so the
+    cursor position -- and every counter derived from it -- is canonical
+    at chunk boundaries regardless of chunking."""
+    bound = jnp.asarray(bound, I64)
+    n = nm.n_events
+
+    def cond(s):
+        i = jnp.clip(s.cursor, 0, n - 1)
+        return (s.cursor < n) & (s.ev_time[i] < bound)
+
+    return jax.lax.while_loop(cond, _apply_one, nm)
+
+
+def _pair_overrides(nm: NetemBlock, src, dst):
+    """Per-link override gather for [..] src/dst index arrays.  Returns
+    (lat_x1000, loss_x1e6, link_down) with global values where no
+    override slot matches."""
+    lat = jnp.broadcast_to(nm.lat_x1000, src.shape)
+    loss = jnp.broadcast_to(nm.loss_x1e6, src.shape)
+    down = jnp.zeros(src.shape, dtype=jnp.bool_)
+    if nm.n_links == 0:
+        return lat, loss, down
+    mn = jnp.minimum(src, dst)
+    mx = jnp.maximum(src, dst)
+    # [.., L] match against the (tiny) override table; one-hot gather.
+    # The loss gather shifts by +1 so the -1 "no override" sentinel
+    # survives the masked sum.
+    m = (mn[..., None] == nm.ov_a) & (mx[..., None] == nm.ov_b)
+    has = jnp.any(m, axis=-1)
+    ov_lat = jnp.sum(jnp.where(m, nm.ov_lat_x1000, 0), axis=-1)
+    ov_loss = jnp.sum(jnp.where(m, nm.ov_loss_x1e6 + 1, 0), axis=-1) - 1
+    lat = jnp.where(has & (ov_lat > 0), ov_lat, lat)
+    loss = jnp.where(has & (ov_loss >= 0), ov_loss, loss)
+    down = has & (jnp.sum(jnp.where(m, nm.ov_down, 0), axis=-1) > 0)
+    return lat, loss, down
+
+
+def _partitioned(nm: NetemBlock, src, dst):
+    """True where src and dst sit on opposite sides of the active
+    partition (group bitmask semantics; mask 0 = healed)."""
+    m = nm.part_mask
+    gs = nm.group[src]
+    gd = nm.group[dst]
+    one = jnp.asarray(1, I32)
+    sa = (jnp.left_shift(one, gs) & m) != 0
+    sb = (jnp.left_shift(one, gd) & m) != 0
+    return (m != 0) & (sa != sb)
+
+
+def route_overlay(nm: NetemBlock, src, dst, lat, rel):
+    """Apply the overlay to routed (latency, reliability) for src->dst
+    packet arrays.  Blocked pairs (either endpoint down, link down, or
+    partitioned) get reliability 0.0 so the existing staging drop path
+    (`u >= rel`, counted in pkts_dropped_inet) kills them.
+
+    Returns (lat, rel).  Neutral overlay is an exact identity."""
+    h = nm.host_up.shape[0]
+    dstc = jnp.clip(dst, 0, h - 1)
+    lat_s, loss, link_down = _pair_overrides(nm, src, dstc)
+    lat = jnp.maximum((lat * lat_s.astype(I64)) // SCALE_ONE,
+                      jnp.asarray(1, I64))
+    rel = rel * (jnp.asarray(1.0, jnp.float32) -
+                 loss.astype(jnp.float32) *
+                 jnp.asarray(1.0 / LOSS_ONE, jnp.float32))
+    up = (nm.host_up[src] > 0) & (nm.host_up[dstc] > 0)
+    blocked = ~up | link_down | _partitioned(nm, src, dstc)
+    rel = jnp.where(blocked, jnp.asarray(0.0, jnp.float32), rel)
+    return lat, rel
+
+
+def alive(nm: NetemBlock):
+    """[H] bool: hosts currently up (delivery gate)."""
+    return nm.host_up > 0
+
+
+def rate(nm, bw_Bps):
+    """Scale an [H] i64 token-bucket rate by the per-host bandwidth
+    overlay; identity (exact) when nm is None or the scale is 1000."""
+    if nm is None:
+        return bw_Bps
+    return jnp.maximum((bw_Bps * nm.bw_x1000.astype(I64)) // SCALE_ONE,
+                       jnp.asarray(1, I64))
+
+
+def min_lat_scale_x1000(events) -> int:
+    """Smallest latency scale any event in a host-side schedule can set
+    (x1000); the conservative window must shrink by this factor at
+    install time or lookahead would exceed the smallest live latency."""
+    scales = [max(1, int(v)) for (_t, k, _a, _b, v) in events
+              if k == EV_LINK_LAT]
+    return min([SCALE_ONE] + scales)

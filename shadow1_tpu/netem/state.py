@@ -1,0 +1,170 @@
+"""Device-resident network-dynamics schedule + overlay (the netem block).
+
+The reference mutates its topology under the workload --
+`topology_getLatency/getReliability` consult live edge state and
+`topology_attach/detach` move hosts (topology.c) -- which is how its Tor
+and Bitcoin experiments model relay churn, degraded links, and
+partitions.  Our routing matrices are baked at build time, so dynamics
+live in a separate compact block: a SORTED event schedule carried on
+`SimState.nm` (present-or-None like cap/log/tr) plus small overlay state
+the delivery path consults every tick.
+
+Design constraints, in order:
+
+* Zero host round-trips: the cursor advances inside the jitted window
+  loop; applying an event is a handful of masked updates.
+* Bitwise neutrality: with no block installed the engine compiles the
+  overlay away entirely; with a block installed but nothing active, the
+  overlay math is integer/float-exact identity (scale 1000/1000 on i64
+  latencies, `rel * 1.0` on f32 reliabilities), so a run with an empty
+  or not-yet-due schedule is bit-identical to a run without one.
+* O(H + L) overlay state, never O(H^2): per-host up/down + group ids,
+  global scalars, and an L-slot sparse per-link override table sized at
+  build time from the distinct link pairs the schedule names.
+"""
+
+from __future__ import annotations
+
+from flax import struct
+import jax.numpy as jnp
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+# Event kinds (the `kind` column of the schedule).  `a`/`b` are host
+# indices (or -1 for "global"); `val` is the kind-specific argument:
+# latency/bandwidth scales are fixed-point x1000, loss fractions x1e6,
+# partitions carry a group bitmask.
+EV_LINK_LAT = 1     # latency scale: a<0 global, else link (a,b)
+EV_LINK_LOSS = 2    # injected loss fraction: a<0 global, else link (a,b)
+EV_LINK_DOWN = 3    # link (a,b) down (both directions)
+EV_LINK_UP = 4      # link (a,b) restored
+EV_HOST_DOWN = 5    # host a down (sends and deliveries killed)
+EV_HOST_UP = 6      # host a restored
+EV_PARTITION = 7    # val = group bitmask isolated from the rest; 0 heals
+EV_BW_SCALE = 8     # bandwidth scale: a<0 all hosts, else host a
+
+KIND_NAMES = {
+    EV_LINK_LAT: "latency_scale",
+    EV_LINK_LOSS: "loss",
+    EV_LINK_DOWN: "link_down",
+    EV_LINK_UP: "link_up",
+    EV_HOST_DOWN: "host_down",
+    EV_HOST_UP: "host_up",
+    EV_PARTITION: "partition",
+    EV_BW_SCALE: "bandwidth_scale",
+}
+KIND_BY_NAME = {v: k for k, v in KIND_NAMES.items()}
+
+# Fixed-point scales.
+SCALE_ONE = 1000       # latency/bandwidth scale 1.0
+LOSS_ONE = 1_000_000   # loss fraction 1.0
+
+# Sentinel time for padding past the last event (never reached).
+T_NEVER = (1 << 62)
+
+
+@struct.dataclass
+class NetemBlock:
+    """Sorted event schedule + the overlay it maintains.
+
+    Schedule arrays are fixed [N] (padded with T_NEVER rows); `cursor`
+    counts applied events and doubles as the events-applied counter.
+    The overlay is what the hot path reads: per-host up mask and group
+    ids, partition bitmask, global latency/loss scalars, per-host
+    bandwidth scale, and the sparse per-link override table keyed by
+    normalized (min, max) host pairs fixed at build time."""
+
+    # -- schedule ---------------------------------------------------------
+    ev_time: jnp.ndarray   # [N] i64 absolute sim ns, ascending
+    ev_kind: jnp.ndarray   # [N] i32 EV_*
+    ev_a: jnp.ndarray      # [N] i32 host index or -1
+    ev_b: jnp.ndarray      # [N] i32 host index or -1
+    ev_val: jnp.ndarray    # [N] i32 kind-specific fixed-point argument
+    cursor: jnp.ndarray    # i32 scalar: events applied so far
+
+    # -- overlay ----------------------------------------------------------
+    host_up: jnp.ndarray          # [H] i32 0/1
+    group: jnp.ndarray            # [H] i32 partition group id (0..30)
+    part_mask: jnp.ndarray        # i32 scalar group bitmask; 0 = healed
+    lat_x1000: jnp.ndarray        # i32 scalar global latency scale
+    loss_x1e6: jnp.ndarray        # i32 scalar global injected loss
+    bw_x1000: jnp.ndarray         # [H] i32 per-host bandwidth scale
+
+    # -- sparse per-link overrides (L may be 0) ---------------------------
+    ov_a: jnp.ndarray             # [L] i32 min(host, host)
+    ov_b: jnp.ndarray             # [L] i32 max(host, host)
+    ov_lat_x1000: jnp.ndarray     # [L] i32; 0 = no override
+    ov_loss_x1e6: jnp.ndarray     # [L] i32; -1 = no override
+    ov_down: jnp.ndarray          # [L] i32 0/1
+
+    # -- counters ---------------------------------------------------------
+    killed: jnp.ndarray           # i64 packets killed by injected faults
+
+    @property
+    def n_events(self) -> int:
+        return self.ev_time.shape[0]
+
+    @property
+    def n_links(self) -> int:
+        return self.ov_a.shape[0]
+
+
+def make_netem_block(num_hosts: int, events, link_pairs=(),
+                     groups=None) -> NetemBlock:
+    """Build a NetemBlock from a host-side event list.
+
+    `events`: iterable of (time_ns, kind, a, b, val) -- sorted here
+    (stable, so same-time events apply in insertion order).
+    `link_pairs`: distinct (a, b) pairs that per-link events reference;
+    the override table is sized to exactly these.
+    `groups`: optional [H] group-id assignment for partitions.
+    """
+    import numpy as np
+
+    evs = sorted(enumerate(events), key=lambda iv: (iv[1][0], iv[0]))
+    evs = [v for _, v in evs]
+    n = max(1, len(evs))
+    t = np.full(n, T_NEVER, np.int64)
+    k = np.zeros(n, np.int32)
+    a = np.full(n, -1, np.int32)
+    b = np.full(n, -1, np.int32)
+    v = np.zeros(n, np.int32)
+    for i, (et, ek, ea, eb, ev) in enumerate(evs):
+        t[i], k[i], a[i], b[i], v[i] = et, ek, ea, eb, ev
+
+    pairs = sorted({(min(x, y), max(x, y)) for x, y in link_pairs})
+    la = np.asarray([p[0] for p in pairs], np.int32)
+    lb = np.asarray([p[1] for p in pairs], np.int32)
+
+    if groups is None:
+        g = np.zeros(num_hosts, np.int32)
+    else:
+        g = np.asarray(groups, np.int32)
+        if g.shape != (num_hosts,):
+            raise ValueError(f"groups must be [{num_hosts}], "
+                             f"got {g.shape}")
+        if g.min() < 0 or g.max() > 30:
+            raise ValueError("partition group ids must be in 0..30 "
+                             "(they index an i32 bitmask)")
+
+    return NetemBlock(
+        ev_time=jnp.asarray(t, I64),
+        ev_kind=jnp.asarray(k, I32),
+        ev_a=jnp.asarray(a, I32),
+        ev_b=jnp.asarray(b, I32),
+        ev_val=jnp.asarray(v, I32),
+        cursor=jnp.asarray(0, I32),
+        host_up=jnp.ones(num_hosts, I32),
+        group=jnp.asarray(g, I32),
+        part_mask=jnp.asarray(0, I32),
+        lat_x1000=jnp.asarray(SCALE_ONE, I32),
+        loss_x1e6=jnp.asarray(0, I32),
+        bw_x1000=jnp.full(num_hosts, SCALE_ONE, I32),
+        ov_a=jnp.asarray(la, I32),
+        ov_b=jnp.asarray(lb, I32),
+        ov_lat_x1000=jnp.zeros(len(pairs), I32),
+        ov_loss_x1e6=jnp.full(len(pairs), -1, I32),
+        ov_down=jnp.zeros(len(pairs), I32),
+        killed=jnp.asarray(0, I64),
+    )

@@ -1,0 +1,298 @@
+"""Host-side netem front ends: the fluent Timeline builder, seeded chaos
+churn, the JSON event-file loader, and `install` (attach a built block to
+a world).
+
+Times are absolute simulated nanoseconds (`core.simtime` units)
+everywhere in this module; the config front ends convert seconds before
+calling in.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..core import rng, simtime
+from . import apply as _apply
+from .state import (EV_BW_SCALE, EV_HOST_DOWN, EV_HOST_UP, EV_LINK_DOWN,
+                    EV_LINK_LAT, EV_LINK_LOSS, EV_LINK_UP, EV_PARTITION,
+                    KIND_BY_NAME, LOSS_ONE, SCALE_ONE, make_netem_block)
+
+SEC = simtime.SIMTIME_ONE_SECOND
+
+_PAIR_KINDS = (EV_LINK_DOWN, EV_LINK_UP)
+
+
+def _x1000(scale: float) -> int:
+    v = int(round(float(scale) * SCALE_ONE))
+    if v < 1:
+        raise ValueError(f"scale {scale} must be > 0")
+    return v
+
+
+def _x1e6(frac: float) -> int:
+    f = float(frac)
+    if not 0.0 <= f <= 1.0:
+        raise ValueError(f"loss fraction {frac} must be in [0, 1]")
+    return int(round(f * LOSS_ONE))
+
+
+class Timeline:
+    """Ordered fault/dynamics schedule under construction.
+
+    Every method returns `self` so scenarios chain:
+
+        netem.timeline().link_down(0, 1, at=2 * SEC) \\
+                        .link_up(0, 1, at=4 * SEC) \\
+                        .host_flap(3, down_at=1 * SEC, up_at=2 * SEC)
+    """
+
+    def __init__(self):
+        self.events: list = []       # (t_ns, kind, a, b, val)
+        self.groups: dict = {}       # host -> partition group id
+
+    def _add(self, at, kind, a=-1, b=-1, val=0):
+        at = int(at)
+        if at < 0:
+            raise ValueError(f"event time {at} must be >= 0")
+        self.events.append((at, kind, int(a), int(b), int(val)))
+        return self
+
+    # -- links ------------------------------------------------------------
+    def link_down(self, a, b, at):
+        if a == b:
+            raise ValueError("link_down needs two distinct hosts")
+        return self._add(at, EV_LINK_DOWN, a, b)
+
+    def link_up(self, a, b, at):
+        return self._add(at, EV_LINK_UP, a, b)
+
+    def latency_scale(self, scale, at, a=None, b=None):
+        """Scale latency globally (a/b omitted) or on one link."""
+        if (a is None) != (b is None):
+            raise ValueError("latency_scale takes both a and b, or neither")
+        return self._add(at, EV_LINK_LAT, -1 if a is None else a,
+                         -1 if b is None else b, _x1000(scale))
+
+    def loss(self, frac, at, a=None, b=None):
+        """Inject loss (a fraction in [0,1]) globally or on one link."""
+        if (a is None) != (b is None):
+            raise ValueError("loss takes both a and b, or neither")
+        return self._add(at, EV_LINK_LOSS, -1 if a is None else a,
+                         -1 if b is None else b, _x1e6(frac))
+
+    # -- hosts ------------------------------------------------------------
+    def host_down(self, host, at):
+        return self._add(at, EV_HOST_DOWN, host)
+
+    def host_up(self, host, at):
+        return self._add(at, EV_HOST_UP, host)
+
+    def host_flap(self, host, down_at, up_at):
+        if not up_at > down_at:
+            raise ValueError("host_flap needs up_at > down_at")
+        return self.host_down(host, down_at).host_up(host, up_at)
+
+    # -- partitions -------------------------------------------------------
+    def set_group(self, host, group):
+        """Assign a host to a partition group (0..30; default 0)."""
+        g = int(group)
+        if not 0 <= g <= 30:
+            raise ValueError("partition group ids must be in 0..30")
+        self.groups[int(host)] = g
+        return self
+
+    def partition(self, groups, at):
+        """Isolate the given group ids from every other group."""
+        mask = 0
+        for g in ([groups] if isinstance(groups, int) else groups):
+            if not 0 <= int(g) <= 30:
+                raise ValueError("partition group ids must be in 0..30")
+            mask |= 1 << int(g)
+        if mask == 0:
+            raise ValueError("partition needs at least one group "
+                             "(use heal() to clear)")
+        return self._add(at, EV_PARTITION, val=mask)
+
+    def heal(self, at):
+        return self._add(at, EV_PARTITION, val=0)
+
+    # -- bandwidth ---------------------------------------------------------
+    def bandwidth_scale(self, scale, at, host=None):
+        return self._add(at, EV_BW_SCALE,
+                         -1 if host is None else host, -1, _x1000(scale))
+
+    # -- chaos ------------------------------------------------------------
+    def chaos(self, seed_key, num_hosts, rate_per_s, *,
+              mean_down_s: float = 5.0, hosts=None,
+              t_start: int = 0, t_end: int):
+        """Seeded churn: each selected host alternates exponential
+        up-times (mean 1/rate_per_s seconds) and down-times (mean
+        mean_down_s), drawn from the counter RNG keyed by (host, draw
+        index) -- bitwise reproducible for a given seed on any chunking
+        or mesh (core/rng.py contract)."""
+        if rate_per_s <= 0:
+            raise ValueError("churn rate must be > 0 flaps/host/second")
+        sel = np.arange(num_hosts) if hosts is None \
+            else np.asarray(sorted(set(int(x) for x in hosts)))
+        if sel.size == 0:
+            return self
+        span_s = (int(t_end) - int(t_start)) / SEC
+        if span_s <= 0:
+            raise ValueError("chaos needs t_end > t_start")
+        mean_up_s = 1.0 / rate_per_s
+        # Draw enough cycles to cover the span with slack; surplus draws
+        # land past t_end and are discarded below.
+        n_cyc = int(np.ceil(span_s / (mean_up_s + mean_down_s) * 3 + 4))
+        key = rng.purpose_key(seed_key, rng.PURPOSE_CHAOS)
+        hh = np.repeat(sel, n_cyc).astype(np.uint32)
+        jj = np.tile(np.arange(n_cyc, dtype=np.uint32), sel.size)
+        u_up = np.asarray(rng.keyed_uniform(key, hh, 2 * jj),
+                          np.float64).reshape(sel.size, n_cyc)
+        u_dn = np.asarray(rng.keyed_uniform(key, hh, 2 * jj + 1),
+                          np.float64).reshape(sel.size, n_cyc)
+        d_up = -mean_up_s * np.log1p(-u_up)
+        d_dn = -mean_down_s * np.log1p(-u_dn)
+        # Interleave up/down durations and accumulate into event times.
+        durs = np.empty((sel.size, 2 * n_cyc))
+        durs[:, 0::2] = d_up
+        durs[:, 1::2] = d_dn
+        times = int(t_start) + np.cumsum(durs * SEC, axis=1).astype(np.int64)
+        for hi, host in enumerate(sel):
+            for c in range(n_cyc):
+                t_down = times[hi, 2 * c]
+                t_up = times[hi, 2 * c + 1]
+                if t_down >= t_end:
+                    break
+                self.host_down(int(host), int(t_down))
+                # A flap straddling t_end still restores the host.
+                self.host_up(int(host), int(min(t_up, int(t_end))))
+        return self
+
+    # -- build ------------------------------------------------------------
+    def link_pairs(self):
+        return {(min(a, b), max(a, b)) for (_t, k, a, b, _v)
+                in self.events if k in _PAIR_KINDS or
+                (k in (EV_LINK_LAT, EV_LINK_LOSS) and a >= 0)}
+
+    def build(self, num_hosts: int):
+        """Lower to a NetemBlock, or None when the timeline is empty --
+        the None fast path keeps untouched worlds bit-identical."""
+        if not self.events and not self.groups:
+            return None
+        groups = np.zeros(num_hosts, np.int32)
+        for h, g in self.groups.items():
+            if not 0 <= h < num_hosts:
+                raise ValueError(f"group host {h} out of range "
+                                 f"[0, {num_hosts})")
+            groups[h] = g
+        for (_t, _k, a, b, _v) in self.events:
+            for x in (a, b):
+                if x >= num_hosts:
+                    raise ValueError(f"event host {x} out of range "
+                                     f"[0, {num_hosts})")
+        return make_netem_block(num_hosts, self.events,
+                                link_pairs=self.link_pairs(),
+                                groups=groups)
+
+    def describe(self) -> dict:
+        """Compact summary for bench/metrics config blocks."""
+        from .state import KIND_NAMES
+        kinds: dict = {}
+        for (_t, k, _a, _b, _v) in self.events:
+            name = KIND_NAMES[k]
+            kinds[name] = kinds.get(name, 0) + 1
+        return {"n_events": len(self.events), "kinds": kinds,
+                "n_groups": len(set(self.groups.values())) or 0}
+
+
+def timeline() -> Timeline:
+    return Timeline()
+
+
+def install(state, params, tl: Timeline):
+    """Attach a timeline to a built world: returns (state, params) with
+    the block on `state.nm` and the conservative lookahead shrunk by the
+    smallest latency scale the schedule can reach (a sub-1.0 scale would
+    otherwise let the window overrun the smallest live latency).  An
+    empty timeline returns the inputs unchanged (None fast path)."""
+    num_hosts = int(state.hosts.num_hosts)
+    block = tl.build(num_hosts)
+    if block is None:
+        return state, params
+    scale = _apply.min_lat_scale_x1000(tl.events)
+    if scale < SCALE_ONE:
+        import jax.numpy as jnp
+        new_min = jnp.maximum(
+            (params.min_latency_ns * scale) // SCALE_ONE,
+            jnp.asarray(1, jnp.int64))
+        params = params.replace(min_latency_ns=new_min)
+    return state.replace(nm=block), params
+
+
+def load_json(path_or_obj, resolve=None) -> Timeline:
+    """Load a timeline from a JSON events file (--netem):
+
+        {"events": [
+           {"time": 2.0, "kind": "link_down", "a": "client", "b": "server"},
+           {"time": 4.0, "kind": "link_up",   "a": "client", "b": "server"},
+           {"time": 1.0, "kind": "host_down", "a": 3},
+           {"time": 1.0, "kind": "latency_scale", "value": 2.5},
+           {"time": 5.0, "kind": "loss", "value": 0.01, "a": 0, "b": 1},
+           {"time": 6.0, "kind": "partition", "groups": [1]},
+           {"time": 8.0, "kind": "bandwidth_scale", "value": 0.5, "a": 2}],
+         "groups": {"relay1": 1, "relay2": 1}}
+
+    `time` is simulated seconds.  Host references (`a`, `b`, group keys)
+    are host indices, or names when `resolve(name) -> index` is given
+    (the CLI passes the world's DNS).
+    """
+    if isinstance(path_or_obj, str):
+        with open(path_or_obj) as f:
+            obj = json.load(f)
+    else:
+        obj = path_or_obj
+
+    def host(x):
+        if x is None:
+            return None
+        if isinstance(x, str) and x.lstrip("-").isdigit():
+            return int(x)   # XML attributes arrive as strings
+        if isinstance(x, str) and resolve is None:
+            raise ValueError(f"netem event names a host {x!r} but no "
+                             f"resolver is available (use indices)")
+        return int(resolve(x)) if isinstance(x, str) else int(x)
+
+    tl = Timeline()
+    for name, g in (obj.get("groups") or {}).items():
+        tl.set_group(host(name), int(g))
+    for e in obj.get("events", []):
+        kind = e.get("kind")
+        if kind not in KIND_BY_NAME:
+            raise ValueError(f"unknown netem event kind {kind!r} "
+                             f"(known: {sorted(KIND_BY_NAME)})")
+        at = int(float(e["time"]) * SEC)
+        a, b = host(e.get("a")), host(e.get("b"))
+        k = KIND_BY_NAME[kind]
+        if k == EV_LINK_DOWN:
+            tl.link_down(a, b, at)
+        elif k == EV_LINK_UP:
+            tl.link_up(a, b, at)
+        elif k == EV_LINK_LAT:
+            tl.latency_scale(float(e["value"]), at, a=a, b=b)
+        elif k == EV_LINK_LOSS:
+            tl.loss(float(e["value"]), at, a=a, b=b)
+        elif k == EV_HOST_DOWN:
+            tl.host_down(a, at)
+        elif k == EV_HOST_UP:
+            tl.host_up(a, at)
+        elif k == EV_PARTITION:
+            groups = e.get("groups", [])
+            if groups:
+                tl.partition([int(g) for g in groups], at)
+            else:
+                tl.heal(at)
+        elif k == EV_BW_SCALE:
+            tl.bandwidth_scale(float(e["value"]), at, host=a)
+    return tl

@@ -216,6 +216,7 @@ _LOG_MSG = {
     5: "delivered packet from host {arg}",
     6: "sent packet to host {arg}",
     7: "thinned {arg} pure ACKs at exchange overflow",
+    8: "netem: inbound packet from host {arg} killed (host down)",
 }
 
 

@@ -172,6 +172,24 @@ def build_gossip(num_hosts: int = 500,
     return state, params, gossip_app.Gossip()
 
 
+def add_churn(state, params, rate_per_s: float,
+              mean_down_s: float = 5.0, hosts=None,
+              t_start: int = 0, t_end: int | None = None):
+    """Install seeded chaos churn on a built world: every selected host
+    alternates exponential up-times (mean 1/rate_per_s s) and down-times
+    (mean mean_down_s s), drawn from params.seed_key -- bitwise
+    reproducible for a given seed (netem/timeline.py chaos).  Returns
+    (state, params); params' conservative lookahead is untouched (churn
+    never shortens latencies)."""
+    from . import netem
+    num_hosts = int(state.hosts.num_hosts)
+    tl = netem.timeline().chaos(
+        params.seed_key, num_hosts, rate_per_s,
+        mean_down_s=mean_down_s, hosts=hosts, t_start=t_start,
+        t_end=int(params.stop_time) if t_end is None else int(t_end))
+    return netem.install(state, params, tl)
+
+
 def run(state, params, app, until=None, profiler=None):
     """Run to `until` (default: params.stop_time).
 

@@ -316,6 +316,12 @@ def fetch_counters(state, profiler=None) -> dict:
         vals += [state.tr.exchanges, state.tr.pkts_exchanged,
                  state.tr.occ_max]
         names += ["exchanges", "pkts_exchanged", "inbox_occ_max"]
+    if getattr(state, "nm", None) is not None:
+        import jax.numpy as _jnp
+        vals += [state.nm.cursor, state.nm.killed,
+                 _jnp.sum(state.nm.host_up == 0)]
+        names += ["netem_events_applied", "netem_killed",
+                  "netem_hosts_down"]
     fetched = jax.device_get(vals)
     out = {n: int(v) for n, v in zip(names, fetched)}
     if state.tr is not None:

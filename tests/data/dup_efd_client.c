@@ -1,0 +1,68 @@
+/* Shim fd-table semantics: eventfd readiness through poll, and dup/dup2
+ * aliases over one virtual TCP socket (the bridge connection must
+ * survive until the LAST alias closes).  Runs under the shadow1 shim
+ * against the modeled echo server; exits 0 and prints "dup_efd ok". */
+#include <arpa/inet.h>
+#include <poll.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+static int fail(const char *m) {
+  printf("FAIL %s\n", m);
+  return 1;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 3) return fail("usage: dup_efd_client ip port");
+
+  /* --- eventfd under the shim: counter + poll readiness -------------- */
+  int efd = eventfd(0, 0);
+  if (efd < 0) return fail("eventfd");
+  struct pollfd pf = {.fd = efd, .events = POLLIN, .revents = 0};
+  if (poll(&pf, 1, 50) != 0) return fail("empty efd must time out");
+  uint64_t v = 3;
+  if (write(efd, &v, 8) != 8) return fail("efd write");
+  if (poll(&pf, 1, -1) != 1 || !(pf.revents & POLLIN))
+    return fail("posted efd must poll POLLIN");
+  v = 0;
+  if (read(efd, &v, 8) != 8 || v != 3) return fail("efd read");
+  pf.revents = 0;
+  if (poll(&pf, 1, 0) != 0) return fail("drained efd must not be ready");
+  if (close(efd) != 0) return fail("efd close");
+
+  /* --- dup/dup2 aliases over one virtual TCP socket ------------------ */
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return fail("socket");
+  struct sockaddr_in a = {0};
+  a.sin_family = AF_INET;
+  a.sin_port = htons((uint16_t)atoi(argv[2]));
+  inet_pton(AF_INET, argv[1], &a.sin_addr);
+  if (connect(fd, (struct sockaddr *)&a, sizeof a) != 0)
+    return fail("connect");
+  int d = dup(fd);
+  if (d < 0 || d == fd) return fail("dup");
+  int target = 137;
+  if (dup2(fd, target) != target) return fail("dup2");
+  if (close(fd) != 0) return fail("close original");
+  /* Two aliases remain: send on one, read the echo back on the other. */
+  const char msg[] = "0123456789abcdef0123456789abcdef";
+  if (send(d, msg, sizeof msg, 0) != (ssize_t)sizeof msg)
+    return fail("send on dup alias");
+  char buf[sizeof msg];
+  size_t got = 0;
+  while (got < sizeof msg) {
+    ssize_t r = recv(target, buf + got, sizeof msg - got, 0);
+    if (r <= 0) return fail("recv on dup2 alias");
+    got += (size_t)r;
+  }
+  if (memcmp(buf, msg, sizeof msg) != 0) return fail("echo mismatch");
+  if (close(d) != 0) return fail("close dup alias");
+  if (close(target) != 0) return fail("close dup2 alias");
+  printf("dup_efd ok\n");
+  return 0;
+}

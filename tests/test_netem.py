@@ -1,0 +1,259 @@
+"""Device-resident network dynamics & fault injection (shadow1_tpu/netem/).
+
+The contract under test (docs/netem.md):
+
+* present-or-None: an EMPTY timeline builds to None and a present block
+  whose events never fire leaves every counter bitwise identical to a
+  run without the subsystem;
+* events apply IN ORDER at window granularity via the device cursor,
+  canonically under any run_until chunking;
+* kills are COUNTED (nm.killed mirrors pkts_dropped_inet for host-down
+  drops) and seeded chaos churn is bitwise reproducible;
+* a mid-run link flap does not wedge TCP: retransmission completes the
+  stream after the link heals.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+import shadow1_tpu  # noqa: F401  (x64)
+from shadow1_tpu import netem, sim, trace
+from shadow1_tpu.core import engine, simtime
+
+SEC = simtime.SIMTIME_ONE_SECOND
+MS = simtime.SIMTIME_ONE_MILLISECOND
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+def _phold(n=8, stop=2 * SEC, seed=1):
+    return sim.build_phold(num_hosts=n, msgs_per_host=2, stop_time=stop,
+                           seed=seed)
+
+
+def _totals(state):
+    h = state.hosts
+    return (int(state.app.recv.sum()), int(state.app.sent.sum()),
+            int(h.pkts_dropped_inet.sum()), int(h.pkts_dropped_router.sum()))
+
+
+class TestBuild:
+    def test_empty_timeline_is_none(self):
+        assert netem.timeline().build(8) is None
+
+    def test_install_empty_is_identity(self):
+        state, params, _app = _phold()
+        s2, p2 = netem.install(state, params, netem.timeline())
+        assert s2 is state and p2 is params
+
+    def test_schedule_sorted_and_stable(self):
+        # Out-of-order inserts sort by time; same-time events keep
+        # insertion order (the cursor applies them in that order).
+        tl = (netem.timeline()
+              .host_down(1, at=5 * MS)
+              .host_down(2, at=1 * MS)
+              .host_up(2, at=5 * MS))
+        nm = tl.build(8)
+        t = np.asarray(nm.ev_time[:3])
+        assert list(t) == sorted(t)
+        # The two t=5ms events: host_down(1) was inserted first.
+        kinds = np.asarray(nm.ev_kind[1:3])
+        assert kinds[0] == netem.EV_HOST_DOWN
+        assert kinds[1] == netem.EV_HOST_UP
+
+    def test_latency_scale_shrinks_lookahead(self):
+        state, params, _app = _phold()
+        before = int(params.min_latency_ns)
+        tl = netem.timeline().latency_scale(0.5, at=1 * SEC)
+        _s, p2 = netem.install(state, params, tl)
+        assert int(p2.min_latency_ns) == before // 2
+
+    def test_load_json_resolves_names(self):
+        ids = {"client": 1, "server": 0}
+        tl = netem.load_json({
+            "events": [
+                {"time": 2.0, "kind": "link_down",
+                 "a": "client", "b": "server"},
+                {"time": 4.0, "kind": "link_up", "a": 1, "b": 0},
+                {"time": 1.0, "kind": "latency_scale", "value": 2.0},
+                {"time": 6.0, "kind": "partition", "groups": [1]},
+                {"time": 7.0, "kind": "partition"},  # heal
+            ],
+            "groups": {"client": 1},
+        }, resolve=ids.get)
+        assert tl.describe()["n_events"] == 5
+        assert tl.groups == {1: 1}
+        nm = tl.build(4)
+        assert nm is not None and int(nm.n_events) == 5
+
+
+class TestEngineOverlay:
+    def test_neutral_block_bitwise_identity(self):
+        # A block whose only event fires long after stop_time must leave
+        # the run bitwise identical to one with no block at all (the
+        # integer-exact neutral-overlay contract).
+        state, params, app = _phold()
+        clean = engine.run_until(state, params, app, 2 * SEC)
+        tl = netem.timeline().host_down(3, at=100 * SEC)
+        s2, p2 = netem.install(state, params, tl)
+        faulted = engine.run_until(s2, p2, app, 2 * SEC)
+        assert _totals(clean) == _totals(faulted)
+        assert jnp.array_equal(clean.app.recv, faulted.app.recv)
+        assert jnp.array_equal(clean.hosts.pkts_dropped_inet,
+                               faulted.hosts.pkts_dropped_inet)
+        assert int(faulted.nm.cursor) == 0
+        assert int(faulted.nm.killed) == 0
+
+    def test_host_down_drops_counted_as_inet(self):
+        state, params, app = _phold()
+        tl = netem.timeline().host_down(3, at=0)
+        s2, p2 = netem.install(state, params, tl)
+        out = engine.run_until(s2, p2, app, 2 * SEC)
+        killed = int(out.nm.killed)
+        assert killed > 0
+        assert killed == int(out.hosts.pkts_dropped_inet.sum())
+        assert int(out.nm.cursor) == 1
+        assert int(out.err) == 0
+
+    def test_partition_blocks_cross_group_until_heal(self):
+        state, params, app = _phold(n=16)
+        tl = netem.timeline()
+        for h in range(16):
+            tl.set_group(h, h % 2)
+        tl.partition([1], at=0).heal(at=1 * SEC)
+        s2, p2 = netem.install(state, params, tl)
+        out = engine.run_until(s2, p2, app, 2 * SEC)
+        assert int(out.nm.cursor) == 2
+        assert int(out.nm.killed) > 0
+        assert int(out.nm.killed) == int(out.hosts.pkts_dropped_inet.sum())
+        # After the heal the world keeps running (phold traffic exists).
+        assert int(out.app.recv.sum()) > 0
+
+    def test_trace_counters_include_netem(self):
+        state, params, app = _phold()
+        tl = netem.timeline().host_down(3, at=0)
+        s2, p2 = netem.install(state, params, tl)
+        out = engine.run_until(s2, p2, app, 1 * SEC)
+        vals = trace.fetch_counters(out)
+        assert vals["netem_events_applied"] == 1
+        assert vals["netem_killed"] == int(out.nm.killed)
+        assert vals["netem_hosts_down"] == 1
+
+
+class TestChaosDeterminism:
+    def _chaos_run(self, seed=1):
+        state, params, app = _phold(n=16, stop=3 * SEC, seed=seed)
+        tl = netem.timeline().chaos(params.seed_key, 16, 0.8,
+                                    mean_down_s=0.5, t_end=3 * SEC)
+        s2, p2 = netem.install(state, params, tl)
+        return tl, engine.run_until(s2, p2, app, 3 * SEC)
+
+    def test_same_seed_same_run(self):
+        tl1, out1 = self._chaos_run(seed=1)
+        tl2, out2 = self._chaos_run(seed=1)
+        assert tl1.events == tl2.events
+        assert int(out1.nm.cursor) == int(out2.nm.cursor)
+        assert int(out1.nm.killed) == int(out2.nm.killed)
+        assert jnp.array_equal(out1.hosts.pkts_dropped_inet,
+                               out2.hosts.pkts_dropped_inet)
+        assert jnp.array_equal(out1.app.recv, out2.app.recv)
+
+    def test_different_seed_differs(self):
+        tl1, _ = self._chaos_run(seed=1)
+        tl2, _ = self._chaos_run(seed=2)
+        assert tl1.events != tl2.events
+
+    def test_chunking_canonical(self):
+        # Counters (cursor included) must not depend on how run_until is
+        # chunked: the final advance makes the cursor catch up to
+        # t_target at every boundary.
+        state, params, app = _phold(n=16, stop=3 * SEC)
+        tl = netem.timeline().chaos(params.seed_key, 16, 0.8,
+                                    mean_down_s=0.5, t_end=3 * SEC)
+        s2, p2 = netem.install(state, params, tl)
+        whole = engine.run_until(s2, p2, app, 3 * SEC)
+        step = s2
+        for k in range(1, 4):
+            step = engine.run_until(step, p2, app, k * SEC)
+        assert int(whole.nm.cursor) == int(step.nm.cursor)
+        assert int(whole.nm.killed) == int(step.nm.killed)
+        assert jnp.array_equal(whole.hosts.pkts_dropped_inet,
+                               step.hosts.pkts_dropped_inet)
+        assert jnp.array_equal(whole.app.recv, step.app.recv)
+
+
+class TestTcpThroughFaults:
+    def test_bulk_completes_through_link_flap(self):
+        # Client 1's link to the server dies mid-transfer and heals 1.4s
+        # later; TCP retransmission must finish the stream (the killed
+        # packets are real losses, not silent stalls).  Client 2 rides
+        # an untouched link as the control.
+        state, params, app = sim.build_bulk(
+            num_hosts=3, server=0, bytes_per_client=500_000,
+            stop_time=30 * SEC, bw_up_Bps=1 << 22, bw_down_Bps=1 << 22)
+        tl = (netem.timeline()
+              .link_down(1, 0, at=100 * MS)
+              .link_up(1, 0, at=1500 * MS))
+        s2, p2 = netem.install(state, params, tl)
+        out = engine.run_until(s2, p2, app, 10 * SEC)
+        phase = np.asarray(out.app.phase)
+        assert list(phase[1:]) == [2, 2], f"clients not done: {phase}"
+        assert int(out.nm.killed) > 0
+        assert int(out.err) == 0
+        # The flapped client finished strictly after the healthy one.
+        ft = np.asarray(out.app.finish_t)
+        assert ft[1] > ft[2]
+        assert ft[1] > 1500 * MS
+
+    def test_tgen_under_link_flap_completes(self):
+        # Config-driven path: the <netem> section lowers through
+        # assemble.build onto the 2-host tgen example; the client's 3
+        # streams must survive a mid-run link outage.
+        from shadow1_tpu.config import assemble, shadowxml
+        cfg = shadowxml.parse(os.path.join(EXAMPLES, "tgen-2host",
+                                           "shadow.config.xml"))
+        cfg.netem = shadowxml.NetemSpec(events=[
+            {"time": 3.0, "kind": "link_down", "a": "client",
+             "b": "server"},
+            {"time": 5.0, "kind": "link_up", "a": "client",
+             "b": "server"},
+        ])
+        asm = assemble.build(cfg, seed=3)
+        st = asm.state
+        assert st.nm is not None and int(st.nm.n_events) == 2
+        for t in range(1, 31):
+            st = engine.run_until(st, asm.params, asm.app, t * SEC)
+            a = st.app
+            if bool(jnp.all(a.finished | (a.cur < 0))):
+                break
+        assert int(st.err) == 0
+        assert int(st.nm.cursor) == 2
+        assert int(st.app.streams_done[1]) == 3
+        assert int(st.app.streams_failed.sum()) == 0
+
+
+class TestXmlFrontEnd:
+    def test_netem_section_parses(self):
+        from shadow1_tpu.config import shadowxml
+        cfg = shadowxml.parse("""
+        <shadow stoptime="10">
+          <topology path="t.graphml"/>
+          <netem churnrate="0.5" churndowntime="2.5">
+            <event time="1" kind="host_down" a="a1"/>
+            <event time="2.5" kind="latency_scale" value="2.0"/>
+            <event time="3" kind="partition" groups="1,2"/>
+            <group host="a1" id="1"/>
+          </netem>
+          <host id="a1"/>
+        </shadow>""")
+        nm = cfg.netem
+        assert nm is not None
+        assert nm.churn_rate == 0.5
+        assert nm.churn_downtime_s == 2.5
+        assert len(nm.events) == 3
+        assert nm.events[1] == {"time": 2.5, "kind": "latency_scale",
+                                "value": 2.0}
+        assert nm.events[2]["groups"] == [1, 2]
+        assert nm.groups == {"a1": 1}

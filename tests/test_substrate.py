@@ -131,6 +131,30 @@ class TestRealProcess:
         # Server echoed exactly the stream, no phantom byte.
         assert int(out.socks.bytes_recv[0].sum()) == total
 
+    def test_dup_aliases_and_eventfd_poll(self, tmp_path):
+        # dup/dup2 make additional low-fd aliases of one virtual socket
+        # (the bridge connection must survive until the LAST alias
+        # closes), and an eventfd participates in poll like a timerfd:
+        # not-ready parks in virtual time, a posted counter is POLLIN.
+        state, params, app = _world(seed=5)
+        sub = Substrate(
+            resolve_ip={_ip_int(SERVER_IP): 0}.get,
+            workdir=str(tmp_path / "dup"))
+
+        def echo_content(host, vs, offset, n):
+            return bytes(vs.sent[offset:offset + n])
+
+        sub.content_provider = echo_content
+        src = pathlib.Path(__file__).parent / "data" / "dup_efd_client.c"
+        p = sub.spawn(1, [buildlib.build_binary(src, "dup_efd_client"),
+                          SERVER_IP, str(SERVER_PORT)])
+        out = bridge.run(sub, state, params, app, 30 * SEC)
+        stdout = (pathlib.Path(sub.workdir) / "proc-0.stdout").read_text()
+        assert p.exited and p.exit_code == 0, \
+            f"rc={p.exit_code} stdout={stdout!r}"
+        assert "dup_efd ok" in stdout
+        assert int(out.err) == 0
+
     def test_real_client_real_server_byte_exact(self, tmp_path):
         # BOTH endpoints are real compiled binaries: the server's
         # listen/accept ride the modeled listener/child machinery, and the

@@ -51,6 +51,16 @@ def _flatten(d: dict, prefix: str = "") -> dict:
     return out
 
 
+def _netem_config(d: dict):
+    """Normalized fault-injection config of a bench JSON: None for a
+    clean run (including files recorded before the netem field existed),
+    else the netem dict itself."""
+    cfg = d.get("config")
+    if not isinstance(cfg, dict):
+        return None
+    return cfg.get("netem") or None
+
+
 def _direction(name: str):
     """'up' (bigger better), 'down' (smaller better), or None (info)."""
     leaf = name.rsplit(".", 1)[-1]
@@ -95,6 +105,14 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     old, new = _load(args.old), _load(args.new)
+    nm_old, nm_new = _netem_config(old), _netem_config(new)
+    if nm_old != nm_new:
+        # A churned run is a different workload, not a regression signal.
+        print(f"benchdiff: refusing to compare runs with different "
+              f"fault-injection configs (old netem={nm_old!r}, "
+              f"new netem={nm_new!r}); rerun with matching --churn/"
+              f"netem settings", file=sys.stderr)
+        return 2
     rows, regressions = diff(old, new, args.threshold)
     if not rows:
         print("benchdiff: no shared directional metrics between the two "

@@ -9,6 +9,7 @@ excluded; steady-state wall time per simulated second reported):
   rung 4: phold event-rate probe          (bench.py metric)
   rung 5: 10k-host onion circuits         (sim.build_onion(2000))
   rung 6: 500-node Bitcoin gossip flood   (sim.build_gossip(500))
+  rung 7: phold under netem chaos churn   (sim.add_churn, docs/netem.md)
 
     python tools/ladder.py [rung ...]     # default: 1 2 3 5 6
 """
@@ -115,6 +116,28 @@ def rung_onion(circuits: int, pool_slab: int = 64):
     }
 
 
+def rung_phold_churn(rate_per_s: float = 0.5, mean_down_s: float = 1.0):
+    # The phold probe with the netem overlay LIVE: seeded chaos flaps
+    # every host (exponential up/down churn), so this rung prices the
+    # overlay math + event cursor against rung 4's clean number and
+    # shows the fault path exercised at scale.
+    s, p, a = sim.build_phold(num_hosts=16384, msgs_per_host=4,
+                              stop_time=10 * SEC,
+                              pool_capacity=16384 * 8,
+                              rx_batch=2)
+    s, p = sim.add_churn(s, p, rate_per_s, mean_down_s=mean_down_s)
+    res, out = _measure(s, p, a, 1, 2)
+    res["events"] = int(out.app.sent.sum() + out.app.recv.sum())
+    res["netem"] = {
+        "churn_rate": rate_per_s,
+        "churn_downtime_s": mean_down_s,
+        "events_applied": int(out.nm.cursor),
+        "packets_killed": int(out.nm.killed),
+        "hosts_down_at_stop": int((out.nm.host_up == 0).sum()),
+    }
+    return res
+
+
 def rung_gossip():
     # BASELINE config 4's workload class: 500 nodes, 12 peers each,
     # inv/getdata/item floods every 200 ms.
@@ -129,7 +152,7 @@ def rung_gossip():
 
 
 def main(rungs):
-    unknown = set(rungs) - {"1", "2", "3", "4", "5", "6"}
+    unknown = set(rungs) - {"1", "2", "3", "4", "5", "6", "7"}
     if unknown:
         raise SystemExit(f"unknown ladder rungs: {sorted(unknown)}")
     results = {"backend": jax.default_backend()}
@@ -160,6 +183,8 @@ def main(rungs):
         record("onion_10k", lambda: rung_onion(2000, pool_slab=64))
     if "6" in rungs:
         record("gossip_500", rung_gossip)
+    if "7" in rungs:
+        record("phold_16k_churn", rung_phold_churn)
     print(json.dumps(results))
 
 
