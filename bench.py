@@ -31,8 +31,18 @@ import jax
 from shadow1_tpu import sim, trace
 from shadow1_tpu.core import engine, simtime
 
-def _baseline_events_per_sec() -> tuple[float, str]:
-    """Measured comparator rate (events/sec) + provenance tag."""
+# The pre-measurement placeholder denominator: rounds recorded before
+# baseline/measured.json existed (r4 and earlier) divided by this, so
+# their vs_baseline is NOT comparable with measured rounds -- the r05
+# switch to the ~5.68M measured rate silently re-scaled the ratio by
+# ~5.7x.  The provenance fields below make that shift explicit in every
+# JSON from now on.
+NOMINAL_BASELINE = 1.0e6
+
+
+def _baseline_events_per_sec() -> tuple[float, str, str, str]:
+    """Comparator rate (events/sec) + provenance:
+    (rate, kind, source, note)."""
     import pathlib
     import subprocess
     root = pathlib.Path(__file__).resolve().parent
@@ -43,9 +53,48 @@ def _baseline_events_per_sec() -> tuple[float, str]:
                 [sys.executable, str(root / "tools" / "refbase.py"),
                  "--quick"], check=True, capture_output=True, timeout=600)
         data = json.loads(cached.read_text())
-        return float(data["phold"]["events_per_sec"]), "measured"
+        rate = float(data["phold"]["events_per_sec"])
+        note = ("vs_baseline divides by the pthread DES measured on this "
+                "machine (tools/refbase.py); rounds recorded before the "
+                "measured file existed used the 1e6 nominal placeholder, "
+                "so their vs_baseline is on a different scale")
+        return rate, "measured", str(cached), note
     except Exception:  # noqa: BLE001  (toolchain missing: nominal fallback)
-        return 1.0e6, "nominal"
+        note = ("baseline toolchain unavailable: vs_baseline divides by "
+                "the 1e6 nominal placeholder, NOT comparable with rounds "
+                "whose baseline_kind is 'measured'")
+        return NOMINAL_BASELINE, "nominal", "nominal:1e6", note
+
+
+def _kernel_counts(rx_batch: int) -> dict | None:
+    """Compiled HLO op/fusion counts per engine phase, measured in a
+    fresh CPU-pinned interpreter (tools/kernelcount.py --json).
+
+    A subprocess for the same reason dryrun_multichip uses one: the
+    count is a property of the compiled graph, not the accelerator, and
+    the measuring interpreter must not touch (or disturb) the ambient
+    TPU backend mid-benchmark.  Returns None when counting fails --
+    the benchmark result must never be lost to its own metadata."""
+    import os
+    import pathlib
+    import subprocess
+    root = pathlib.Path(__file__).resolve().parent
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SHADOW1_TPU_CACHE"] = ""
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.pop("XLA_FLAGS", None)
+    try:
+        r = subprocess.run(
+            [sys.executable, str(root / "tools" / "kernelcount.py"),
+             "--json", "--rx-batch", str(rx_batch)],
+            env=env, cwd=str(root), capture_output=True, text=True,
+            timeout=600)
+        if r.returncode != 0:
+            return None
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001
+        return None
 
 # Throughput scales with the host count (each micro-step advances every
 # host; the per-step reductions grow sublinearly), so the benchmark runs
@@ -56,7 +105,8 @@ MEAN_DELAY_NS = 10 * simtime.SIMTIME_ONE_MILLISECOND
 SIM_SECONDS = 2
 
 
-def main(churn: float | None = None, churn_downtime_s: float = 5.0):
+def main(churn: float | None = None, churn_downtime_s: float = 5.0,
+         gate_against: str | None = None):
     # The benchmark opts into arrival batching explicitly (rx_batch=2,
     # the measured sweet spot); the app default is serial rx_batch=1.
     # The batching config rides the JSON so recorded rounds are
@@ -115,17 +165,23 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0):
         + int(out.app.sent.sum() - warm.app.sent.sum())
     rate = events / wall
     steps = max(n_steps - int(warm.n_steps), 1)
-    base_rate, base_kind = _baseline_events_per_sec()
+    base_rate, base_kind, base_source, base_note = \
+        _baseline_events_per_sec()
     counters = trace.fetch_counters(out, profiler)
+    # Compiled-graph size (measured after the timed passes so the CPU
+    # subprocess never competes with the benchmark for the machine).
+    profiler.set_kernelcount(_kernel_counts(app.rx_batch))
     metrics = profiler.metrics()
     trace.install(None)
-    print(json.dumps({
+    result = {
         "metric": "phold_events_per_sec",
         "value": round(rate, 2),
         "unit": "events/sec",
         "vs_baseline": round(rate / base_rate, 4),
         "baseline_events_per_sec": base_rate,
         "baseline_kind": base_kind,
+        "baseline_source": base_source,
+        "baseline_note": base_note,
         "events_per_microstep": round(events / steps, 2),
         "microsteps": steps,
         "windows": int(out.n_windows) - int(warm.n_windows),
@@ -143,8 +199,36 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0):
             "compile": metrics["compile"],
             "transfers": metrics["transfers"],
             "device_counters": counters,
+            "kernelcount": metrics.get("kernelcount"),
         },
-    }))
+    }
+    print(json.dumps(result))
+    if gate_against:
+        return _gate(gate_against, result)
+    return 0
+
+
+def _gate(old_path: str, result: dict) -> int:
+    """Diff this run against a recorded round with tools/benchdiff.py
+    --kernels: fail (nonzero) when throughput OR compiled kernel count
+    regressed.  The bench-flow wiring for CI / future rounds:
+
+        python bench.py --gate-against BENCH_r05.json
+    """
+    import pathlib
+    import tempfile
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent
+                           / "tools"))
+    import benchdiff
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump(result, f)
+        new_path = f.name
+    rc = benchdiff.main([old_path, new_path, "--kernels"])
+    if rc:
+        print(f"bench gate FAILED against {old_path} (rc={rc})",
+              file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":
@@ -155,15 +239,22 @@ if __name__ == "__main__":
                          "second (recorded in the JSON config block)")
     ap.add_argument("--churn-downtime", type=float, default=5.0,
                     metavar="SECONDS", help="mean down-time per flap")
+    ap.add_argument("--gate-against", default=None, metavar="OLD_JSON",
+                    help="after printing the result, diff it against a "
+                         "recorded BENCH_r{N}.json / bench line with "
+                         "tools/benchdiff.py --kernels and exit nonzero "
+                         "on a throughput or kernel-count regression")
     ns = ap.parse_args()
     # The TPU tunnel's compile service occasionally drops a request
     # ("response body closed", "TPU device error"); one retry rides out
     # such transients so a flaky RPC doesn't record a failed round.
     try:
-        main(ns.churn, ns.churn_downtime)
+        sys.exit(main(ns.churn, ns.churn_downtime, ns.gate_against))
+    except SystemExit:
+        raise
     except Exception:  # noqa: BLE001
         import traceback
         print("bench attempt 1 failed; retrying", file=sys.stderr)
         traceback.print_exc()
         time.sleep(20)
-        main(ns.churn, ns.churn_downtime)
+        sys.exit(main(ns.churn, ns.churn_downtime, ns.gate_against))

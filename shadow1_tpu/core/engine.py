@@ -458,7 +458,7 @@ def _wire_bytes(proto, length):
 
 
 def _rx_phase(state: SimState, params, em, tick_t, active, app,
-              window_end):
+              window_end, bw_dn=None, alive=None):
     """Arrivals: router enqueue (stage flip), NIC token/CoDel drain of one
     packet per host, transport delivery, inbox slot free.
 
@@ -564,7 +564,8 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
     ids = jnp.arange(ki, dtype=I32)[None, :]
     rows = jnp.arange(h, dtype=I32)
     boot = tick_t < params.bootstrap_end
-    bw_dn = netem_apply.rate(state.nm, params.bw_down_Bps)
+    if bw_dn is None:
+        bw_dn = netem_apply.rate(state.nm, params.bw_down_Bps)
     tokens, last = nic.refill(hosts.tokens_rx, hosts.last_refill_rx,
                               bw_dn, tick_t, active)
     hosts = hosts.replace(last_refill_rx=last)
@@ -642,7 +643,9 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
         # plus loopback sends that bypass the staging drop).  The slot
         # still frees (funded), so nothing strands.
         if state.nm is not None:
-            nm_kill = deliver & ~netem_apply.alive(state.nm)
+            up = alive if alive is not None else \
+                netem_apply.alive(state.nm)
+            nm_kill = deliver & ~up
             deliver = deliver & ~nm_kill
         else:
             nm_kill = None
@@ -732,9 +735,24 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
             tcp_mask = deliver & (pkt.proto == PROTO_TCP)
             reply_slot = emit.SLOT_RX_REPLY if r == 0 \
                 else emit.NUM_SLOTS + r - 1
-            state, em = tcp_mod.process_arrivals(state, params, em, t_eff,
-                                                 pkt, tcp_mask,
-                                                 reply_slot=reply_slot)
+
+            def _arrivals(args, _pkt=pkt, _mask=tcp_mask, _t=t_eff,
+                          _slot=reply_slot):
+                s_, e_ = args
+                return tcp_mod.process_arrivals(s_, params, e_, _t, _pkt,
+                                                _mask, reply_slot=_slot)
+
+            if params.kernel_diet:
+                # KERNEL-DIET GATE: rounds with no TCP arrival anywhere
+                # skip the whole per-round arrival machine (socket
+                # match, ACK clocking, reassembly).  Exact skip: every
+                # write in process_arrivals is masked by (a subset of)
+                # tcp_mask, and emit.put under a false mask is the
+                # identity.
+                state, em = jax.lax.cond(jnp.any(tcp_mask), _arrivals,
+                                         lambda a: a, (state, em))
+            else:
+                state, em = _arrivals((state, em))
 
         hosts = state.hosts
         hosts = hosts.replace(
@@ -764,6 +782,15 @@ def _route(params, vs, vd, src, ctr):
     (reference carries per-edge jitter, topology.c:81-105).
 
     Returns (latency_ns i64, reliability f32)."""
+    if not params.has_jitter:
+        # STATIC no-jitter world: the perturbation is provably zero
+        # (jit == 0 makes the where() drop delta), so the keyed-uniform
+        # hash chain traces away entirely and the routing gather narrows
+        # to the leading (lat, rel) columns.  RNG draws are functionally
+        # keyed -- skipping one consumes nothing -- so this is bitwise-
+        # neutral.
+        lat, rel = params.route_narrow(vs, vd)
+        return jnp.maximum(lat, simtime.SIMTIME_ONE_NANOSECOND), rel
     lat, jit, rel = params.route(vs, vd)
     key = rng.purpose_key(params.seed_key, rng.PURPOSE_JITTER)
     u = rng.keyed_uniform(key, src, ctr.astype(jnp.uint32),
@@ -812,7 +839,7 @@ def _patched_rows(em, src2, ctr2, time_v, send_t, lat, stage_v, status_v):
 
 
 def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
-                     active, app):
+                     active, app, bw_up=None):
     """Assign pkt_ids, apply routing latency + reliability drops, and
     merge staged emissions into free OUTBOX slots of the emitting host's
     own slab -- direct to IN_FLIGHT when the tx token bucket covers them,
@@ -857,10 +884,19 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     lat = jnp.where(loop, simtime.SIMTIME_ONE_NANOSECOND, lat)
     rel = jnp.where(loop, 1.0, rel)
 
-    drop_key = rng.purpose_key(params.seed_key, rng.PURPOSE_PACKET_DROP)
-    u = rng.keyed_uniform(drop_key, src2, ctr2.astype(jnp.uint32),
-                          (ctr2 >> 32).astype(jnp.uint32))
-    dropped = valid & (u >= rel)
+    if params.has_loss or state.nm is not None:
+        drop_key = rng.purpose_key(params.seed_key,
+                                   rng.PURPOSE_PACKET_DROP)
+        u = rng.keyed_uniform(drop_key, src2, ctr2.astype(jnp.uint32),
+                              (ctr2 >> 32).astype(jnp.uint32))
+        dropped = valid & (u >= rel)
+    else:
+        # STATIC loss-free world with no fault overlay: every rel is
+        # exactly 1.0 and keyed_uniform draws in [0, 1), so u >= rel can
+        # never hold -- the whole drop hash chain traces away (the
+        # keyed draw consumes nothing, so skipping it is bitwise-
+        # neutral).
+        dropped = jnp.zeros_like(valid)
     if state.nm is not None:
         # Injected-fault kills: dropped here but the BASE draw would have
         # survived -- exactly the packets netem killed (blocked pairs or
@@ -891,9 +927,10 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     # --- NIC tx admission: direct-admit under the token budget, else park
     # in TX_QUEUED for _tx_drain (FIFO is preserved because any backlog
     # forces parking).
+    if bw_up is None:
+        bw_up = netem_apply.rate(state.nm, params.bw_up_Bps)
     tokens, last = nic.refill(hosts.tokens_tx, hosts.last_refill_tx,
-                              netem_apply.rate(state.nm, params.bw_up_Bps),
-                              tick_t, active)
+                              bw_up, tick_t, active)
     sizes = _wire_bytes(em.proto, em.length).astype(I64) * nic.SCALE
     sizes_nl = jnp.where(placed, sizes, 0)
     prefix = jnp.cumsum(sizes_nl, axis=1)
@@ -1067,11 +1104,38 @@ def _select_tx_slab(pool, tick_t, active, h):
     return slot_of_host, chosen
 
 
-def _tx_drain(state: SimState, params, tick_t, active):
+def _tx_drain(state: SimState, params, tick_t, active, bw_up=None):
     """Drain one parked TX_QUEUED packet per host onto the wire, gated by
     the upstream token bucket (reference _networkinterface_sendPackets,
     network_interface.c:519-561: dequeue under token budget, then
-    router_forward -> worker_sendPacket)."""
+    router_forward -> worker_sendPacket).
+
+    KERNEL-DIET GATE: apps that never park (unbounded bandwidth, or
+    sends always under budget) pay only a cheap any() here instead of
+    replaying the slab row-min + packed gather every micro-step.  The
+    skip is exact -- with no TX_QUEUED packet anywhere the body reduces
+    to the bare token refill (have/funded/chosen all false leave pool,
+    tx_queued and t_resume bitwise untouched), and the refill itself
+    stays unconditional so token/timestamp state never diverges."""
+    if bw_up is None:
+        bw_up = netem_apply.rate(state.nm, params.bw_up_Bps)
+    if not params.kernel_diet:
+        return _tx_drain_body(state, params, tick_t, active, bw_up)
+
+    def _refill_only(s):
+        tokens, last = nic.refill(s.hosts.tokens_tx,
+                                  s.hosts.last_refill_tx,
+                                  bw_up, tick_t, active)
+        return s.replace(hosts=s.hosts.replace(tokens_tx=tokens,
+                                               last_refill_tx=last))
+
+    return jax.lax.cond(
+        jnp.any(state.pool.stage == STAGE_TX_QUEUED),
+        lambda s: _tx_drain_body(s, params, tick_t, active, bw_up),
+        _refill_only, state)
+
+
+def _tx_drain_body(state: SimState, params, tick_t, active, bw_up):
     pool, hosts = state.pool, state.hosts
     h = hosts.num_hosts
 
@@ -1079,7 +1143,6 @@ def _tx_drain(state: SimState, params, tick_t, active):
     have = slot_of_host >= 0
     slot = jnp.clip(slot_of_host, 0, pool.capacity - 1)
 
-    bw_up = netem_apply.rate(state.nm, params.bw_up_Bps)
     tokens, last = nic.refill(hosts.tokens_tx, hosts.last_refill_tx,
                               bw_up, tick_t, active)
     # One packed row gather for every field of the chosen packet.
@@ -1125,9 +1188,26 @@ def _tx_drain(state: SimState, params, tick_t, active):
 # ---------------------------------------------------------------------------
 
 
-def _microstep_core(state: SimState, params, app, t_h, window_end):
+def _window_ctx(state: SimState, params):
+    """Window-invariant inputs of the micro-step, hoisted out of the
+    inner while body: the netem overlay only changes at window
+    boundaries (netem_apply.advance runs before the window's ticks), so
+    the effective NIC rates and the host-liveness mask are constant
+    across every micro-step of a window.  Returns (bw_up, bw_dn, alive);
+    alive is None for worlds without a fault overlay."""
+    return (netem_apply.rate(state.nm, params.bw_up_Bps),
+            netem_apply.rate(state.nm, params.bw_down_Bps),
+            None if state.nm is None else netem_apply.alive(state.nm))
+
+
+def _microstep_core(state: SimState, params, app, t_h, window_end,
+                    ctx=None):
     """Advance every host's earliest pending event (< window_end)."""
     from ..transport import tcp as tcp_mod
+
+    if ctx is None:
+        ctx = _window_ctx(state, params)
+    bw_up, bw_dn, alive = ctx
 
     h = state.hosts.num_hosts
     if _uses_tcp(app) and state.inbox.blk.shape[1] < ICOLS:
@@ -1159,7 +1239,8 @@ def _microstep_core(state: SimState, params, app, t_h, window_end):
     # Phase A: arrivals through the destination slab (router queue, NIC rx
     # tokens + CoDel, transport delivery).
     state, em, delivered_n, t_post = _rx_phase(state, params, em, tick_t,
-                                               active, app, window_end)
+                                               active, app, window_end,
+                                               bw_dn=bw_dn, alive=alive)
 
     # Phases B-D run at the POST-BATCH per-host instant: when rx_batch
     # rounds consumed arrivals slightly after tick_t, every downstream
@@ -1185,8 +1266,9 @@ def _microstep_core(state: SimState, params, app, t_h, window_end):
     # packets through the tx bucket.
     if _uses_tcp(app):
         state, em = tcp_mod.transmit(state, params, em, t_post, active)
-    state, placed = _stage_emissions(state, params, em, t_post, active, app)
-    state = _tx_drain(state, params, t_post, active)
+    state, placed = _stage_emissions(state, params, em, t_post, active,
+                                     app, bw_up=bw_up)
+    state = _tx_drain(state, params, t_post, active, bw_up=bw_up)
 
     # Virtual CPU accounting (reference cpu_updateTime + cpu_addDelay,
     # cpu.c:77-108): every delivered packet and staged emission costs
@@ -1241,13 +1323,18 @@ def run_until(state: SimState, params, app, t_target):
             # already shrank the lookahead for sub-1.0 latency scales).
             st = st.replace(nm=netem_apply.advance(st.nm, we))
 
+        # Hoist the window-invariant micro-step inputs here: the inner
+        # while body closes over them, so XLA computes them once per
+        # window instead of once per micro-step.
+        ctx = _window_ctx(st, params)
+
         def icond(icarry):
             _s, _th, g = icarry
             return g < we
 
         def ibody(icarry):
             s, th, _ = icarry
-            s = _microstep_core(s, params, app, th, we)
+            s = _microstep_core(s, params, app, th, we, ctx=ctx)
             th2, g2 = scan(s)
             return s, th2, g2
 

@@ -25,8 +25,12 @@ QDISC_RR = 1
 # gather instead of three separate [V,V] gathers -- gathers are among the
 # few ops with real per-index cost inside a compiled loop
 # (tools/opbench*.py), and the hot path issues them at [H, E] volume.
-(RCOL_LAT_LO, RCOL_LAT_HI, RCOL_JIT_LO, RCOL_JIT_HI, RCOL_REL) = range(5)
+# Column ORDER is load-bearing: the always-needed fields (latency,
+# reliability) come first so jitter-free worlds (the common case) gather
+# only the leading RCOLS_NARROW columns per packet.
+(RCOL_LAT_LO, RCOL_LAT_HI, RCOL_REL, RCOL_JIT_LO, RCOL_JIT_HI) = range(5)
 RCOLS = 5
+RCOLS_NARROW = 3            # lat lo/hi + reliability
 
 
 @struct.dataclass
@@ -88,6 +92,22 @@ class NetParams:
     # it programmatically -- and it costs a packed scatter per window plus
     # masked updates in every micro-step, so it traces away by default.
     pds_trail: bool = struct.field(pytree_node=False, default=False)
+    # STATIC: any pair has reliability < 1.0.  When False (and no fault
+    # overlay is installed) the per-emission drop draw is provably never
+    # taken, so the whole keyed-uniform hash chain traces away.  The
+    # default True is the conservative always-correct setting; builders
+    # going through make_net_params get the computed value.
+    has_loss: bool = struct.field(pytree_node=False, default=True)
+    # STATIC: any pair has jitter > 0.  When False the per-packet jitter
+    # draw traces away AND routing gathers only the narrow (lat, rel)
+    # leading columns of route_blk.
+    has_jitter: bool = struct.field(pytree_node=False, default=True)
+    # STATIC master switch for the dynamic micro-step gates (lax.cond
+    # around _tx_drain / TCP timers / arrivals / transmit): the gated
+    # graph is bitwise-identical to the ungated one -- this switch exists
+    # so tests can run both variants and assert exactly that
+    # (tests/test_kernel_diet.py).
+    kernel_diet: bool = struct.field(pytree_node=False, default=True)
 
     @property
     def n_vertices(self) -> int:
@@ -105,6 +125,19 @@ class NetParams:
         jit = dec_i64(rows[..., RCOL_JIT_LO], rows[..., RCOL_JIT_HI])
         rel = jax.lax.bitcast_convert_type(rows[..., RCOL_REL], F32)
         return lat, jit, rel
+
+    def route_narrow(self, vs, vd):
+        """Jitter-free routing lookup: gather only the leading
+        (lat lo/hi, rel) columns per packet.  The static column slice is
+        loop-invariant, so XLA hoists it out of the micro-step while
+        body and the per-packet gather moves 3/5 the bytes.  Returns
+        (latency_ns i64, reliability f32)."""
+        from .state import dec_i64
+        narrow = self.route_blk[:, :RCOLS_NARROW]
+        rows = narrow[vs * self.n_vertices + vd]
+        lat = dec_i64(rows[..., RCOL_LAT_LO], rows[..., RCOL_LAT_HI])
+        rel = jax.lax.bitcast_convert_type(rows[..., RCOL_REL], F32)
+        return lat, rel
 
     @property
     def latency_ns(self):
@@ -206,9 +239,9 @@ def make_net_params(
     route_blk = jnp.stack([
         enc_lo(latency_ns.reshape(-1)),
         enc_hi(latency_ns.reshape(-1)),
+        jax.lax.bitcast_convert_type(rel_m.reshape(-1), I32),
         enc_lo(jitter_ns.reshape(-1)),
         enc_hi(jitter_ns.reshape(-1)),
-        jax.lax.bitcast_convert_type(rel_m.reshape(-1), I32),
     ], axis=1)
     return NetParams(
         route_blk=route_blk,
@@ -229,4 +262,6 @@ def make_net_params(
         pcap_mask=jnp.asarray(pcap_mask, bool),
         cong=cong,
         has_iface_buf=bool(jnp.any(jnp.asarray(iface_buf_pkts, I32) > 0)),
+        has_loss=bool(jnp.any(rel_m < 1.0)),
+        has_jitter=bool(jnp.any(jitter_ns > 0)),
     )

@@ -162,6 +162,7 @@ class Profiler:
         self.transfer_count = 0
         self.compiles = []      # (t_rel_s, dur_s)
         self.counter_samples = []   # (t_rel_s, {name: value})
+        self.kernelcount = None     # tools/kernelcount.py report|None
 
     # -- recording hooks ----------------------------------------------------
 
@@ -183,6 +184,13 @@ class Profiler:
         """Record a snapshot of (already-fetched) device counters."""
         self.counter_samples.append((time.perf_counter() - self.t0,
                                      dict(values)))
+
+    def set_kernelcount(self, report: dict | None):
+        """Attach a tools/kernelcount.py report: compiled HLO op/fusion
+        counts per engine phase.  Rides metrics()/metrics.json so every
+        profiled artifact carries the compiled-graph size alongside the
+        wall times (benchdiff gates on it with --kernels)."""
+        self.kernelcount = report
 
     # -- aggregation --------------------------------------------------------
 
@@ -212,6 +220,8 @@ class Profiler:
         }
         if self.counter_samples:
             out["device_counters"] = self.counter_samples[-1][1]
+        if self.kernelcount is not None:
+            out["kernelcount"] = self.kernelcount
         return out
 
     # -- artifacts ----------------------------------------------------------

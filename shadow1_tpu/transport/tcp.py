@@ -823,6 +823,14 @@ _NKINDS = 4
 
 
 def run_timers(state, params, em, tick_t, active):
+    """Fire <=1 due TCP timer per host (RTO / delack / TIME_WAIT /
+    persist).
+
+    KERNEL-DIET GATE: the cheap elementwise due-scan runs every tick,
+    but the fire machinery (gather, state machine, scatter, emission)
+    only compiles into the taken branch -- ticks where no timer anywhere
+    is due skip it.  Exact skip: with `due` all false the body's every
+    write is masked false and the timer emission mask is empty."""
     socks = state.socks
     h, s = socks.num_hosts, socks.slots
 
@@ -831,6 +839,19 @@ def run_timers(state, params, em, tick_t, active):
     cand2 = cand.reshape(h, s * _NKINDS)
     due = cand2 <= tick_t[:, None]
     due = due & active[:, None]
+
+    def _fire(args):
+        st_, em_ = args
+        return _timers_fire(st_, params, em_, tick_t, cand2, due)
+
+    if not params.kernel_diet:
+        return _fire((state, em))
+    return jax.lax.cond(jnp.any(due), _fire, lambda a: a, (state, em))
+
+
+def _timers_fire(state, params, em, tick_t, cand2, due):
+    socks = state.socks
+    h, s = socks.num_hosts, socks.slots
     tmin = jnp.min(jnp.where(due, cand2, INV), axis=1)
     at_min = due & (cand2 == tmin[:, None])
     flat = jnp.arange(s * _NKINDS, dtype=I32)[None, :]
@@ -1011,96 +1032,117 @@ def transmit(state, params, em, tick_t, active):
     want = (retx | can_new | fin_ready) & tx_active[:, None]
     # Suppressed-but-willing senders must wake when the outbox drains
     # (next window); without this a sender with only an RTO armed would
-    # stall for a full RTO.
+    # stall for a full RTO.  Computed OUTSIDE the diet gate below:
+    # deferral can hold while `want` is all-false (back-pressured hosts
+    # are masked out of want entirely).
     deferred = active & ~room_ok & \
         jnp.any(retx | can_new | fin_ready, axis=1)
-    # Socket selection qdisc (reference network_interface.c:466-540):
-    # FIFO serves the lowest eligible slot; RR rotates a per-host cursor
-    # so concurrent sockets share the interface fairly.
-    pick_fifo = jnp.min(jnp.where(want, slot_ids, s_num), axis=1)
     rr = state.hosts.rr_next
-    eff = (slot_ids - rr[:, None]) % s_num
-    pick_eff = jnp.min(jnp.where(want, eff, s_num), axis=1)
-    pick_rr = (jnp.clip(pick_eff, 0, s_num - 1) + rr) % s_num
     use_rr = params.qdisc == QDISC_RR
-    have = pick_fifo < s_num
-    pick = jnp.where(use_rr, pick_rr, pick_fifo)
-    pick = jnp.clip(pick, 0, s_num - 1)
-    sv = _Sock(socks, pick)
 
-    for k in range(emit.TX_SLOTS):
-        # Per-round eligibility from the (updated) registers -- the same
-        # rule as the table-wide pick above.
-        retx_k, can_new_k, fin_ready_k = _eligibility(
-            sv.tcp_state, sv.snd_una, sv.snd_nxt, sv.snd_end, sv.snd_wnd,
-            sv.cwnd, sv.retrans_nxt, sv.retrans_end, sv.app_closed)
-        # SACK-aware retransmission: hop the cursor over every sacked
-        # range it sits in (ranges sorted by distance from snd_una, so
-        # one ascending pass suffices) -- selective repeat instead of
-        # resending bytes the peer already holds.
-        seq_sk = sv.retrans_nxt
-        for _r in range(st.SSACK_RANGES):
-            lo_r, hi_r = sv.ssack_lo[:, _r], sv.ssack_hi[:, _r]
-            inr = retx_k & (lo_r != hi_r) & _seq_leq(lo_r, seq_sk) & \
-                _seq_lt(seq_sk, hi_r)
-            seq_sk = jnp.where(inr, hi_r, seq_sk)
-        moved = have & retx_k & (seq_sk != sv.retrans_nxt)
-        sv.setwhere(moved, retrans_nxt=seq_sk)
-        retx_bound_k = _seq_min(sv.retrans_end, sv.snd_nxt)
-        retx_k = retx_k & _seq_lt(seq_sk, retx_bound_k)
-        do_retx = have & retx_k
-        do_new = have & ~do_retx & can_new_k
-        do_fin_only = have & ~do_retx & ~do_new & fin_ready_k
+    # KERNEL-DIET GATE: ticks where no socket anywhere wants to send
+    # skip the pick + TX_SLOTS segment rounds + scatter.  Exact skip:
+    # want all-false forces have all-false, every setwhere/put masked
+    # false, and the recomputed `more` (= per-host any(want)) all-false.
+    def _tx_rounds(args):
+        socks, em = args
+        # Socket selection qdisc (reference network_interface.c:466-540):
+        # FIFO serves the lowest eligible slot; RR rotates a per-host
+        # cursor so concurrent sockets share the interface fairly.
+        pick_fifo = jnp.min(jnp.where(want, slot_ids, s_num), axis=1)
+        eff = (slot_ids - rr[:, None]) % s_num
+        pick_eff = jnp.min(jnp.where(want, eff, s_num), axis=1)
+        pick_rr = (jnp.clip(pick_eff, 0, s_num - 1) + rr) % s_num
+        have = pick_fifo < s_num
+        pick = jnp.where(use_rr, pick_rr, pick_fifo)
+        pick = jnp.clip(pick, 0, s_num - 1)
+        sv = _Sock(socks, pick)
 
-        # Segment geometry: min(MSS, remaining stream).  Eligibility already
-        # guaranteed window room for a full segment (or the tail).
-        seq = jnp.where(do_retx, sv.retrans_nxt, sv.snd_nxt)
-        data_left = jnp.where(
-            do_retx, _sdiff(sv.snd_end, sv.retrans_nxt),
-            _sdiff(sv.snd_end, sv.snd_nxt))
-        seg_len = jnp.clip(jnp.minimum(TCP_MSS, data_left), 0, TCP_MSS)
-        # Retransmit of the FIN octet itself (retrans_nxt == snd_end).
-        retx_fin = do_retx & (data_left == 0) & sv.app_closed
-        seg_len = jnp.where(retx_fin | do_fin_only, 0, seg_len)
-        send_fin = retx_fin | do_fin_only | \
-            (do_new & sv.app_closed &
-             ((seq + seg_len.astype(U32)) == sv.snd_end))
-        # Piggybacked FIN consumes one extra sequence number.
-        consumed = seg_len.astype(U32) + jnp.where(send_fin, 1, 0).astype(U32)
+        for k in range(emit.TX_SLOTS):
+            # Per-round eligibility from the (updated) registers -- the same
+            # rule as the table-wide pick above.
+            retx_k, can_new_k, fin_ready_k = _eligibility(
+                sv.tcp_state, sv.snd_una, sv.snd_nxt, sv.snd_end, sv.snd_wnd,
+                sv.cwnd, sv.retrans_nxt, sv.retrans_end, sv.app_closed)
+            # SACK-aware retransmission: hop the cursor over every sacked
+            # range it sits in (ranges sorted by distance from snd_una, so
+            # one ascending pass suffices) -- selective repeat instead of
+            # resending bytes the peer already holds.
+            seq_sk = sv.retrans_nxt
+            for _r in range(st.SSACK_RANGES):
+                lo_r, hi_r = sv.ssack_lo[:, _r], sv.ssack_hi[:, _r]
+                inr = retx_k & (lo_r != hi_r) & _seq_leq(lo_r, seq_sk) & \
+                    _seq_lt(seq_sk, hi_r)
+                seq_sk = jnp.where(inr, hi_r, seq_sk)
+            moved = have & retx_k & (seq_sk != sv.retrans_nxt)
+            sv.setwhere(moved, retrans_nxt=seq_sk)
+            retx_bound_k = _seq_min(sv.retrans_end, sv.snd_nxt)
+            retx_k = retx_k & _seq_lt(seq_sk, retx_bound_k)
+            do_retx = have & retx_k
+            do_new = have & ~do_retx & can_new_k
+            do_fin_only = have & ~do_retx & ~do_new & fin_ready_k
 
-        doing = do_retx | do_new | do_fin_only
-        flags = jnp.where(doing, TCP_FLAG_ACK, 0) | \
-            jnp.where(send_fin & doing, TCP_FLAG_FIN, 0)
+            # Segment geometry: min(MSS, remaining stream).  Eligibility already
+            # guaranteed window room for a full segment (or the tail).
+            seq = jnp.where(do_retx, sv.retrans_nxt, sv.snd_nxt)
+            data_left = jnp.where(
+                do_retx, _sdiff(sv.snd_end, sv.retrans_nxt),
+                _sdiff(sv.snd_end, sv.snd_nxt))
+            seg_len = jnp.clip(jnp.minimum(TCP_MSS, data_left), 0, TCP_MSS)
+            # Retransmit of the FIN octet itself (retrans_nxt == snd_end).
+            retx_fin = do_retx & (data_left == 0) & sv.app_closed
+            seg_len = jnp.where(retx_fin | do_fin_only, 0, seg_len)
+            send_fin = retx_fin | do_fin_only | \
+                (do_new & sv.app_closed &
+                 ((seq + seg_len.astype(U32)) == sv.snd_end))
+            # Piggybacked FIN consumes one extra sequence number.
+            consumed = seg_len.astype(U32) + jnp.where(send_fin, 1, 0).astype(U32)
 
-        em = emit.put(
-            em, doing, emit.SLOT_TX_BASE + k,
-            dst=sv.peer_host, sport=sv.local_port, dport=sv.peer_port,
-            proto=st.PROTO_TCP, flags=flags, seq=seq, ack=sv.rcv_nxt,
-            wnd=recv_window(sv), length=seg_len, ts_echo=sv.ts_recent)
+            doing = do_retx | do_new | do_fin_only
+            flags = jnp.where(doing, TCP_FLAG_ACK, 0) | \
+                jnp.where(send_fin & doing, TCP_FLAG_FIN, 0)
 
-        # Cursor updates.
-        sv.setwhere(do_retx, retrans_nxt=sv.retrans_nxt + consumed,
-                    retx_segs=sv.retx_segs + 1)
-        adv_new = (do_new | do_fin_only)
-        sv.setwhere(adv_new, snd_nxt=seq + consumed)
-        sv.setwhere(adv_new, bytes_sent=sv.bytes_sent + seg_len)
-        # First FIN transmission moves the state machine
-        # (reference tcp_close / FIN enqueue).
-        first_fin = (do_new | do_fin_only) & send_fin
-        sv.setwhere(first_fin & (sv.tcp_state == TCPS_ESTABLISHED),
-                    tcp_state=TCPS_FINWAIT1)
-        sv.setwhere(first_fin & (sv.tcp_state == TCPS_CLOSEWAIT),
-                    tcp_state=TCPS_LASTACK)
-        # Sending data piggybacks an ACK.
-        sv.setwhere(doing, delack_pending=0, t_delack=INV)
-        # Arm RTO if off.
-        sv.setwhere(doing & (sv.t_rto == INV), t_rto=tick_t + sv.rto)
+            em = emit.put(
+                em, doing, emit.SLOT_TX_BASE + k,
+                dst=sv.peer_host, sport=sv.local_port, dport=sv.peer_port,
+                proto=st.PROTO_TCP, flags=flags, seq=seq, ack=sv.rcv_nxt,
+                wnd=recv_window(sv), length=seg_len, ts_echo=sv.ts_recent)
 
-    socks = sv.scatter(socks, have)
+            # Cursor updates.
+            sv.setwhere(do_retx, retrans_nxt=sv.retrans_nxt + consumed,
+                        retx_segs=sv.retx_segs + 1)
+            adv_new = (do_new | do_fin_only)
+            sv.setwhere(adv_new, snd_nxt=seq + consumed)
+            sv.setwhere(adv_new, bytes_sent=sv.bytes_sent + seg_len)
+            # First FIN transmission moves the state machine
+            # (reference tcp_close / FIN enqueue).
+            first_fin = (do_new | do_fin_only) & send_fin
+            sv.setwhere(first_fin & (sv.tcp_state == TCPS_ESTABLISHED),
+                        tcp_state=TCPS_FINWAIT1)
+            sv.setwhere(first_fin & (sv.tcp_state == TCPS_CLOSEWAIT),
+                        tcp_state=TCPS_LASTACK)
+            # Sending data piggybacks an ACK.
+            sv.setwhere(doing, delack_pending=0, t_delack=INV)
+            # Arm RTO if off.
+            sv.setwhere(doing & (sv.t_rto == INV), t_rto=tick_t + sv.rto)
 
-    # More sendable work remains at this instant -> re-tick the host.
-    retx, can_new, fin_ready = _tx_eligibility(socks)
-    more = jnp.any((retx | can_new | fin_ready), axis=1) & tx_active
+        socks = sv.scatter(socks, have)
+
+        # More sendable work remains at this instant -> re-tick.
+        retx_a, can_new_a, fin_ready_a = _tx_eligibility(socks)
+        more = jnp.any((retx_a | can_new_a | fin_ready_a), axis=1) & \
+            tx_active
+        rr_next = jnp.where(use_rr & have, (pick + 1) % s_num, rr)
+        return socks, em, more, rr_next
+
+    if params.kernel_diet:
+        socks, em, more, rr_next = jax.lax.cond(
+            jnp.any(want), _tx_rounds,
+            lambda args: (args[0], args[1], jnp.zeros((h,), bool), rr),
+            (socks, em))
+    else:
+        socks, em, more, rr_next = _tx_rounds((socks, em))
+
     hosts = state.hosts
     t_res = jnp.where(
         more, tick_t,
@@ -1108,6 +1150,5 @@ def transmit(state, params, em, tick_t, active):
                   jnp.asarray(simtime.SIMTIME_INVALID, I64)))
     hosts = hosts.replace(
         t_resume=jnp.minimum(hosts.t_resume, t_res),
-        rr_next=jnp.where(use_rr & have, (pick + 1) % s_num,
-                          hosts.rr_next))
+        rr_next=rr_next)
     return state.replace(socks=socks, hosts=hosts), em

@@ -1,0 +1,165 @@
+"""Kernel-count metric tests (tools/kernelcount.py + benchdiff --kernels).
+
+The kernel diet's regression gate rests on two properties checked here:
+the HLO parser counts instructions correctly (opcode extraction must not
+trip over tuple shapes or metadata), and the per-phase counts are
+deterministic for a fixed world -- they must diff EXACTLY across two
+measurements or the 0%-threshold gate would flag noise.  The benchdiff
+side checks the gate itself: kernel growth exits nonzero under
+--kernels, is invisible without it, and reports from different fixed
+worlds refuse to compare.
+"""
+
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# A representative optimized-HLO fragment: computation headers, tuple
+# shapes (whose opening paren must NOT parse as an opcode), ROOT
+# markers, fusions, a gather, and a while loop.
+_HLO = """\
+HloModule jit_microstep, entry_computation_layout={()->()}
+
+%fused_computation (param_0: f32[8]) -> f32[8] {
+  %param_0 = f32[8]{0} parameter(0)
+  ROOT %add.1 = f32[8]{0} add(f32[8]{0} %param_0, f32[8]{0} %param_0)
+}
+
+ENTRY %main (arg0: f32[8], arg1: s64[8,3]) -> (f32[8], s64[]) {
+  %arg0 = f32[8]{0} parameter(0)
+  %arg1 = s64[8,3]{1,0} parameter(1)
+  %fusion = f32[8]{0} fusion(f32[8]{0} %arg0), kind=kLoop, calls=%fused_computation
+  %gather.2 = s64[8]{0} gather(s64[8,3]{1,0} %arg1, s64[8]{0} %arg0), metadata={op_name="jit(step)/gather"}
+  %while.3 = (f32[8]{0}, s64[]) while(%tuple.0), condition=%cond, body=%body
+  ROOT %tuple.1 = (f32[8]{0}, s64[]) tuple(f32[8]{0} %fusion, s64[] %c0)
+}
+"""
+
+
+class TestHloCounts:
+    def test_parses_fragment(self):
+        kc = _load_tool("kernelcount")
+        c = kc.hlo_counts(_HLO)
+        # 2 instrs in the fused computation + 6 in ENTRY.
+        assert c["n_ops"] == 8
+        assert c["n_fusions"] == 1
+        assert c["n_gather"] == 1
+        assert c["n_while"] == 1
+        assert c["n_scatter"] == 0
+
+    def test_tuple_shape_is_not_an_opcode(self):
+        kc = _load_tool("kernelcount")
+        # The result shape's paren follows '(' / digits, never a word
+        # boundary match, so the opcode is 'while', not a shape token.
+        c = kc.hlo_counts(
+            "  %w = (f32[2]{0}, s32[]) while(%t), body=%b\n")
+        assert c["n_ops"] == 1 and c["n_while"] == 1
+
+    def test_counts_deterministic_for_fixed_world(self):
+        kc = _load_tool("kernelcount")
+        a = kc.phase_counts(num_hosts=8, rx_batch=1, seed=3)
+        b = kc.phase_counts(num_hosts=8, rx_batch=1, seed=3)
+        assert a == b
+        for phase in ("microstep", "exchange", "run_until"):
+            assert a[phase]["n_ops"] > 0, phase
+
+    def test_report_headline_keys(self):
+        kc = _load_tool("kernelcount")
+        rep = kc.report(num_hosts=8, rx_batch=1, seed=3)
+        assert rep["microstep_ops"] == rep["phases"]["microstep"]["n_ops"]
+        assert rep["world"]["rx_batch"] == 1
+        assert "backend" in rep
+
+
+class TestBenchdiffKernelGate:
+    """benchdiff --kernels: the compiled-graph regression gate."""
+
+    OLD = {"metric": "phold_events_per_sec", "value": 1000.0,
+           "wall_sec": 10.0,
+           "profile": {"kernelcount": {
+               "backend": "cpu",
+               "world": {"app": "phold", "num_hosts": 64,
+                         "rx_batch": 1, "seed": 1},
+               "phases": {"microstep": {"n_ops": 5000, "n_fusions": 120,
+                                        "n_gather": 5}},
+               "microstep_ops": 5000, "microstep_fusions": 120}}}
+
+    def _write(self, tmp_path, name, data):
+        p = tmp_path / name
+        p.write_text(json.dumps(data))
+        return str(p)
+
+    def test_kernel_regression_exits_nonzero(self, tmp_path):
+        new = json.loads(json.dumps(self.OLD))
+        new["profile"]["kernelcount"]["microstep_ops"] = 5001
+        new["profile"]["kernelcount"]["phases"]["microstep"]["n_ops"] \
+            = 5001
+        bd = _load_tool("benchdiff")
+        rc = bd.main([self._write(tmp_path, "old.json", self.OLD),
+                      self._write(tmp_path, "new.json", new),
+                      "--kernels"])
+        assert rc == 1
+
+    def test_kernel_regression_ignored_without_flag(self, tmp_path):
+        new = json.loads(json.dumps(self.OLD))
+        new["profile"]["kernelcount"]["microstep_ops"] = 9999
+        new["profile"]["kernelcount"]["phases"]["microstep"]["n_ops"] \
+            = 9999
+        bd = _load_tool("benchdiff")
+        rc = bd.main([self._write(tmp_path, "old.json", self.OLD),
+                      self._write(tmp_path, "new.json", new)])
+        assert rc == 0
+
+    def test_kernel_shrink_passes(self, tmp_path):
+        new = json.loads(json.dumps(self.OLD))
+        new["profile"]["kernelcount"]["microstep_ops"] = 4500
+        new["profile"]["kernelcount"]["phases"]["microstep"]["n_ops"] \
+            = 4500
+        bd = _load_tool("benchdiff")
+        rc = bd.main([self._write(tmp_path, "old.json", self.OLD),
+                      self._write(tmp_path, "new.json", new),
+                      "--kernels"])
+        assert rc == 0
+
+    def test_per_opcode_breakdown_never_gates(self, tmp_path):
+        # An optimization may trade straight-line ops for a conditional;
+        # only the aggregate n_ops/n_fusions regressions flag.
+        new = json.loads(json.dumps(self.OLD))
+        new["profile"]["kernelcount"]["phases"]["microstep"]["n_gather"] \
+            = 50
+        bd = _load_tool("benchdiff")
+        rc = bd.main([self._write(tmp_path, "old.json", self.OLD),
+                      self._write(tmp_path, "new.json", new),
+                      "--kernels"])
+        assert rc == 0
+
+    def test_world_mismatch_refuses(self, tmp_path):
+        new = json.loads(json.dumps(self.OLD))
+        new["profile"]["kernelcount"]["world"]["rx_batch"] = 2
+        bd = _load_tool("benchdiff")
+        rc = bd.main([self._write(tmp_path, "old.json", self.OLD),
+                      self._write(tmp_path, "new.json", new),
+                      "--kernels"])
+        assert rc == 2
+
+    def test_standalone_kernelcount_jsons(self, tmp_path):
+        old = self.OLD["profile"]["kernelcount"]
+        new = json.loads(json.dumps(old))
+        new["microstep_fusions"] = 121
+        new["phases"]["microstep"]["n_fusions"] = 121
+        bd = _load_tool("benchdiff")
+        rc = bd.main([self._write(tmp_path, "kc0.json", old),
+                      self._write(tmp_path, "kc1.json", new),
+                      "--kernels"])
+        assert rc == 1

@@ -29,6 +29,26 @@ _HIGHER_BETTER = ("events_per_sec", "value", "vs_baseline",
 _LOWER_BETTER = ("wall_sec", "wall_s", "p50_ms", "p95_ms", "max_ms",
                  "total_s", "compile_s")
 
+# Compiled-kernel-count leaves (tools/kernelcount.py reports, standalone
+# or embedded under profile.kernelcount): deterministic integers, so
+# they gate at a much tighter default threshold than wall times -- but
+# ONLY under --kernels, because two files may legitimately differ in
+# graph size (different jax version, different backend) when the
+# comparison is about throughput.
+_KERNEL_SPECIAL = ("microstep_ops", "microstep_fusions")
+
+# Only the aggregate graph size gates; the per-opcode breakdown
+# (n_gather, n_conditional, ...) shows WHERE a graph changed but must
+# not flag on its own -- an optimization legitimately trades straight-
+# line ops for a conditional, and gating each opcode would flag the
+# improvement.
+_KERNEL_GATED = ("n_ops", "n_fusions") + _KERNEL_SPECIAL
+
+
+def _is_kernel(name: str) -> bool:
+    leaf = name.rsplit(".", 1)[-1]
+    return leaf.startswith("n_") or leaf in _KERNEL_SPECIAL
+
 
 def _load(path: str) -> dict:
     with open(path) as f:
@@ -61,6 +81,20 @@ def _netem_config(d: dict):
     return cfg.get("netem") or None
 
 
+def _kernel_world(d: dict):
+    """The fixed-world config a kernelcount report was measured on:
+    (backend, world dict) for a standalone tools/kernelcount.py JSON or
+    a bench JSON carrying profile.kernelcount; None when absent."""
+    kc = d
+    prof = d.get("profile")
+    if isinstance(prof, dict) and isinstance(prof.get("kernelcount"),
+                                             dict):
+        kc = prof["kernelcount"]
+    if not isinstance(kc.get("world"), dict):
+        return None
+    return (kc.get("backend"), tuple(sorted(kc["world"].items())))
+
+
 def _direction(name: str):
     """'up' (bigger better), 'down' (smaller better), or None (info)."""
     leaf = name.rsplit(".", 1)[-1]
@@ -71,23 +105,36 @@ def _direction(name: str):
     return None
 
 
-def diff(old: dict, new: dict, threshold_pct: float):
+def diff(old: dict, new: dict, threshold_pct: float,
+         kernels: bool = False, kernel_threshold_pct: float = 0.0):
     """Compare shared numeric metrics; return (rows, regressions).
 
     rows: (name, old, new, pct_change, flag) for every shared directional
-    metric; regressions: the flagged subset."""
+    metric; regressions: the flagged subset.  With kernels=True the
+    compiled-kernel-count leaves gate too (direction down, at the tight
+    kernel threshold -- counts are deterministic integers, so any growth
+    is a real graph regression, not noise)."""
     fo, fn = _flatten(old), _flatten(new)
     rows, regressions = [], []
     for name in sorted(set(fo) & set(fn)):
-        d = _direction(name)
+        kernel = _is_kernel(name)
+        if kernel and not kernels:
+            continue
+        gated = not kernel or name.rsplit(".", 1)[-1] in _KERNEL_GATED
+        d = "down" if kernel else _direction(name)
         if d is None:
             continue
         a, b = fo[name], fn[name]
         if a == 0:
-            continue
-        pct = (b - a) / abs(a) * 100
-        worse = -pct if d == "up" else pct
-        flag = worse > threshold_pct
+            # A zero-count kernel metric can still regress by appearing.
+            if not (kernel and b > 0):
+                continue
+            pct, worse = float("inf"), float("inf")
+        else:
+            pct = (b - a) / abs(a) * 100
+            worse = -pct if d == "up" else pct
+        limit = kernel_threshold_pct if kernel else threshold_pct
+        flag = gated and worse > limit
         rows.append((name, a, b, pct, flag))
         if flag:
             regressions.append((name, a, b, pct))
@@ -102,6 +149,14 @@ def main(argv=None) -> int:
     ap.add_argument("new", help="candidate JSON")
     ap.add_argument("--threshold", type=float, default=10.0,
                     help="regression threshold in percent (default 10)")
+    ap.add_argument("--kernels", action="store_true",
+                    help="also gate on compiled kernel-count metrics "
+                         "(tools/kernelcount.py leaves, standalone or "
+                         "under profile.kernelcount)")
+    ap.add_argument("--kernel-threshold", type=float, default=0.0,
+                    help="kernel-count regression threshold in percent "
+                         "(default 0: counts are deterministic, any "
+                         "growth flags)")
     args = ap.parse_args(argv)
 
     old, new = _load(args.old), _load(args.new)
@@ -113,7 +168,18 @@ def main(argv=None) -> int:
               f"new netem={nm_new!r}); rerun with matching --churn/"
               f"netem settings", file=sys.stderr)
         return 2
-    rows, regressions = diff(old, new, args.threshold)
+    if args.kernels:
+        wo, wn = _kernel_world(old), _kernel_world(new)
+        if wo is not None and wn is not None and wo != wn:
+            # Counts from different fixed worlds measure different
+            # graphs -- comparing them is noise, not a gate.
+            print(f"benchdiff: refusing to compare kernel counts from "
+                  f"different worlds (old={wo!r}, new={wn!r})",
+                  file=sys.stderr)
+            return 2
+    rows, regressions = diff(old, new, args.threshold,
+                             kernels=args.kernels,
+                             kernel_threshold_pct=args.kernel_threshold)
     if not rows:
         print("benchdiff: no shared directional metrics between the two "
               "files", file=sys.stderr)

@@ -1,0 +1,172 @@
+"""Count compiled HLO ops/fusions per engine phase.
+
+PERF.md's slope measurements show the micro-step is KERNEL-COUNT bound
+(2.6ms at H=100 vs 5.0ms at H=10,000): the dominant cost is the number
+of compiled ops the step replays, not the data it moves.  This tool
+makes that number a first-class, diffable metric: lower each hot phase
+(`microstep`, the windowed `run_until` loop, the boundary `exchange`)
+for a FIXED tiny world, compile it, and count instructions by opcode in
+the optimized HLO (`jax.stages.Lowered` -> `compiled.as_text()`).
+
+Counts are deterministic for a fixed (world, backend, jax version), so
+they diff exactly across rounds:
+
+    python tools/kernelcount.py --json > kc.json
+    # later, after an engine change:
+    python tools/benchdiff.py kc.json kc_new.json --kernels
+
+bench.py embeds the same JSON under its `profile.kernelcount` block (and
+metrics.json carries it via trace.Profiler) so every recorded BENCH_r{N}
+ships the compiled-graph size next to the throughput it produced.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+# Runnable as `python tools/kernelcount.py` from a source checkout (the
+# subprocess invocation bench.py uses): put the repo root first.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def _force_cpu():
+    """Default to the CPU backend: kernel counts gate regressions, so
+    they must be computable on a dev box with no accelerator attached
+    (and stay comparable across rounds).  An explicit JAX_PLATFORMS
+    wins -- pass JAX_PLATFORMS=tpu to count the TPU graph instead."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+# One HLO instruction per line: `  %name = <shape> opcode(...)` (the
+# leading ROOT marker is optional).  The opcode is the first
+# word-then-paren after the `=`; tuple shapes like `(f32[2], s32[])`
+# cannot match because their paren follows a non-word character.
+_INSTR_RE = re.compile(r"^\s+(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*)$")
+_OPCODE_RE = re.compile(r"\b([a-z][a-z0-9_\-]*)\(")
+
+# Opcodes with real per-launch / per-index cost inside a compiled loop
+# (tools/opbench*.py economics) -- broken out so diffs show WHERE a
+# graph grew, not just that it grew.
+_TRACKED = ("fusion", "gather", "scatter", "while", "conditional",
+            "sort", "custom-call", "all-reduce", "all-gather",
+            "dynamic-slice", "dynamic-update-slice", "reduce")
+
+
+def hlo_counts(text: str) -> dict:
+    """Instruction counts of an HLO module dump: total ops across every
+    computation, plus per-opcode counts for the tracked kinds."""
+    n_ops = 0
+    by_op = {k: 0 for k in _TRACKED}
+    for line in text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        op = _OPCODE_RE.search(m.group(1))
+        if op is None:
+            continue
+        n_ops += 1
+        name = op.group(1)
+        if name in by_op:
+            by_op[name] += 1
+    out = {"n_ops": n_ops, "n_fusions": by_op.pop("fusion")}
+    out.update({f"n_{k.replace('-', '_')}": v for k, v in by_op.items()})
+    return out
+
+
+def _tiny_world(num_hosts: int, rx_batch: int, seed: int):
+    from shadow1_tpu import sim
+
+    return sim.build_phold(num_hosts=num_hosts, msgs_per_host=2,
+                           pool_capacity=num_hosts * 16, seed=seed,
+                           rx_batch=rx_batch)
+
+
+def phase_counts(num_hosts: int = 64, rx_batch: int = 1,
+                 seed: int = 1) -> dict:
+    """Compile the hot phases for a fixed tiny phold world and count
+    their HLO ops.  Returns {phase: hlo_counts(...)}; values depend only
+    on (shapes, statics, backend), never on runtime data."""
+    import jax
+    import jax.numpy as jnp
+
+    from shadow1_tpu.core import engine
+    from shadow1_tpu.core.state import I64
+
+    state, params, app = _tiny_world(num_hosts, rx_batch, seed)
+    h = int(state.hosts.num_hosts)
+    t_h = jnp.zeros((h,), I64)
+    we = jnp.asarray(0, I64)
+
+    def _microstep(s, th, w):
+        return engine.microstep(s, params, app, th, w)
+
+    def _exchange(s):
+        return engine._exchange_body(s, params)
+
+    phases = {
+        "microstep": lambda: jax.jit(_microstep).lower(state, t_h, we),
+        "exchange": lambda: jax.jit(_exchange).lower(state),
+        "run_until": lambda: engine.run_until.lower(
+            state, params, app, jnp.asarray(0, I64)),
+    }
+    out = {}
+    for name, lower in phases.items():
+        text = lower().compile().as_text()
+        out[name] = hlo_counts(text)
+    return out
+
+
+def report(num_hosts: int = 64, rx_batch: int = 1, seed: int = 1) -> dict:
+    """The full diffable report: per-phase counts + config echo."""
+    import jax
+
+    phases = phase_counts(num_hosts=num_hosts, rx_batch=rx_batch,
+                          seed=seed)
+    return {
+        "backend": jax.default_backend(),
+        "world": {"app": "phold", "num_hosts": num_hosts,
+                  "rx_batch": rx_batch, "seed": seed},
+        "phases": phases,
+        # The headline number regressions gate on: the per-step graph.
+        "microstep_ops": phases["microstep"]["n_ops"],
+        "microstep_fusions": phases["microstep"]["n_fusions"],
+    }
+
+
+def main(argv=None) -> int:
+    _force_cpu()
+    ap = argparse.ArgumentParser(
+        description="count compiled HLO ops/fusions per engine phase")
+    ap.add_argument("--hosts", type=int, default=64)
+    ap.add_argument("--rx-batch", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON object")
+    args = ap.parse_args(argv)
+
+    rep = report(num_hosts=args.hosts, rx_batch=args.rx_batch,
+                 seed=args.seed)
+    if args.json:
+        print(json.dumps(rep))
+        return 0
+    print(f"backend: {rep['backend']}  world: phold "
+          f"H={args.hosts} rx_batch={args.rx_batch}")
+    cols = sorted({k for p in rep["phases"].values() for k in p})
+    cols = ["n_ops", "n_fusions"] + [c for c in cols
+                                     if c not in ("n_ops", "n_fusions")]
+    w = max(len(n) for n in rep["phases"])
+    print(f"{'phase':<{w}s} " + " ".join(f"{c:>12s}" for c in cols))
+    for name, p in rep["phases"].items():
+        print(f"{name:<{w}s} " + " ".join(f"{p.get(c, 0):>12d}"
+                                          for c in cols))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
