@@ -22,6 +22,7 @@ BENCH_r{N}.json values are comparable across rounds via the raw value.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -64,6 +65,22 @@ def _baseline_events_per_sec() -> tuple[float, str, str, str]:
                 "the 1e6 nominal placeholder, NOT comparable with rounds "
                 "whose baseline_kind is 'measured'")
         return NOMINAL_BASELINE, "nominal", "nominal:1e6", note
+
+
+def _stage_emissions_ms(state, params, app) -> float | None:
+    """Staging-merge cost on the live backend (ms/merge), slope-timed
+    by tools/phaseprof.measure_staging_ms over the warmed bench state.
+    Runs AFTER the timed passes (one extra small compile).  None when
+    measurement fails -- the benchmark result must never be lost to its
+    own metadata."""
+    import pathlib
+    root = pathlib.Path(__file__).resolve().parent
+    sys.path.insert(0, str(root / "tools"))
+    try:
+        import phaseprof
+        return round(phaseprof.measure_staging_ms(state, params, app), 4)
+    except Exception:  # noqa: BLE001
+        return None
 
 
 def _kernel_counts(rx_batch: int) -> dict | None:
@@ -171,6 +188,10 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
     # Compiled-graph size (measured after the timed passes so the CPU
     # subprocess never competes with the benchmark for the machine).
     profiler.set_kernelcount(_kernel_counts(app.rx_batch))
+    # Staging-phase cost on the live backend: the packed-pool block
+    # write this round halved, tracked so benchdiff flags a regression.
+    stage_ms = _stage_emissions_ms(warm, params, app)
+    profiler.set_metric("stage_emissions_ms", stage_ms)
     metrics = profiler.metrics()
     trace.install(None)
     result = {
@@ -194,12 +215,21 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
             "app_tx_lanes": int(getattr(app, "app_tx_lanes", 1)),
             "netem": netem_cfg,
         },
+        # Wall-clock numbers are only comparable between runs on the
+        # same backend and core count; benchdiff downgrades machine-
+        # bound metrics to informational when these don't match (or
+        # when the baseline predates the field).
+        "env": {
+            "backend": jax.default_backend(),
+            "cpu_count": os.cpu_count(),
+        },
         "profile": {
             "phases": metrics["phases"],
             "compile": metrics["compile"],
             "transfers": metrics["transfers"],
             "device_counters": counters,
             "kernelcount": metrics.get("kernelcount"),
+            "stage_emissions_ms": stage_ms,
         },
     }
     print(json.dumps(result))

@@ -58,9 +58,20 @@ def load(path: str, template_state, template_params):
                 v = z[f"{prefix}{i}"]
                 want = jax.numpy.asarray(leaf)
                 if v.shape != want.shape or v.dtype != want.dtype:
+                    hint = ""
+                    if v.ndim == 2 and want.ndim == 2 and \
+                            v.shape[0] == want.shape[0] and \
+                            v.shape[1] != want.shape[1]:
+                        # Same row count, different column count: almost
+                        # certainly a packed-block width mismatch (the
+                        # outbox/inbox narrow for TCP-free worlds).
+                        hint = ("; packed blocks narrow for TCP-free "
+                                "worlds (core/state.py pool_cols) -- "
+                                "build the template with the saved "
+                                "run's uses_tcp setting")
                     raise ValueError(
                         f"checkpoint leaf {prefix}{i} is {v.dtype}{v.shape}, "
-                        f"template wants {want.dtype}{want.shape}")
+                        f"template wants {want.dtype}{want.shape}{hint}")
                 vals.append(jax.numpy.asarray(v))
             return jax.tree_util.tree_unflatten(treedef, vals)
 

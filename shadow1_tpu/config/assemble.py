@@ -289,6 +289,10 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
     # high-fan-in server needs slab room proportional to its concurrent
     # client count; exhaustion degrades to counted drops + the
     # ERR_POOL_OVERFLOW escape hatch rather than corruption.
+    # A config whose fan-in pushes the slab into the known-bad tunnel-
+    # backend region (slab >= 128 at 10k+ hosts) gets a loud
+    # RuntimeWarning from make_sim_state -- see state.warn_known_bad_pool
+    # and tools/repro_tunnel_crash.py; pin pool_slab=64 to stay stable.
     slab = int(max(pool_slab, min(4096, 32 * (1 + fan_in.max()))))
 
     # State construction is hundreds of small array ops; build it on the

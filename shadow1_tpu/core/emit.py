@@ -27,12 +27,12 @@ import jax
 from flax import struct
 import jax.numpy as jnp
 
-from .state import (F32, I32, I64, U32, SACK_BLOCKS, OCOLS,
+from .state import (F32, I32, I64, U32, SACK_BLOCKS, ICOLS, OCOLS,
                     ICOL_SPORT, ICOL_DPORT, ICOL_PROTO, ICOL_FLAGS,
                     ICOL_SEQ, ICOL_ACK, ICOL_WND, ICOL_LEN, ICOL_PAYLOAD,
                     ICOL_TIME_LO, ICOL_TIME_HI, ICOL_TSE_LO, ICOL_TSE_HI,
-                    ICOL_SACK0_LO, OCOL_DST, OCOL_PRIO,
-                    enc_lo, enc_hi, dec_i64)
+                    ICOL_SACK0_LO, OEXT_DST, OEXT_PRIO,
+                    enc_lo, enc_hi, dec_i64, ext_base)
 
 # Emission slots, in deterministic within-tick order.
 SLOT_RX_REPLY = 0   # ACK/SYN-ACK/RST generated while processing an arrival
@@ -49,12 +49,13 @@ class Emissions:
     column format (state.OCOL_* layout, engine-owned columns zero)."""
 
     valid: jnp.ndarray       # [H,E] bool
-    blk: jnp.ndarray         # [H,E,OCOLS] i32
+    blk: jnp.ndarray         # [H,E,C] i32 (C matches the world's outbox
+                             # width: state.pool_cols)
 
     # Decoded column views (engine staging + capture/log paths).
     @property
     def dst(self):
-        return self.blk[:, :, OCOL_DST]
+        return self.blk[:, :, ext_base(self.blk.shape[-1]) + OEXT_DST]
 
     @property
     def sport(self):
@@ -94,15 +95,18 @@ class Emissions:
                        self.blk[:, :, ICOL_TIME_HI])
 
 
-def empty(num_hosts: int, num_slots: int = NUM_SLOTS) -> Emissions:
+def empty(num_hosts: int, num_slots: int = NUM_SLOTS,
+          cols: int = OCOLS) -> Emissions:
     """`num_slots` trims the staging buffer to the lanes an app can
     actually use (pure-UDP apps never emit from the RX-reply path or the
     TCP transmitter, so 3 lanes suffice) -- the [H, E] routing gather in
-    the staging path scales with E."""
+    the staging path scales with E.  `cols` must match the world's outbox
+    width (state.pool_cols): narrow worlds stage narrow rows, so the
+    staging merge and the row stack in `put` shrink with the layout."""
     he = (num_hosts, num_slots)
     return Emissions(
         valid=jnp.zeros(he, jnp.bool_),
-        blk=jnp.zeros(he + (OCOLS,), I32),
+        blk=jnp.zeros(he + (cols,), I32),
     )
 
 
@@ -128,9 +132,10 @@ def put(em: Emissions, mask: jnp.ndarray, slot: int, *, dst, sport, dport,
             return jax.lax.bitcast_convert_type(v, I32)
         return v.astype(I32)
 
+    width = em.blk.shape[-1]
+    base = ext_base(width)
     ts64 = b(t_send, I64)
-    tse64 = b(ts_echo, I64)
-    cols = [jnp.zeros((h,), I32)] * OCOLS
+    cols = [jnp.zeros((h,), I32)] * width
     cols[ICOL_SPORT] = bc32(sport, I32)
     cols[ICOL_DPORT] = bc32(dport, I32)
     cols[ICOL_PROTO] = bc32(proto, I32)
@@ -142,23 +147,31 @@ def put(em: Emissions, mask: jnp.ndarray, slot: int, *, dst, sport, dport,
     cols[ICOL_PAYLOAD] = bc32(payload_id, I32)
     cols[ICOL_TIME_LO] = enc_lo(ts64)
     cols[ICOL_TIME_HI] = enc_hi(ts64)
-    cols[ICOL_TSE_LO] = enc_lo(tse64)
-    cols[ICOL_TSE_HI] = enc_hi(tse64)
-    if sack_lo is not None:
-        slo = jnp.asarray(sack_lo).astype(U32)
-        shi = jnp.asarray(sack_hi).astype(U32)
-        if slo.ndim == 1:
-            slo = jnp.broadcast_to(slo[None, :], (h, SACK_BLOCKS))
-            shi = jnp.broadcast_to(shi[None, :], (h, SACK_BLOCKS))
-        for i in range(SACK_BLOCKS):
-            cols[ICOL_SACK0_LO + 2 * i] = \
-                jax.lax.bitcast_convert_type(slo[:, i], I32)
-            cols[ICOL_SACK0_LO + 2 * i + 1] = \
-                jax.lax.bitcast_convert_type(shi[:, i], I32)
-    cols[OCOL_DST] = bc32(dst, I32)
-    cols[OCOL_PRIO] = bc32(priority, F32)
+    if base >= ICOLS:
+        # Full-width row: the TCP-only columns exist.  Narrow (TCP-free)
+        # worlds never pass ts_echo/sack, so dropping the columns drops
+        # only structurally-zero writes.
+        tse64 = b(ts_echo, I64)
+        cols[ICOL_TSE_LO] = enc_lo(tse64)
+        cols[ICOL_TSE_HI] = enc_hi(tse64)
+        if sack_lo is not None:
+            slo = jnp.asarray(sack_lo).astype(U32)
+            shi = jnp.asarray(sack_hi).astype(U32)
+            if slo.ndim == 1:
+                slo = jnp.broadcast_to(slo[None, :], (h, SACK_BLOCKS))
+                shi = jnp.broadcast_to(shi[None, :], (h, SACK_BLOCKS))
+            for i in range(SACK_BLOCKS):
+                cols[ICOL_SACK0_LO + 2 * i] = \
+                    jax.lax.bitcast_convert_type(slo[:, i], I32)
+                cols[ICOL_SACK0_LO + 2 * i + 1] = \
+                    jax.lax.bitcast_convert_type(shi[:, i], I32)
+    elif sack_lo is not None:
+        raise ValueError("SACK blocks need a full-width (TCP) emission "
+                         "block; this world staged a narrow one")
+    cols[base + OEXT_DST] = bc32(dst, I32)
+    cols[base + OEXT_PRIO] = bc32(priority, F32)
 
-    row = jnp.stack(cols, axis=1)                      # [H, OCOLS]
+    row = jnp.stack(cols, axis=1)                      # [H, C]
     new = jnp.where(mask[:, None], row, em.blk[:, slot, :])
     return Emissions(
         valid=em.valid.at[:, slot].set(jnp.where(mask, True,

@@ -71,8 +71,7 @@ from .state import (ERR_POOL_OVERFLOW, I32, I64, U32, PROTO_TCP, PROTO_UDP,
                     ICOL_TIME_LO, ICOL_TIME_HI, ICOL_CTR_LO, ICOL_CTR_HI,
                     ICOL_TS_LO, ICOL_TS_HI, ICOL_TSE_LO, ICOL_TSE_HI,
                     ICOL_SACK0_LO, ICOL_SACK0_HI, ICOL_SACK2_HI, ICOLS,
-                    OCOL_DST, OCOL_LAT_LO, OCOL_LAT_HI, OCOL_PRIO, OCOLS,
-                    MCOL_STAGE, MCOL_STATUS,
+                    OEXT_DST, OEXT_LAT_LO, OEXT_LAT_HI, OEXT_PRIO, ext_base,
                     LOG_WARNING, LOG_DEBUG, LOG_DROP_INET, LOG_DROP_ROUTER,
                     LOG_DROP_TAIL, LOG_DROP_POOL, LOG_DELIVER, LOG_SEND,
                     LOG_NETEM_DOWN,
@@ -357,12 +356,9 @@ def _exchange_body(state: SimState, params) -> SimState:
     # the re-rank changes only WHO overflows, deterministically.
     if ic >= ICOLS:
         blk_f = pool.blk
-        from .state import TCP_FLAG_ACK
-        # Pure ACK = the ACK flag alone: no payload, no SYN/FIN/RST, and
-        # no PSH (which marks zero-window probes -- never shed those).
-        pure_ack = (blk_f[:, ICOL_PROTO] == PROTO_TCP) & \
-            (blk_f[:, ICOL_LEN] == 0) & \
-            (blk_f[:, ICOL_FLAGS] == TCP_FLAG_ACK)
+        from ..transport.tcp import pure_ack as _pure_ack
+        pure_ack = _pure_ack(blk_f[:, ICOL_PROTO], blk_f[:, ICOL_FLAGS],
+                             blk_f[:, ICOL_LEN])
         ackp = jnp.pad(pure_ack, (0, pad)) & mvp
         overflow = jnp.any(total > n_free)
 
@@ -817,25 +813,37 @@ def _free_slot_pick(free2, rank2):
 
 
 def _patched_rows(em, src2, ctr2, time_v, send_t, lat, stage_v, status_v):
-    """[H,E,MCOLS] staging rows: the emission block with the engine-owned
+    """[H,E,C+2] staging rows: the emission block with the engine-owned
     columns patched in (SRC, TIME, CTR, TS, LAT) plus the merge-scratch
-    STAGE/STATUS columns.  Pure slicing + stacking; one concatenate."""
+    STAGE/STATUS columns.  Pure slicing + stacking; one concatenate.
+    Width-adaptive: a narrow (TCP-free) emission block has no TS/TSE/SACK
+    columns to carry, so those pieces vanish from the concatenate and the
+    merge downstream shrinks with them."""
     eb = em.blk
+    base = ext_base(eb.shape[2])
 
     def c(x):
         return x[:, :, None].astype(I32)
 
-    return jnp.concatenate([
+    pieces = [
         c(src2),                                   # ICOL_SRC
         eb[:, :, 1:ICOL_TIME_LO],                  # SPORT..PAYLOAD
         c(enc_lo(time_v)), c(enc_hi(time_v)),      # ICOL_TIME_*
         c(enc_lo(ctr2)), c(enc_hi(ctr2)),          # ICOL_CTR_*
-        c(enc_lo(send_t)), c(enc_hi(send_t)),      # ICOL_TS_*
-        eb[:, :, ICOL_TSE_LO:OCOL_LAT_LO],         # TSE, SACK, DST
-        c(enc_lo(lat)), c(enc_hi(lat)),            # OCOL_LAT_*
-        eb[:, :, OCOL_PRIO:OCOL_PRIO + 1],         # OCOL_PRIO
-        c(stage_v), c(status_v),                   # MCOL_STAGE/STATUS
-    ], axis=2)
+    ]
+    if base >= ICOLS:
+        pieces += [
+            c(enc_lo(send_t)), c(enc_hi(send_t)),  # ICOL_TS_*
+            eb[:, :, ICOL_TSE_LO:base + 1],        # TSE, SACK, DST
+        ]
+    else:
+        pieces += [eb[:, :, base + OEXT_DST:base + OEXT_DST + 1]]
+    pieces += [
+        c(enc_lo(lat)), c(enc_hi(lat)),            # OEXT_LAT_*
+        eb[:, :, base + OEXT_PRIO:base + OEXT_PRIO + 1],
+        c(stage_v), c(status_v),                   # stage/status scratch
+    ]
+    return jnp.concatenate(pieces, axis=2)
 
 
 def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
@@ -963,17 +971,18 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     oh = (within[:, :, None] == ids[:, None, :]) & have_slot[:, :, None]
     hit = jnp.any(oh, axis=1)
 
+    pc = pool.blk.shape[1]                             # world block width
     val3 = _patched_rows(em, src2, ctr2, time_v, send_t, lat,
-                         stage_v, status_v)            # [H,E,MCOLS]
+                         stage_v, status_v)            # [H,E,pc+2]
     v = jnp.sum(jnp.where(oh[:, :, :, None], val3[:, :, None, :], 0),
-                axis=1, dtype=I32)                     # [H,Ko,MCOLS]
-    blk3 = pool.blk.reshape(h, ko, OCOLS)
+                axis=1, dtype=I32)                     # [H,Ko,pc+2]
+    blk3 = pool.blk.reshape(h, ko, pc)
     hit3 = hit[:, :, None]
     pool = pool.replace(
-        blk=jnp.where(hit3, v[:, :, :OCOLS], blk3).reshape(-1, OCOLS),
-        stage=jnp.where(hit, v[:, :, MCOL_STAGE],
+        blk=jnp.where(hit3, v[:, :, :pc], blk3).reshape(-1, pc),
+        stage=jnp.where(hit, v[:, :, pc],
                         pool.stage.reshape(h, ko)).reshape(-1),
-        status=jnp.where(hit, v[:, :, MCOL_STATUS],
+        status=jnp.where(hit, v[:, :, pc + 1],
                          pool.status.reshape(h, ko)).reshape(-1)
         if params.pds_trail else pool.status,
         time=jnp.where(hit, dec_i64(v[:, :, ICOL_TIME_LO],
@@ -1146,7 +1155,7 @@ def _tx_drain_body(state: SimState, params, tick_t, active, bw_up):
     tokens, last = nic.refill(hosts.tokens_tx, hosts.last_refill_tx,
                               bw_up, tick_t, active)
     # One packed row gather for every field of the chosen packet.
-    row = pool.blk[slot]                                 # [H, OCOLS]
+    row = pool.blk[slot]                                 # [H, C]
     size = _wire_bytes(row[:, ICOL_PROTO], row[:, ICOL_LEN]).astype(I64) \
         * nic.SCALE
     boot = tick_t < params.bootstrap_end
@@ -1157,7 +1166,8 @@ def _tx_drain_body(state: SimState, params, tick_t, active, bw_up):
     # already includes this packet's keyed jitter draw, so departure needs
     # no routing lookup; the reliability draw also happened at staging, so
     # loss is independent of queueing).
-    arr = tick_t + dec_i64(row[:, OCOL_LAT_LO], row[:, OCOL_LAT_HI])
+    eb = ext_base(pool.blk.shape[1])
+    arr = tick_t + dec_i64(row[:, eb + OEXT_LAT_LO], row[:, eb + OEXT_LAT_HI])
     ko = pool.capacity // h
     funded_b = jnp.broadcast_to(funded[:, None], (h, ko)).reshape(-1)
     arr_b = jnp.broadcast_to(arr[:, None], (h, ko)).reshape(-1)
@@ -1234,7 +1244,10 @@ def _microstep_core(state: SimState, params, app, t_h, window_end,
         # (app_tx_lanes), each stamped with its own t_send.
         n_lanes = emit.SLOT_APP + max(1, int(getattr(app, "app_tx_lanes",
                                                      1)))
-    em = emit.empty(h, n_lanes)
+    # The staging block matches the world's outbox width: TCP-free worlds
+    # stage 18-column rows (no TS/TSE/SACK), shrinking both emit.put's
+    # row stack and the staging merge (PERF.md round 7).
+    em = emit.empty(h, n_lanes, cols=state.pool.blk.shape[1])
 
     # Phase A: arrivals through the destination slab (router queue, NIC rx
     # tokens + CoDel, transport delivery).

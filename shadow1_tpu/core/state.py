@@ -37,6 +37,7 @@ the device only ever needs metadata.
 from __future__ import annotations
 
 from flax import struct
+import jax
 import jax.numpy as jnp
 
 from . import simtime
@@ -129,13 +130,15 @@ def _zeros(shape, dtype):
 class PacketPool:
     """All packets in the world (the OUTBOX half); fixed capacity P.
 
-    Layout (round 5): every per-packet field that is written ONCE at
-    staging lives in a packed [P, OCOLS] i32 block whose first ICOLS
-    columns are byte-identical to the inbox layout -- emission staging
-    writes the block with ONE one-hot merge (instead of ~21 per-field
-    merges, the largest phase of the round-4 step), and the boundary
-    exchange forwards rows into the inbox with a 2-column time splice
-    instead of a 24-field re-pack.  Only the hot-mutated lifecycle
+    Layout (round 5, narrowed round 7): every per-packet field that is
+    written ONCE at staging lives in a packed [P, C] i32 block whose
+    prefix columns are byte-identical to the world's inbox layout
+    (C = OCOLS for TCP worlds, NCOLS_UDP + OEXT_COLS for TCP-free ones;
+    see pool_cols/ext_base) -- emission staging writes the block with
+    ONE one-hot merge (instead of ~21 per-field merges, the largest
+    phase of the round-4 step), and the boundary exchange forwards rows
+    into the inbox with a 2-column time splice instead of a 24-field
+    re-pack.  Only the hot-mutated lifecycle
     fields stay as separate arrays: `stage` (every phase), `time`
     (authoritative; _tx_drain restamps departures), `status` (PDS trail).
 
@@ -146,9 +149,12 @@ class PacketPool:
     batchings.
     """
 
-    blk: jnp.ndarray          # [P, OCOLS] i32 packed (immutable per stay;
+    blk: jnp.ndarray          # [P, C] i32 packed (immutable per stay;
                               # TIME cols stale after _tx_drain -- `time`
-                              # below is authoritative)
+                              # below is authoritative).  C = OCOLS, or
+                              # NCOLS_UDP + OEXT_COLS for TCP-free worlds
+                              # (pool_cols); extension columns sit at the
+                              # END of the block (ext_base + OEXT_*).
     stage: jnp.ndarray        # [P] i32 STAGE_*
     time: jnp.ndarray         # [P] i64 stage-dependent: ready/deliver/arrive
     status: jnp.ndarray       # [P] i32 PDS_* trail
@@ -164,7 +170,7 @@ class PacketPool:
 
     @property
     def dst(self):
-        return self.blk[:, OCOL_DST]
+        return self.blk[:, ext_base(self.blk.shape[1]) + OEXT_DST]
 
     @property
     def proto(self):
@@ -176,7 +182,14 @@ class PacketPool:
 
     @property
     def lat_ns(self):
-        return dec_i64(self.blk[:, OCOL_LAT_LO], self.blk[:, OCOL_LAT_HI])
+        b = ext_base(self.blk.shape[1])
+        return dec_i64(self.blk[:, b + OEXT_LAT_LO],
+                       self.blk[:, b + OEXT_LAT_HI])
+
+    @property
+    def priority(self):
+        b = ext_base(self.blk.shape[1])
+        return jax.lax.bitcast_convert_type(self.blk[:, b + OEXT_PRIO], F32)
 
     @property
     def pkt_id(self):
@@ -185,9 +198,9 @@ class PacketPool:
         return (src << 40) | ctr
 
 
-def make_packet_pool(capacity: int) -> PacketPool:
+def make_packet_pool(capacity: int, cols: int = None) -> PacketPool:
     return PacketPool(
-        blk=_zeros((capacity, OCOLS), I32),
+        blk=_zeros((capacity, OCOLS if cols is None else cols), I32),
         stage=_zeros((capacity,), I32),
         time=_full((capacity,), I64, simtime.SIMTIME_INVALID),
         status=_zeros((capacity,), I32),
@@ -229,8 +242,35 @@ OCOL_LAT_HI = ICOLS + 2    # fixed at staging (parked departures skip routing)
 OCOL_PRIO = ICOLS + 3      # qdisc priority (f32 bitcast)
 OCOLS = ICOLS + 4
 
+# Width-relative extension addressing (round 7): the outbox block (and
+# the emission staging block) is the inbox prefix -- ICOLS columns, or
+# NCOLS_UDP for TCP-free worlds, matching the world's inbox width --
+# followed by the four send-side extension columns ABOVE.  Extension
+# columns are addressed from the END of the block (ext_base(C) + OEXT_*)
+# so the same code compiles for both widths; the OCOL_* constants are the
+# full-width (C == OCOLS) spellings and keep working for TCP worlds.
+# Narrowing the outbox drops the TS/TSE/SACK columns that only feed the
+# TCP machine from emit.put's row stack AND the staging merge's
+# [H, E, Ko] one-hot -- the largest micro-step phase (PERF.md round 7).
+(OEXT_DST, OEXT_LAT_LO, OEXT_LAT_HI, OEXT_PRIO) = range(4)
+OEXT_COLS = 4
+
+
+def ext_base(cols: int) -> int:
+    """First extension column of a width-`cols` packed outbox block."""
+    return cols - OEXT_COLS
+
+
+def pool_cols(uses_tcp: bool) -> int:
+    """Packed outbox/emission block width for a world: the world's inbox
+    width plus the send-side extension columns."""
+    return (ICOLS if uses_tcp else NCOLS_UDP) + OEXT_COLS
+
+
 # Staging-scratch columns appended to the merge (split off into the
-# separate stage/status arrays after the one big one-hot merge).
+# separate stage/status arrays after the one big one-hot merge).  These
+# are spelled relative to the block width at the staging site -- the
+# full-width constants below exist for the C == OCOLS case.
 MCOL_STAGE = OCOLS + 0
 MCOL_STATUS = OCOLS + 1
 MCOLS = OCOLS + 2
@@ -769,6 +809,25 @@ class SimState:
     n_events: jnp.ndarray = struct.field(default=None)   # i64 deliveries+emissions
 
 
+def warn_known_bad_pool(num_hosts: int, slab: int) -> None:
+    """Loud warning for the known-bad region of the TPU tunnel backend
+    (BASELINE.md; tools/repro_tunnel_crash.py r4 finding): the exchange-
+    rank superblock tables scale with hosts*slab, and slab 128 at 10k
+    hosts reproducibly faults the tunnel worker during the first
+    simulated second.  Slab 64 is measured stable at the same scale.
+    Called from make_sim_state so every world builder (config assemble,
+    sim.build_onion's slab-128 default, hand-built states) is covered."""
+    if slab >= 128 and num_hosts >= 10_000:
+        import warnings
+        warnings.warn(
+            f"pool slab {slab} at {num_hosts} hosts is in the known-bad "
+            f"region of the TPU tunnel backend (worker kernel fault, "
+            f"see tools/repro_tunnel_crash.py); pool_slab=64 is "
+            f"measured stable at this scale -- pass pool_slab=64 "
+            f"unless deliberately bisecting the backend bug",
+            RuntimeWarning, stacklevel=3)
+
+
 def make_sim_state(num_hosts: int, sock_slots: int = 16,
                    pool_capacity: int = 1 << 15, app=None,
                    inbox_capacity: int | None = None,
@@ -780,12 +839,13 @@ def make_sim_state(num_hosts: int, sock_slots: int = 16,
     # least 8 slots per host.  The inbox defaults to the outbox size; size
     # it by expected fan-IN (a popular server needs a deeper inbox slab).
     slab = max(8, -(-pool_capacity // num_hosts))
+    warn_known_bad_pool(num_hosts, slab)
     if inbox_capacity is None:
         inbox_capacity = pool_capacity
     islab = max(8, -(-inbox_capacity // num_hosts))
     return SimState(
         now=jnp.asarray(0, I64),
-        pool=make_packet_pool(num_hosts * slab),
+        pool=make_packet_pool(num_hosts * slab, cols=pool_cols(uses_tcp)),
         inbox=make_inbox(num_hosts, islab,
                          cols=ICOLS if uses_tcp else NCOLS_UDP),
         socks=make_socket_table(num_hosts, sock_slots),

@@ -12,11 +12,12 @@ packet exchange and the min-next-event reduction (the analog of the
 master's window advance, master.c:450-480).
 """
 
-from .sharding import (HOST_AXIS, make_mesh, shard_params, shard_state,
-                       sharded_run_until)
+from .sharding import (HOST_AXIS, assert_packed_pool_sharding, make_mesh,
+                       shard_params, shard_state, sharded_run_until)
 
 __all__ = [
     "HOST_AXIS",
+    "assert_packed_pool_sharding",
     "make_mesh",
     "shard_params",
     "shard_state",

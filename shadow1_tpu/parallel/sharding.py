@@ -122,6 +122,29 @@ def shard_params(params, mesh: Mesh):
     return jax.tree_util.tree_map_with_path(place, params)
 
 
+def assert_packed_pool_sharding(state, mesh: Mesh) -> None:
+    """Layout contract of the packed packet pool on a mesh: the outbox
+    is exactly ONE 2-D [P, C] block leaf, and that leaf shards its pool
+    axis (the 21-parallel-arrays layout this block replaced would ride
+    the mesh as 21 separately-placed leaves; a regression back to
+    per-field leaves would pass tests on one chip and silently multiply
+    collective bookkeeping on eight).  Call on a sharded state
+    (shard_state output); raises AssertionError on violation."""
+    leaves = jax.tree_util.tree_leaves(state.pool)
+    blocks = [lf for lf in leaves if getattr(lf, "ndim", 0) == 2]
+    assert len(blocks) == 1, (
+        f"packed pool must hold exactly one 2-D block leaf; found "
+        f"{len(blocks)} among shapes "
+        f"{[getattr(lf, 'shape', None) for lf in leaves]}")
+    blk = blocks[0]
+    expect = P(HOST_AXIS) if blk.shape[0] % mesh.devices.size == 0 \
+        else P()
+    spec = getattr(blk.sharding, "spec", None)
+    assert spec == expect, (
+        f"pool block sharding {spec} != expected {expect} "
+        f"(shape {blk.shape} on {mesh.devices.size} devices)")
+
+
 def sharded_run_until(state, params, app, t_target, mesh: Mesh):
     """Shard state/params onto `mesh` and run the (jitted) engine.
 

@@ -163,6 +163,7 @@ class Profiler:
         self.compiles = []      # (t_rel_s, dur_s)
         self.counter_samples = []   # (t_rel_s, {name: value})
         self.kernelcount = None     # tools/kernelcount.py report|None
+        self.extra_metrics = {}     # {name: number} via set_metric
 
     # -- recording hooks ----------------------------------------------------
 
@@ -191,6 +192,14 @@ class Profiler:
         profiled artifact carries the compiled-graph size alongside the
         wall times (benchdiff gates on it with --kernels)."""
         self.kernelcount = report
+
+    def set_metric(self, name: str, value):
+        """Attach one named scalar metric (e.g. a measured phase cost
+        like stage_emissions_ms) so it rides metrics()/metrics.json and
+        tools/benchdiff.py can gate on it across rounds.  None values
+        are dropped (a failed measurement must not poison the JSON)."""
+        if value is not None:
+            self.extra_metrics[name] = value
 
     # -- aggregation --------------------------------------------------------
 
@@ -222,6 +231,7 @@ class Profiler:
             out["device_counters"] = self.counter_samples[-1][1]
         if self.kernelcount is not None:
             out["kernelcount"] = self.kernelcount
+        out.update(self.extra_metrics)
         return out
 
     # -- artifacts ----------------------------------------------------------

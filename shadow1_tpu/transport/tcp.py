@@ -84,6 +84,19 @@ _SENDABLE = (TCPS_ESTABLISHED, TCPS_CLOSEWAIT, TCPS_FINWAIT1, TCPS_CLOSING,
 # ---------------------------------------------------------------------------
 
 
+def pure_ack(proto, flags, length):
+    """Pure-ACK classification (vectorized over packed header columns):
+    the ACK flag alone -- no payload, no SYN/FIN/RST handshake or
+    teardown semantics, and no PSH (which marks zero-window probes).
+    Cumulative ACKing makes exactly these packets safe to shed under
+    destination-slab pressure (the next ACK supersedes a shed one);
+    engine._exchange_body sheds them before any data packet at exchange
+    overflow.  Owned by the transport layer because "what is a pure ACK"
+    is TCP semantics, not engine bookkeeping."""
+    return (proto == st.PROTO_TCP) & (length == 0) & \
+        (flags == TCP_FLAG_ACK)
+
+
 def _sdiff(a, b):
     """Signed distance a-b in sequence space ([i32], wrap-safe)."""
     return (a.astype(U32) - b.astype(U32)).astype(I32)

@@ -68,6 +68,14 @@ def run_udp_pingpong_sim(workdir, binp, rounds, server_name="server",
     return ps, pc, out, sub
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running redundancy tests excluded from the tier-1 "
+        "sweep (`-m 'not slow'`); run explicitly before perf-sensitive "
+        "merges")
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():
     """Free compiled executables + trace caches between test modules.

@@ -1,0 +1,177 @@
+"""Packed-pool narrow-layout bitwise-neutrality tests.
+
+TCP-free worlds carry a narrowed packet block: pool rows drop the ten
+TCP-only columns (TSE + SACK) and keep the UDP inbox prefix plus the
+four outbox-extension columns (dst / latency / priority), 18 columns
+instead of 28 (core/state.py pool_cols / ext_base).  The narrowing is
+only admissible because it is VALUE-IDENTICAL: every surviving column
+must hold exactly what the full-width layout would have held, and no
+dropped column may hold anything a TCP-free consumer reads (TS_LO/HI
+carry the send timestamp even for UDP packets, but only TCP's RTT
+sampling ever reads it back; TSE/SACK must be zero).  These tests
+enforce that
+by running the SAME world twice -- once narrow (as built), once widened
+back to the legacy full-width blocks -- and demanding leaf-for-leaf
+bitwise equality under the column map, across rx_batch modes, both run
+entry points, and a netem link-flap world, plus a checkpoint round-trip
+through the narrow layout.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from shadow1_tpu import checkpoint, netem, sim
+from shadow1_tpu.core import emit, engine, simtime
+from shadow1_tpu.core import state as st
+
+SEC = simtime.SIMTIME_ONE_SECOND
+MS = simtime.SIMTIME_ONE_MILLISECOND
+
+# Narrow pool columns, as positions in the full-width layout: the UDP
+# inbox prefix (0..NCOLS_UDP-1) followed by the outbox extension, which
+# full-width puts after the TCP columns (OCOLS - OEXT_COLS ..).
+NARROW_FROM_WIDE = list(range(st.NCOLS_UDP)) + [
+    st.OCOLS - st.OEXT_COLS + k for k in range(st.OEXT_COLS)]
+
+
+def _widen(state):
+    """The same t=0 world with legacy full-width packed blocks."""
+    assert state.pool.blk.shape[1] == st.pool_cols(False)
+    assert state.inbox.blk.shape[1] == st.NCOLS_UDP
+    return state.replace(
+        pool=st.make_packet_pool(state.pool.capacity, cols=st.OCOLS),
+        inbox=st.make_inbox(
+            state.hosts.num_hosts,
+            state.inbox.capacity // state.hosts.num_hosts,
+            cols=st.ICOLS))
+
+
+def _assert_equiv(narrow, wide, label):
+    """Leaf-for-leaf bitwise equality modulo the column map."""
+    la, ta = jax.tree_util.tree_flatten(narrow)
+    lb, tb = jax.tree_util.tree_flatten(wide)
+    assert ta == tb, f"{label}: tree structure diverged"
+    blk_pairs = 0
+    for i, (x, y) in enumerate(zip(la, lb)):
+        x, y = np.asarray(x), np.asarray(y)
+        if x.shape == y.shape:
+            assert np.array_equal(x, y), f"{label}: leaf {i} diverged"
+            continue
+        # Width-mismatched leaves must be exactly the two packed blocks.
+        assert x.ndim == 2 and y.ndim == 2 and x.shape[0] == y.shape[0], (
+            f"{label}: leaf {i} has unexplained shape {x.shape}/{y.shape}")
+        if y.shape[1] == st.OCOLS:
+            cols, drop = NARROW_FROM_WIDE, y[:, st.ICOL_TSE_LO:st.ICOLS]
+        else:
+            assert y.shape[1] == st.ICOLS
+            cols, drop = list(range(st.NCOLS_UDP)), y[:, st.ICOL_TSE_LO:]
+        # TS_LO/HI legitimately hold the send timestamp in the wide
+        # layout (write-only for UDP -- only TCP RTT sampling reads it);
+        # TSE/SACK must never have been touched in a TCP-free world.
+        assert not drop.any(), (
+            f"{label}: leaf {i}: full-width run wrote a TSE/SACK column "
+            f"in a TCP-free world -- narrowing would be lossy")
+        assert np.array_equal(x, y[:, cols]), f"{label}: blk leaf {i}"
+        blk_pairs += 1
+    assert blk_pairs == 2, f"{label}: expected narrow pool+inbox blocks"
+
+
+def _phold(**kw):
+    kw.setdefault("num_hosts", 16)
+    kw.setdefault("msgs_per_host", 2)
+    kw.setdefault("mean_delay_ns", 10 * MS)
+    kw.setdefault("stop_time", 2 * SEC)
+    kw.setdefault("pool_capacity", 16 * 8)
+    kw.setdefault("seed", 7)
+    return sim.build_phold(**kw)
+
+
+class TestPholdNeutrality:
+    @pytest.mark.parametrize("rx_batch", [1, 2])
+    def test_run_until_bitwise_identical(self, rx_batch):
+        state, params, app = _phold(rx_batch=rx_batch)
+        narrow = engine.run_until(state, params, app, SEC)
+        wide = engine.run_until(_widen(state), params, app, SEC)
+        assert int(narrow.app.recv.sum()) > 0, "no traffic simulated"
+        _assert_equiv(narrow, wide, f"phold rx_batch={rx_batch}")
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("chunk_ms", [200, 500])
+    def test_chunked_bitwise_identical(self, chunk_ms):
+        # Hold the chunking fixed; narrow vs wide must then be bitwise
+        # on every leaf including window/rng bookkeeping.
+        state, params, app = _phold()
+        narrow = engine.run_chunked(state, params, app, SEC,
+                                    chunk_ns=chunk_ms * MS)
+        wide = engine.run_chunked(_widen(state), params, app, SEC,
+                                  chunk_ns=chunk_ms * MS)
+        _assert_equiv(narrow, wide, f"phold chunked {chunk_ms}ms")
+
+    @pytest.mark.slow
+    def test_netem_link_flap_bitwise_identical(self):
+        # A link flap exercises the exchange drop path mid-run; the
+        # overlay must see identical packets in both layouts.
+        state, params, app = _phold(num_hosts=16, msgs_per_host=4)
+        tl = netem.timeline()
+        tl.link_down(2, 5, at=100 * MS).link_up(2, 5, at=600 * MS)
+        tl.link_down(1, 9, at=200 * MS).link_up(1, 9, at=SEC)
+        state, params = netem.install(state, params, tl)
+        narrow = engine.run_until(state, params, app, 2 * SEC)
+        wide = engine.run_until(_widen(state), params, app, 2 * SEC)
+        assert int(narrow.nm.cursor) == 4, "timeline never applied"
+        _assert_equiv(narrow, wide, "phold link-flap")
+
+
+class TestTcpWorldsStayWide:
+    """TCP worlds must keep the full-width block (TSE + SACK live in the
+    dropped columns) and keep working end to end, loss included."""
+
+    def test_lossy_bulk_full_width_and_healthy(self):
+        state, params, app = sim.build_bulk(
+            num_hosts=4, bytes_per_client=30_000,
+            reliability=0.97, stop_time=4 * SEC, seed=11)
+        assert state.pool.blk.shape[1] == st.pool_cols(True) == st.OCOLS
+        assert state.inbox.blk.shape[1] == st.ICOLS
+        out = engine.run_until(state, params, app, 3 * SEC)
+        assert int(out.err) == 0
+        assert int(out.socks.bytes_recv.sum()) > 0, "no bytes moved"
+
+    def test_narrow_emissions_reject_tcp_fields(self):
+        # The emission buffer has no home for SACK ranges in a TCP-free
+        # world; emit.put must refuse rather than silently drop them.
+        em = emit.empty(4, 1, cols=st.pool_cols(False))
+        ones = np.ones((4,), np.int32)
+        with pytest.raises(ValueError):
+            emit.put(em, np.ones((4,), bool), 0, dst=ones, sport=ones,
+                     dport=ones, proto=ones, length=ones,
+                     sack_lo=ones.astype(np.int64),
+                     sack_hi=ones.astype(np.int64))
+
+
+class TestCheckpointRoundTrip:
+    def test_save_load_continue_bitwise(self, tmp_path):
+        state, params, app = _phold()
+        mid = engine.run_until(state, params, app, SEC)
+        path = str(tmp_path / "mid.npz")
+        checkpoint.save(path, mid, params)
+        # Template built the same way: narrow layout on both sides.
+        t_state, t_params, _ = _phold()
+        assert t_state.pool.blk.shape[1] == st.pool_cols(False)
+        l_state, l_params = checkpoint.load(path, t_state, t_params)
+        straight = engine.run_until(mid, params, app, 2 * SEC)
+        resumed = engine.run_until(l_state, l_params, app, 2 * SEC)
+        la, ta = jax.tree_util.tree_flatten(straight)
+        lb, tb = jax.tree_util.tree_flatten(resumed)
+        assert ta == tb
+        for i, (x, y) in enumerate(zip(la, lb)):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), (
+                f"resume leaf {i} diverged")
+
+    def test_width_mismatch_names_the_cause(self, tmp_path):
+        state, params, app = _phold()
+        path = str(tmp_path / "narrow.npz")
+        checkpoint.save(path, state, params)
+        t_state, t_params, _ = _phold()
+        with pytest.raises(ValueError, match="uses_tcp"):
+            checkpoint.load(path, _widen(t_state), t_params)

@@ -27,7 +27,18 @@ import sys
 _HIGHER_BETTER = ("events_per_sec", "value", "vs_baseline",
                   "events_per_microstep")
 _LOWER_BETTER = ("wall_sec", "wall_s", "p50_ms", "p95_ms", "max_ms",
-                 "total_s", "compile_s")
+                 "total_s", "compile_s", "stage_emissions_ms")
+
+# Machine-bound leaves: wall-clock / throughput numbers that only
+# compare between runs on the same backend + core count.  Across
+# environments (or against a baseline recorded before bench.py stamped
+# an "env" block) they print as informational rows but never flag --
+# a 1-core CPU container cannot "regress" against a TPU recording.
+# events_per_microstep and the kernel counts are properties of the
+# compiled graph / trajectory and gate regardless.
+_MACHINE_BOUND = ("events_per_sec", "value", "vs_baseline", "wall_sec",
+                  "wall_s", "p50_ms", "p95_ms", "max_ms", "total_s",
+                  "compile_s", "stage_emissions_ms")
 
 # Compiled-kernel-count leaves (tools/kernelcount.py reports, standalone
 # or embedded under profile.kernelcount): deterministic integers, so
@@ -95,6 +106,15 @@ def _kernel_world(d: dict):
     return (kc.get("backend"), tuple(sorted(kc["world"].items())))
 
 
+def _env(d: dict):
+    """The recorded execution environment (backend, cpu_count), or None
+    for files written before bench.py stamped one."""
+    env = d.get("env")
+    if not isinstance(env, dict):
+        return None
+    return (env.get("backend"), env.get("cpu_count"))
+
+
 def _direction(name: str):
     """'up' (bigger better), 'down' (smaller better), or None (info)."""
     leaf = name.rsplit(".", 1)[-1]
@@ -106,14 +126,16 @@ def _direction(name: str):
 
 
 def diff(old: dict, new: dict, threshold_pct: float,
-         kernels: bool = False, kernel_threshold_pct: float = 0.0):
+         kernels: bool = False, kernel_threshold_pct: float = 0.0,
+         same_env: bool = True):
     """Compare shared numeric metrics; return (rows, regressions).
 
     rows: (name, old, new, pct_change, flag) for every shared directional
     metric; regressions: the flagged subset.  With kernels=True the
     compiled-kernel-count leaves gate too (direction down, at the tight
     kernel threshold -- counts are deterministic integers, so any growth
-    is a real graph regression, not noise)."""
+    is a real graph regression, not noise).  With same_env=False the
+    machine-bound leaves (_MACHINE_BOUND) still print but never flag."""
     fo, fn = _flatten(old), _flatten(new)
     rows, regressions = [], []
     for name in sorted(set(fo) & set(fn)):
@@ -121,6 +143,8 @@ def diff(old: dict, new: dict, threshold_pct: float,
         if kernel and not kernels:
             continue
         gated = not kernel or name.rsplit(".", 1)[-1] in _KERNEL_GATED
+        if not same_env and name.rsplit(".", 1)[-1] in _MACHINE_BOUND:
+            gated = False
         d = "down" if kernel else _direction(name)
         if d is None:
             continue
@@ -177,9 +201,20 @@ def main(argv=None) -> int:
                   f"different worlds (old={wo!r}, new={wn!r})",
                   file=sys.stderr)
             return 2
+    eo, en = _env(old), _env(new)
+    # Both-absent compares (hand-written JSONs, pre-env recordings on
+    # one machine) keep the legacy full gate; a one-sided or mismatched
+    # stamp means the runs came from different machines/backends.
+    same_env = eo == en
+    if not same_env:
+        print(f"benchdiff: environments differ "
+              f"(old env={eo!r}, new env={en!r}); machine-bound metrics "
+              f"(wall/throughput) shown for information only -- graph "
+              f"metrics still gate", file=sys.stderr)
     rows, regressions = diff(old, new, args.threshold,
                              kernels=args.kernels,
-                             kernel_threshold_pct=args.kernel_threshold)
+                             kernel_threshold_pct=args.kernel_threshold,
+                             same_env=same_env)
     if not rows:
         print("benchdiff: no shared directional metrics between the two "
               "files", file=sys.stderr)

@@ -95,7 +95,7 @@ def phase_counts(num_hosts: int = 64, rx_batch: int = 1,
     import jax
     import jax.numpy as jnp
 
-    from shadow1_tpu.core import engine
+    from shadow1_tpu.core import emit, engine
     from shadow1_tpu.core.state import I64
 
     state, params, app = _tiny_world(num_hosts, rx_batch, seed)
@@ -109,9 +109,22 @@ def phase_counts(num_hosts: int = 64, rx_batch: int = 1,
     def _exchange(s):
         return engine._exchange_body(s, params)
 
+    # The staging merge in isolation (emissions block -> outbox rows):
+    # the phase the packed-pool block write collapsed, counted on its own
+    # so the block-layout win stays visible when the surrounding
+    # micro-step grows for unrelated reasons.  The emissions buffer is a
+    # traced INPUT (not built inside the lowered fn) so none of its
+    # zeros constant-fold into the counted graph.
+    em0 = emit.empty(h, emit.SLOT_APP + 1, cols=state.pool.blk.shape[1])
+
+    def _staging(s, em, th):
+        return engine._stage_emissions(s, params, em, th,
+                                       jnp.ones((h,), jnp.bool_), app)[0]
+
     phases = {
         "microstep": lambda: jax.jit(_microstep).lower(state, t_h, we),
         "exchange": lambda: jax.jit(_exchange).lower(state),
+        "staging": lambda: jax.jit(_staging).lower(state, em0, t_h),
         "run_until": lambda: engine.run_until.lower(
             state, params, app, jnp.asarray(0, I64)),
     }

@@ -42,7 +42,7 @@ MS = simtime.SIMTIME_ONE_MILLISECOND
 
 
 def timeloop(name, state0, params, app, body, iters_pair=(50, 200),
-             trials=3):
+             trials=3, quiet=False):
     """Slope-time `body` (state, t_h) -> (state, t_h): ms per iteration
     from the (iters_pair[1] - iters_pair[0]) wall-time difference."""
     res = {}
@@ -73,7 +73,8 @@ def timeloop(name, state0, params, app, body, iters_pair=(50, 200),
         res[iters] = min(ts)
     slope = (res[iters_pair[1]] - res[iters_pair[0]]) \
         / (iters_pair[1] - iters_pair[0]) * 1e3
-    print(f"{name:44s} {slope:8.3f} ms/iter", flush=True)
+    if not quiet:
+        print(f"{name:44s} {slope:8.3f} ms/iter", flush=True)
     return slope
 
 
@@ -117,7 +118,8 @@ def _subset_bodies(state, params, app, we):
     def base(s, th):
         active = th < we
         tick = jnp.where(active, th, we)
-        return s, emit.empty(h, n_lanes), tick, active
+        return s, emit.empty(h, n_lanes, cols=s.pool.blk.shape[1]), \
+            tick, active
 
     def v_scan(s, th):
         s = s.replace(hosts=s.hosts.replace(
@@ -204,18 +206,41 @@ def run_ablate(state, params, app, we):
                 setattr(engine, name, fn)
 
     no_tx = with_patches({"_tx_drain":
-                          lambda s, params, tick_t, active: s})
+                          lambda s, params, tick_t, active, **kw: s})
     no_stage = with_patches({"_stage_emissions":
-                             lambda s, params, em, tick_t, active, app:
-                             (s, jnp.zeros_like(em.valid))})
+                             lambda s, params, em, tick_t, active, app,
+                             **kw: (s, jnp.zeros_like(em.valid))})
     no_rx = with_patches({"_rx_phase":
-                          lambda s, params, em, tick_t, active, app, we2:
-                          (s, em, jnp.zeros(
+                          lambda s, params, em, tick_t, active, app, we2,
+                          **kw: (s, em, jnp.zeros(
                               (s.hosts.num_hosts,), I32), tick_t)})
 
     print(f"{'=> tx_drain':44s} {base - no_tx:8.3f} ms")
     print(f"{'=> stage_emissions':44s} {base - no_stage:8.3f} ms")
     print(f"{'=> rx_phase':44s} {base - no_rx:8.3f} ms")
+
+
+def measure_staging_ms(state, params, app, iters_pair=(20, 60)) -> float:
+    """ms per staging merge on the live backend: a forced loop of
+    `_stage_emissions` over a fully-valid synthetic emissions buffer,
+    slope-timed.  The merge's cost is shape-bound (one-hot masked
+    selects over [H, E, Ko, C]), not data-bound, so the synthetic
+    buffer measures the real phase; bench.py records the result as
+    `profile.stage_emissions_ms` each round."""
+    h = int(state.hosts.num_hosts)
+    em = emit.empty(h, emit.SLOT_APP + 1, cols=state.pool.blk.shape[1])
+    dst = (jnp.arange(h, dtype=I32) + 1) % h
+    em = emit.put(em, jnp.ones((h,), jnp.bool_), emit.SLOT_APP,
+                  dst=dst, sport=9, dport=9, proto=17, length=100)
+    active = jnp.ones((h,), jnp.bool_)
+
+    def body(s, th):
+        s2, _placed = engine._stage_emissions(s, params, em, th, active,
+                                              app)
+        return s2, th + 1
+
+    return timeloop("staging (forced)", state, params, app, body,
+                    iters_pair=iters_pair, quiet=True)
 
 
 def run_exchange(state, params, app):
