@@ -222,6 +222,9 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
         "env": {
             "backend": jax.default_backend(),
             "cpu_count": os.cpu_count(),
+            # Throughput buckets by mesh size: benchdiff refuses to
+            # compare across device counts (rc 2), like cross-netem.
+            "n_devices": 1,
         },
         "profile": {
             "phases": metrics["phases"],
@@ -231,6 +234,126 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
             "kernelcount": metrics.get("kernelcount"),
             "stage_emissions_ms": stage_ms,
         },
+    }
+    print(json.dumps(result))
+    if gate_against:
+        return _gate(gate_against, result)
+    return 0
+
+
+# MULTICHIP scaling rung (--devices N): a smaller fixed world than the
+# single-chip probe, because every rung of the ladder (1, 2, 4, .., N
+# devices) runs it to completion and the 1-device rung bounds the wall
+# time.  Same shape across rungs so ev/s is comparable within the record.
+MESH_HOSTS = 2048
+MESH_SIM_SECONDS = 1
+
+
+def _mesh_child(n_devices: int) -> int:
+    """Child process of --devices: measure phold ev/s through the
+    explicit shard_map engine (parallel.mesh_run_until) on this
+    process's first `n_devices` devices.  Prints one JSON line."""
+    from shadow1_tpu import parallel
+
+    devs = jax.devices()
+    assert len(devs) >= n_devices, (
+        f"mesh child sees {len(devs)} devices, need {n_devices}")
+    mesh = parallel.make_mesh(devs[:n_devices])
+    state, params, app = sim.build_phold(
+        num_hosts=MESH_HOSTS,
+        msgs_per_host=MSGS_PER_HOST,
+        mean_delay_ns=MEAN_DELAY_NS,
+        stop_time=(MESH_SIM_SECONDS + 1) * simtime.SIMTIME_ONE_SECOND,
+        pool_capacity=MESH_HOSTS * 8,
+        rx_batch=2,
+    )
+    warm = parallel.mesh_run_until(
+        state, params, app, 10 * simtime.SIMTIME_ONE_MILLISECOND,
+        mesh=mesh)
+    jax.block_until_ready(warm)
+    best = None
+    for _attempt in range(2):
+        t0 = time.perf_counter()
+        out = parallel.mesh_run_chunked(
+            warm, params, app,
+            MESH_SIM_SECONDS * simtime.SIMTIME_ONE_SECOND, mesh=mesh)
+        n_steps = int(out.n_steps)  # sync point (scalar fetch)
+        wall = time.perf_counter() - t0
+        if best is None or wall < best[0]:
+            best = (wall, out, n_steps)
+    wall, out, _ = best
+    events = int(out.app.recv.sum() - warm.app.recv.sum()) \
+        + int(out.app.sent.sum() - warm.app.sent.sum())
+    print(json.dumps({
+        "devices": n_devices,
+        "events_per_sec": round(events / wall, 2),
+        "events": events,
+        "wall_sec": round(wall, 3),
+        "err": int(out.err),
+    }))
+    return 0
+
+
+def main_multichip(n_devices: int, gate_against: str | None = None) -> int:
+    """--devices N: the MULTICHIP scaling record.  Runs the fixed
+    MESH_HOSTS phold world through parallel.mesh_run_until at every
+    power-of-two device count up to N (1, 2, 4, .., N), each in a fresh
+    child interpreter so the device count is set before jax initializes
+    (forced virtual CPU devices when the ambient backend doesn't have
+    enough real ones).  Prints ONE JSON line whose value is the ev/s at
+    N devices and whose multichip.scaling block holds the whole rung."""
+    import pathlib
+    import subprocess
+    root = pathlib.Path(__file__).resolve().parent
+    counts = [d for d in (1, 2, 4, 8, 16, 32, 64) if d < n_devices]
+    counts.append(n_devices)
+    ambient = jax.default_backend()
+    rungs = []
+    for d in counts:
+        env = dict(os.environ)
+        if ambient == "cpu" or len(jax.devices()) < d:
+            backend = "cpu"
+            env["JAX_PLATFORMS"] = "cpu"
+            env["SHADOW1_TPU_CACHE"] = ""
+            env["PALLAS_AXON_POOL_IPS"] = ""
+            flags = [f for f in env.get("XLA_FLAGS", "").split()
+                     if "xla_force_host_platform_device_count" not in f]
+            flags.append(f"--xla_force_host_platform_device_count={d}")
+            env["XLA_FLAGS"] = " ".join(flags)
+        else:
+            backend = ambient
+        r = subprocess.run(
+            [sys.executable, str(root / "bench.py"), "--mesh-child",
+             str(d)], env=env, cwd=str(root), capture_output=True,
+            text=True, timeout=1800)
+        if r.returncode != 0:
+            sys.stderr.write(r.stderr)
+            print(f"bench --devices: child at {d} devices failed "
+                  f"(rc={r.returncode})", file=sys.stderr)
+            return 1
+        rung = json.loads(r.stdout.strip().splitlines()[-1])
+        rung["backend"] = backend
+        rungs.append(rung)
+    top = rungs[-1]
+    result = {
+        "metric": "phold_events_per_sec",
+        "value": top["events_per_sec"],
+        "unit": "events/sec",
+        "wall_sec": top["wall_sec"],
+        "config": {
+            "num_hosts": MESH_HOSTS,
+            "msgs_per_host": MSGS_PER_HOST,
+            "sim_seconds": MESH_SIM_SECONDS,
+            "rx_batch": 2,
+            "engine": "mesh_run_until",
+            "netem": None,
+        },
+        "env": {
+            "backend": top["backend"],
+            "cpu_count": os.cpu_count(),
+            "n_devices": n_devices,
+        },
+        "multichip": {"scaling": rungs},
     }
     print(json.dumps(result))
     if gate_against:
@@ -274,7 +397,20 @@ if __name__ == "__main__":
                          "recorded BENCH_r{N}.json / bench line with "
                          "tools/benchdiff.py --kernels and exit nonzero "
                          "on a throughput or kernel-count regression")
+    ap.add_argument("--devices", type=int, default=None, metavar="N",
+                    help="MULTICHIP scaling record: run the fixed mesh "
+                         "world through parallel.mesh_run_until at 1, 2, "
+                         "4, .., N devices (fresh child interpreter per "
+                         "count; virtual CPU devices when the backend "
+                         "lacks real ones) and print one JSON line with "
+                         "the scaling block")
+    ap.add_argument("--mesh-child", type=int, default=None,
+                    help=argparse.SUPPRESS)
     ns = ap.parse_args()
+    if ns.mesh_child:
+        sys.exit(_mesh_child(ns.mesh_child))
+    if ns.devices:
+        sys.exit(main_multichip(ns.devices, ns.gate_against))
     # The TPU tunnel's compile service occasionally drops a request
     # ("response body closed", "TPU device error"); one retry rides out
     # such transients so a flaky RPC doesn't record a failed round.

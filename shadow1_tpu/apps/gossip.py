@@ -30,7 +30,7 @@ from flax import struct
 import jax.numpy as jnp
 
 from ..core import emit, simtime
-from ..core.state import I32, I64, U32
+from ..core.state import I32, I64, U32, host_ids
 from ..transport import udp
 
 GOSSIP_PORT = 8333          # where every node's wildcard socket binds
@@ -97,9 +97,10 @@ class Gossip:
         has_work = (owe_item | want | announce).any(axis=1)
         t = jnp.where(has_work, a.next_t,
                       jnp.asarray(simtime.SIMTIME_INVALID, I64))
-        # Unborn items wake their origin at birth.
-        h = a.next_t.shape[0]
-        mine = (a.origin[None, :] == jnp.arange(h, dtype=I32)[:, None]) & \
+        # Unborn items wake their origin at birth.  Origins are GLOBAL
+        # host ids, so the row comparison uses global ids too (identity
+        # arange off-mesh).
+        mine = (a.origin[None, :] == host_ids(state, I32)[:, None]) & \
             (a.phase == PH_UNKNOWN)
         birth_t = jnp.min(jnp.where(mine, a.birth[None, :],
                                     jnp.asarray(simtime.SIMTIME_INVALID, I64)),
@@ -110,7 +111,7 @@ class Gossip:
         a = state.app
         socks = state.socks
         h, items = a.phase.shape
-        rows = jnp.arange(h, dtype=I32)
+        rows = host_ids(state, I32)   # GLOBAL ids (origin/peer compares)
         slot = jnp.zeros((h,), I32)
 
         # ---- birth: originate due items (content appears from thin air) --

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import simtime
-from ..core.state import (I32, I64, SOCK_TCP, TCPS_CLOSEWAIT,
+from ..core.state import (I32, I64, SOCK_TCP, TCPS_CLOSEWAIT, host_ids,
                           TCPS_ESTABLISHED, U32)
 from ..transport import tcp
 from ..transport.tcp import _sdiff
@@ -87,7 +87,10 @@ class Onion:
         # slot).
         want = active & ~a.started & (a.next_hop >= 0) & \
             (a.role <= 1) & (a.start_t <= tick_t)
-        lport = (20000 + jnp.arange(h, dtype=I32) % 20000)
+        # Local ports derive from the GLOBAL host id (identity
+        # off-mesh): ports are on the wire, so a shard-local index
+        # would break the mesh determinism contract.
+        lport = (20000 + host_ids(state, I32) % 20000)
         socks = tcp.connect_v(socks, want, slot, a.next_hop, ONION_PORT,
                               lport, tick_t)
         a = a.replace(started=a.started | want)

@@ -19,7 +19,7 @@ from flax import struct
 import jax.numpy as jnp
 
 from ..core import emit, rng, simtime
-from ..core.state import I32, I64, U32
+from ..core.state import I32, I64, U32, host_ids
 from ..transport import udp
 
 PHOLD_PORT = 9000
@@ -112,7 +112,12 @@ class Phold:
         a = state.app
         socks = state.socks
         h = a.pending.shape[0]
-        rows = jnp.arange(h, dtype=U32)
+        # GLOBAL host ids (identity off-mesh): they key every RNG draw and
+        # the dst pick, so draws are mesh-invariant.  The world's global
+        # host count is host_vertex's length, not the (possibly shard-
+        # local) state row count.
+        rows = host_ids(state, U32)
+        hg = params.host_vertex.shape[0]
         slot = jnp.full((h,), self.sock_slot, I32)
 
         # Consume delivered messages from the socket ring: each one becomes
@@ -165,7 +170,7 @@ class Phold:
                 # precedes any event that could reschedule it.
                 due = active & (a.pending > 0) & (a.next_send < bound)
                 t_send = a.next_send
-            dst = self._pick_dst(params, rows, ctr, h)
+            dst = self._pick_dst(params, rows, ctr, hg)
             em = emit.put(
                 em, due, emit.SLOT_APP + k,
                 dst=dst, sport=PHOLD_PORT, dport=PHOLD_PORT,

@@ -48,7 +48,7 @@ import numpy as np
 from ..core import rng, simtime
 from ..core.state import (I32, I64, U32, SOCK_TCP, TCPS_CLOSED,
                           TCPS_CLOSEWAIT, TCPS_ESTABLISHED, TCPS_LASTACK,
-                          TCPS_TIMEWAIT)
+                          TCPS_TIMEWAIT, host_ids)
 from ..transport import tcp
 
 INV = simtime.SIMTIME_INVALID
@@ -230,6 +230,12 @@ class TgenState:
     streams_done: jnp.ndarray   # [H] i64 observable: completed streams
     streams_failed: jnp.ndarray  # [H] i64
 
+    # Mesh-padding fills (parallel.pad_world_to_mesh): a zero row is NOT
+    # inert here -- cur=0 is a live program at node 0 and t_next=0 is a
+    # tick due at t=0.  Leaves not listed pad with zeros.
+    PAD_VALUES = {"cur": -1, "start_t": INV, "stop_t": INV,
+                  "wait_until": INV, "t_next": INV}
+
 
 class Tgen:
     """Static app marker (hashable; tables live in TgenState)."""
@@ -260,7 +266,9 @@ class Tgen:
         a = state.app
         socks = state.socks
         h = a.cur.shape[0]
-        rows = jnp.arange(h)
+        # Global host ids: RNG draws must be keyed identically whether the
+        # world runs on one device or sharded (docs/parallel.md).
+        rows = host_ids(state)
         slot = jnp.full((h,), self.client_slot, I32)
 
         # -- start / stop ----------------------------------------------------
@@ -365,9 +373,20 @@ class Tgen:
         child = (socks.stype == SOCK_TCP) & (socks.parent >= 0) & \
             ((socks.tcp_state == TCPS_ESTABLISHED) |
              (socks.tcp_state == TCPS_CLOSEWAIT))
-        peer = jnp.clip(socks.peer_host, 0, h - 1)
-        want_send = a.cur_send[peer]
-        want_recv = a.cur_recv[peer]
+        # peer_host is a GLOBAL id; on a mesh the peer's registers may live
+        # on another shard, so gather the two spec columns globally first.
+        if state.hoff is None:
+            cur_send_g, cur_recv_g = a.cur_send, a.cur_recv
+        else:
+            import jax
+            from ..core.engine import MESH_AXIS
+            cur_send_g = jax.lax.all_gather(a.cur_send, MESH_AXIS,
+                                            tiled=True)
+            cur_recv_g = jax.lax.all_gather(a.cur_recv, MESH_AXIS,
+                                            tiled=True)
+        peer = jnp.clip(socks.peer_host, 0, cur_send_g.shape[0] - 1)
+        want_send = cur_send_g[peer]
+        want_recv = cur_recv_g[peer]
         reply_ready = child & (socks.peer_host >= 0) & \
             (socks.bytes_recv >= want_send) & ~socks.app_closed
         rtarget = (jnp.uint32(1) + want_recv.astype(U32))

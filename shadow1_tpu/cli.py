@@ -100,6 +100,16 @@ def _parser():
                         "count) to the data directory and print a phase "
                         "summary table (see docs/observability.md)")
     r.add_argument("--quiet", action="store_true")
+    r.add_argument("--devices", type=int, default=1, metavar="N",
+                   help="shard the run across N devices "
+                        "(parallel.mesh_run_until: the window loop under "
+                        "shard_map with a dst-bucketed all-to-all exchange; "
+                        "bitwise-identical to single-device, see "
+                        "docs/parallel.md).  Worlds whose host count does "
+                        "not divide N are padded with inert hosts.  "
+                        "Incompatible with the single-device observability "
+                        "rings (--pcap, --log-level, --profile) and with "
+                        "real-process plugins")
     return p
 
 
@@ -250,6 +260,42 @@ def run_config(args) -> int:
         # Device-side per-window counters, fetched once per drain point.
         state = trace.ensure_counters(state)
 
+    mesh = None
+    parallel_mod = None
+    if args.devices > 1:
+        # The mesh path runs the window loop under shard_map; the
+        # capture/log rings and the substrate bridge are single-device
+        # mechanisms (global append cursors, per-host RPC), so refuse the
+        # combination instead of silently de-interleaving.
+        incompat = []
+        if want_pcap:
+            incompat.append("--pcap / <host logpcap>")
+        if drain is not None:
+            incompat.append("--log-level / <host loglevel>")
+        if profiler is not None:
+            incompat.append("--profile")
+        if substrate is not None:
+            incompat.append("real-process plugins")
+        if incompat:
+            print(f"error: --devices is incompatible with "
+                  f"{', '.join(incompat)} (single-device only; see "
+                  f"docs/parallel.md)", file=sys.stderr)
+            return 2
+        from . import parallel as parallel_mod
+        devs = jax.devices()
+        if len(devs) < args.devices:
+            print(f"error: --devices {args.devices} but only {len(devs)} "
+                  f"{jax.default_backend()} device(s) visible",
+                  file=sys.stderr)
+            return 2
+        mesh = parallel_mod.make_mesh(devs[:args.devices])
+        state, params = parallel_mod.pad_world_to_mesh(
+            state, params, args.devices)
+        if not args.quiet:
+            print(f"[shadow1-tpu] mesh: {args.devices} devices, "
+                  f"{int(state.hosts.num_hosts) // args.devices} hosts "
+                  f"per shard", file=sys.stderr)
+
     t = int(state.now)
     hb_next = 0
     while t < stop:
@@ -259,6 +305,9 @@ def run_config(args) -> int:
                      stop)
         if substrate is not None:
             state = _bridge.run(substrate, state, params, app, t_next)
+        elif mesh is not None:
+            state = parallel_mod.mesh_run_chunked(state, params, app,
+                                                  t_next, mesh=mesh)
         else:
             state = engine.run_chunked(state, params, app, t_next)
         t = t_next

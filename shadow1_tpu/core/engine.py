@@ -75,13 +75,37 @@ from .state import (ERR_POOL_OVERFLOW, I32, I64, U32, PROTO_TCP, PROTO_UDP,
                     LOG_WARNING, LOG_DEBUG, LOG_DROP_INET, LOG_DROP_ROUTER,
                     LOG_DROP_TAIL, LOG_DROP_POOL, LOG_DELIVER, LOG_SEND,
                     LOG_NETEM_DOWN,
-                    enc_lo, enc_hi, dec_i64, SimState)
+                    enc_lo, enc_hi, dec_i64, SimState, host_ids)
 # Fault/dynamics overlay operators (netem/apply.py).  Every call site
 # guards on `state.nm is None` (a trace-time pytree check), so worlds
 # without a fault schedule compile the overlay away entirely.
 from ..netem import apply as netem_apply
 
 INV = simtime.SIMTIME_INVALID
+
+# Mesh axis name the sharded entry (parallel/mesh.py) maps hosts over.
+# Defined here (not imported from parallel/) so core never depends on the
+# parallel package; parallel.sharding.HOST_AXIS must match.
+MESH_AXIS = "hosts"
+
+
+def _on_mesh(state: SimState) -> bool:
+    """Trace-time static: is this trace running inside the shard_map body
+    of parallel.mesh_run_until?  Off-mesh (hoff None) every mesh branch
+    below traces away, keeping the single-device graph byte-identical."""
+    return state.hoff is not None
+
+
+def _lrows(state: SimState, vec):
+    """Slice a [H_global] per-host vector down to this shard's local rows
+    (identity off-mesh).  Only needed for the few per-host inputs that
+    stay replicated under the mesh because they are also gathered by
+    global ids (params.host_vertex)."""
+    if state.hoff is None:
+        return vec
+    return jax.lax.dynamic_slice_in_dim(vec, state.hoff,
+                                        state.hosts.num_hosts)
+
 
 def _uses_tcp(app) -> bool:
     """Static app capability: apps that never open TCP sockets (pure-UDP
@@ -434,9 +458,167 @@ def _exchange_body(state: SimState, params) -> SimState:
     return state
 
 
+def _exchange_body_mesh(state: SimState, params) -> SimState:
+    """Boundary exchange across a device mesh: the dst-bucketed
+    all-to-all the single-device scatter becomes when hosts shard.
+
+    Three stages, each reusing the single-device machinery at a
+    different granularity:
+
+    1. SEND BUCKETING: movers rank by destination SHARD (`_rank_by_dst`
+       with h = n_shards) in local flat (src-major) order, then scatter
+       their spliced rows -- plus a global-dst trailer column (and the
+       status trail when enabled) -- into a [D*B, C+] send buffer of D
+       fixed-size blocks.  B = local pool capacity is an exact bound:
+       a shard can never have more movers than outbox slots.
+
+    2. COLLECTIVE: one tiled `lax.all_to_all` swaps block d of every
+       shard to shard d.  Received block s holds sender s's movers in
+       sender-local flat order, so concatenated blocks s=0..D-1 are in
+       GLOBAL flat (src-major) order -- exactly the order the
+       single-device rank walks, which is what keeps the per-dst rank
+       (and therefore slot assignment, overflow choice, and ACK-shed
+       choice) bitwise identical to the single-device run.
+
+    3. LOCAL SPLICE: the received rows re-rank by LOCAL destination and
+       take free inbox slots in ascending order -- the unchanged
+       single-device tail, with the two ACK-shed gate predicates
+       (overflow anywhere / any pure ACK among movers) reduced across
+       shards first: they are global `any`s on one device, and shards
+       must agree on the shed-vs-keep regime or slot layouts (including
+       stale bytes under later writes) diverge leaf-for-leaf."""
+    pool, ib, hosts = state.pool, state.inbox, state.hosts
+    h = hosts.num_hosts                   # local hosts on this shard
+    p0 = pool.capacity                    # local outbox rows
+    p1 = ib.capacity
+    ki = p1 // h
+    ic = ib.blk.shape[1]
+    d = jax.lax.psum(1, MESH_AXIS)        # static shard count
+    hg = h * d                            # global hosts
+
+    moving = pool.stage == STAGE_IN_FLIGHT          # [p0] local src-major
+    dst_g = jnp.clip(pool.dst, 0, hg - 1)           # global dst ids
+    dev = dst_g // h                                # destination shard
+
+    # --- stage 1: rank by destination shard, in local flat order.
+    m = _superblock(p0, d)
+    npad = -(-p0 // m) * m
+    pad = npad - p0
+    devp = jnp.pad(dev, (0, pad))
+    mvp = jnp.pad(moving, (0, pad))
+    brank, _ = _rank_by_dst(mvp, devp, d, m)
+
+    # Spliced rows exactly as the single-device exchange forwards them
+    # (TIME columns refreshed from the authoritative `time` array).
+    vals = jnp.concatenate(
+        [pool.blk[:, :ICOL_TIME_LO],
+         enc_lo(pool.time)[:, None], enc_hi(pool.time)[:, None],
+         pool.blk[:, ICOL_TIME_HI + 1:ic]], axis=1)        # [p0, ic]
+    trail = [dst_g[:, None]]
+    if params.pds_trail:
+        trail.append(pool.status[:, None])
+    row = jnp.pad(jnp.concatenate([vals] + trail, axis=1),
+                  ((0, pad), (0, 0)))                      # [npad, cs]
+    cs = row.shape[1]
+
+    b = p0                                 # bucket capacity (exact bound)
+    send_idx = jnp.where(mvp, devp * b + jnp.clip(brank, 0, b - 1), d * b)
+    sb = jnp.full((d * b, cs), -1, I32).at[send_idx].set(row, mode="drop")
+
+    # --- stage 2: the collective.  Received block s = sender s's bucket
+    # for this shard, preserving sender-local order.
+    rb = jax.lax.all_to_all(sb, MESH_AXIS, split_axis=0, concat_axis=0,
+                            tiled=True)                    # [d*b, cs]
+
+    # --- stage 3: local splice (the single-device tail on rb rows).
+    rdst_g = rb[:, ic]                     # -1 marks bucket padding
+    rvalid = rdst_g >= 0
+    rdst = jnp.clip(rdst_g - state.hoff, 0, h - 1)         # local dst row
+
+    n = d * b
+    m2 = _superblock(n, h)
+    npad2 = -(-n // m2) * m2
+    pad2 = npad2 - n
+    rdstp = jnp.pad(rdst, (0, pad2))
+    rvp = jnp.pad(rvalid, (0, pad2))
+    rank, total = _rank_by_dst(rvp, rdstp, h, m2)
+
+    free2 = (ib.stage == STAGE_FREE).reshape(h, ki)
+    ids = jnp.arange(ki, dtype=I32)[None, :]
+    n_free = jnp.sum(free2, axis=1, dtype=I32)
+
+    if ic >= ICOLS:
+        from ..transport.tcp import pure_ack as _pure_ack
+        pure_ack = _pure_ack(rb[:, ICOL_PROTO], rb[:, ICOL_FLAGS],
+                             rb[:, ICOL_LEN])
+        ackp = jnp.pad(pure_ack, (0, pad2)) & rvp
+        # GLOBAL gate predicates (see docstring): reduce before the cond.
+        overflow = jax.lax.pmax(
+            jnp.any(total > n_free).astype(I32), MESH_AXIS) > 0
+        any_ack = jax.lax.pmax(
+            jnp.any(ackp).astype(I32), MESH_AXIS) > 0
+
+        def two_class(_):
+            rank_prot, total_prot = _rank_by_dst(rvp & ~ackp, rdstp, h, m2)
+            r = jnp.where(ackp, total_prot[rdstp] + (rank - rank_prot),
+                          rank_prot)
+            return r, total_prot
+
+        rank_eff, total_prot = jax.lax.cond(
+            overflow & any_ack, two_class, lambda _: (rank, total), None)
+    else:
+        rank_eff, total_prot = rank, total
+
+    order2 = jnp.argsort(jnp.where(free2, ids, ids + ki), axis=1).astype(I32)
+    within = order2.reshape(-1)[rdstp * ki + jnp.clip(rank_eff, 0, ki - 1)]
+    ok = rvp & (rank_eff < n_free[rdstp])
+    islot = jnp.where(ok, rdstp * ki + within, p1)
+
+    rvals = jnp.pad(rb[:, :ic], ((0, pad2), (0, 0)))
+    ib = ib.replace(
+        blk=ib.blk.at[islot].set(rvals, mode="drop"),
+        stage=ib.stage.at[islot].set(STAGE_IN_FLIGHT, mode="drop"),
+        status=ib.status.at[islot].set(jnp.pad(rb[:, ic + 1], (0, pad2)),
+                                       mode="drop")
+        if params.pds_trail else ib.status,
+    )
+
+    if state.tr is not None:
+        # Local partials; pkts_exchanged / occ_max are finalized across
+        # shards by mesh_run_until (psum of the delta / pmax).
+        fit = jnp.minimum(total, n_free)
+        occ = jnp.max(ki - n_free + fit)
+        state = state.replace(tr=state.tr.replace(
+            exchanges=state.tr.exchanges + 1,
+            pkts_exchanged=state.tr.pkts_exchanged
+            + jnp.sum(fit.astype(I64)),
+            occ_max=jnp.maximum(state.tr.occ_max, occ.astype(I32))))
+
+    pool = pool.replace(stage=jnp.where(moving, STAGE_FREE, pool.stage))
+    drops_all = jnp.maximum(total - n_free, 0).astype(I64)
+    data_drops = jnp.minimum(
+        drops_all, jnp.maximum(total_prot - n_free, 0).astype(I64))
+    acks_shed = drops_all - data_drops
+    hosts = hosts.replace(
+        pkts_dropped_pool=hosts.pkts_dropped_pool + data_drops,
+        acks_thinned=hosts.acks_thinned + acks_shed)
+    # err is a per-shard partial here; mesh_run_until ORs it across
+    # shards before returning (nothing inside the run branches on it).
+    err = state.err | jnp.where(jnp.any(data_drops > 0), ERR_POOL_OVERFLOW,
+                                0).astype(state.err.dtype)
+    return state.replace(pool=pool, inbox=ib, hosts=hosts, err=err)
+
+
 def _exchange(state: SimState, params) -> SimState:
     """Run the boundary exchange iff anything moved this window."""
     moving = jnp.any(state.pool.stage == STAGE_IN_FLIGHT)
+    if _on_mesh(state):
+        # The mesh body contains collectives, so every shard must take
+        # the same branch: any mover anywhere runs the exchange on all.
+        moving = jax.lax.pmax(moving.astype(I32), MESH_AXIS) > 0
+        return jax.lax.cond(moving,
+                            lambda s: _exchange_body_mesh(s, params),
+                            lambda s: s, state)
     return jax.lax.cond(moving, lambda s: _exchange_body(s, params),
                         lambda s: s, state)
 
@@ -559,8 +741,13 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
     d_rounds = max(1, int(getattr(app, "rx_batch", 1)))
     ids = jnp.arange(ki, dtype=I32)[None, :]
     rows = jnp.arange(h, dtype=I32)
+    # Packet SRC columns carry GLOBAL host ids; under a mesh the local row
+    # index must be shifted before comparing against them (loopback test).
+    rows_g = host_ids(state, I32)
     boot = tick_t < params.bootstrap_end
     if bw_dn is None:
+        assert state.hoff is None, \
+            "mesh runs must pass the window ctx (local bw slices)"
         bw_dn = netem_apply.rate(state.nm, params.bw_down_Bps)
     tokens, last = nic.refill(hosts.tokens_rx, hosts.last_refill_rx,
                               bw_dn, tick_t, active)
@@ -624,7 +811,7 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
                                       bw_dn, t_eff, have)
             hosts = hosts.replace(last_refill_rx=last)
         size = _wire_bytes(pkt.proto, pkt.length).astype(I64) * nic.SCALE
-        loop = pkt.src == rows
+        loop = pkt.src == rows_g
         free_pass = loop | boot
         funded = have & (free_pass | (tokens >= size))
 
@@ -871,13 +1058,18 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     counts = jnp.sum(valid, axis=1).astype(I64)       # [H]
     ctr = hosts.send_ctr                               # [H]
 
-    src2 = jnp.broadcast_to(jnp.arange(h, dtype=I32)[:, None], (h, e))
+    # GLOBAL source ids: they key the jitter/drop RNG draws and ride the
+    # packet SRC column, so they must be mesh-invariant (identity arange
+    # off-mesh).
+    src2 = jnp.broadcast_to(host_ids(state, I32)[:, None], (h, e))
     ctr2 = ctr[:, None] + rank
 
     # Routing: latency (+ per-packet jitter) + reliability, loopback
     # shortcut.  vs is the emitting host's own vertex -- a broadcast, not
-    # a gather.
-    vs = jnp.broadcast_to(params.host_vertex[:, None], (h, e))
+    # a gather.  host_vertex stays replicated under the mesh (em.dst holds
+    # global ids), so the own-vertex broadcast slices it to local rows.
+    vs = jnp.broadcast_to(_lrows(state, params.host_vertex)[:, None],
+                          (h, e))
     vd = params.host_vertex[jnp.clip(em.dst, 0, params.host_vertex.shape[0] - 1)]
     lat, rel = _route(params, vs, vd, src2, ctr2)
     if state.nm is not None:
@@ -936,6 +1128,8 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     # in TX_QUEUED for _tx_drain (FIFO is preserved because any backlog
     # forces parking).
     if bw_up is None:
+        assert state.hoff is None, \
+            "mesh runs must pass the window ctx (local bw slices)"
         bw_up = netem_apply.rate(state.nm, params.bw_up_Bps)
     tokens, last = nic.refill(hosts.tokens_tx, hosts.last_refill_tx,
                               bw_up, tick_t, active)
@@ -1058,7 +1252,11 @@ def _loopback_insert(state: SimState, params, em, lb, src2, ctr2,
     lb_rank = jnp.where(lb, jnp.cumsum(lb, axis=1) - 1, -1)
     within = _free_slot_pick(free2, lb_rank)
     ok = lb & (lb_rank >= 0) & (lb_rank < n_free[:, None])
-    islot = jnp.where(ok, src2 * ki + within, p1).reshape(-1)
+    # src2 carries GLOBAL ids (they ride the SRC column); slab addressing
+    # is local, so shift back under a mesh.
+    src_l = src2 if state.hoff is None \
+        else src2 - state.hoff.astype(I32)
+    islot = jnp.where(ok, src_l * ki + within, p1).reshape(-1)
 
     # Packed rows in inbox layout: the emission block's first ICOLS
     # columns with SRC/TIME/CTR/TS patched (arrival = send + 1ns).
@@ -1127,6 +1325,8 @@ def _tx_drain(state: SimState, params, tick_t, active, bw_up=None):
     tx_queued and t_resume bitwise untouched), and the refill itself
     stays unconditional so token/timestamp state never diverges."""
     if bw_up is None:
+        assert state.hoff is None, \
+            "mesh runs must pass the window ctx (local bw slices)"
         bw_up = netem_apply.rate(state.nm, params.bw_up_Bps)
     if not params.kernel_diet:
         return _tx_drain_body(state, params, tick_t, active, bw_up)
@@ -1204,10 +1404,22 @@ def _window_ctx(state: SimState, params):
     boundaries (netem_apply.advance runs before the window's ticks), so
     the effective NIC rates and the host-liveness mask are constant
     across every micro-step of a window.  Returns (bw_up, bw_dn, alive);
-    alive is None for worlds without a fault overlay."""
-    return (netem_apply.rate(state.nm, params.bw_up_Bps),
-            netem_apply.rate(state.nm, params.bw_down_Bps),
-            None if state.nm is None else netem_apply.alive(state.nm))
+    alive is None for worlds without a fault overlay.
+
+    Under a mesh the bw params arrive pre-sliced to local rows (shard_map
+    in_specs) while the nm overlay stays replicated, so the overlay
+    factors are sliced to match (netem_apply.rate_rows/alive_rows)."""
+    if state.hoff is None:
+        return (netem_apply.rate(state.nm, params.bw_up_Bps),
+                netem_apply.rate(state.nm, params.bw_down_Bps),
+                None if state.nm is None else netem_apply.alive(state.nm))
+    h = state.hosts.num_hosts
+    return (netem_apply.rate_rows(state.nm, params.bw_up_Bps,
+                                  state.hoff, h),
+            netem_apply.rate_rows(state.nm, params.bw_down_Bps,
+                                  state.hoff, h),
+            None if state.nm is None
+            else netem_apply.alive_rows(state.nm, state.hoff, h))
 
 
 def _microstep_core(state: SimState, params, app, t_h, window_end,
@@ -1311,10 +1523,42 @@ def microstep(state: SimState, params, app, t_h, window_end):
 @functools.partial(jax.jit, static_argnames=("app",))
 def run_until(state: SimState, params, app, t_target):
     """Run windows until simulated time reaches t_target (jitted whole)."""
+    return run_until_impl(state, params, app, t_target)
+
+
+def run_until_impl(state: SimState, params, app, t_target):
+    """Window-loop body shared by the jitted single-device entry above
+    and the shard_map body of parallel.mesh_run_until.
+
+    Mesh mode (state.hoff set) changes exactly three things, all gated
+    at trace time so the single-device graph is byte-identical:
+
+    * the two loop-driving reductions -- per-window global min event
+      time and earliest outbox-pending arrival -- get a cross-shard
+      `pmin`, making every loop predicate uniform across shards (the
+      reference's `master_slaveFinishedCurrentRound` window-advance
+      reduction, master.c:450-480, as one collective);
+    * `_exchange` takes the all-to-all body (and a pmax'd predicate);
+    * `_window_ctx` slices the replicated netem overlay to local rows.
+
+    Uniform predicates guarantee identical window/micro-step trip counts
+    on every shard, which is what lets collectives live inside the
+    while_loops at all -- and makes n_steps/n_windows/now replicated for
+    free."""
     t_target = jnp.asarray(t_target, I64)
+    mesh = _on_mesh(state)
 
     def scan(s):
-        return _scan_all(s, params, app)
+        t_h, gmin = _scan_all(s, params, app)
+        if mesh:
+            gmin = jax.lax.pmin(gmin, MESH_AXIS)
+        return t_h, gmin
+
+    def outbox_pending(s):
+        g = _outbox_pending(s)
+        if mesh:
+            g = jax.lax.pmin(g, MESH_AXIS)
+        return g
 
     def window_cond(carry):
         st, _t_h, gmin, gout = carry
@@ -1353,12 +1597,12 @@ def run_until(state: SimState, params, app, t_target):
 
         st, t_h, gmin = jax.lax.while_loop(icond, ibody, (st, t_h, gmin))
         st = st.replace(now=we, n_windows=st.n_windows + 1)
-        return st, t_h, gmin, _outbox_pending(st)
+        return st, t_h, gmin, outbox_pending(st)
 
     t_h0, gmin0 = scan(state)
     state, _, _, _ = jax.lax.while_loop(
         window_cond, window_body,
-        (state, t_h0, gmin0, _outbox_pending(state)))
+        (state, t_h0, gmin0, outbox_pending(state)))
     if state.nm is not None:
         # Catch up through idle spans the window loop skipped, so the
         # cursor (and every counter derived from it) is canonical at

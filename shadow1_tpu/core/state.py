@@ -807,6 +807,23 @@ class SimState:
     n_steps: jnp.ndarray = struct.field(default=None)    # i64 micro-steps
     n_windows: jnp.ndarray = struct.field(default=None)  # i64 windows run
     n_events: jnp.ndarray = struct.field(default=None)   # i64 deliveries+emissions
+    # Mesh shard offset (parallel/mesh.py): global host id of this shard's
+    # row 0.  None off-mesh -- `state.hoff is None` is a trace-time static,
+    # so single-device graphs compile byte-identical to before the field
+    # existed.  Set only inside the shard_map body, never persisted.
+    hoff: any = struct.field(pytree_node=True, default=None)  # i32 scalar
+
+
+def host_ids(state, dtype=I32) -> jnp.ndarray:
+    """GLOBAL host ids of this state's rows: arange(h) off-mesh, shifted by
+    the shard offset under a mesh.  Use wherever a host id feeds RNG keys,
+    packet src fields, or comparisons against global-valued ids (app dst
+    leaves, packet.src) -- local row indices are only valid for slab
+    addressing."""
+    ids = jnp.arange(state.hosts.num_hosts, dtype=dtype)
+    if state.hoff is None:
+        return ids
+    return ids + state.hoff.astype(dtype)
 
 
 def warn_known_bad_pool(num_hosts: int, slab: int) -> None:

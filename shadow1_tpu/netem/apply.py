@@ -154,6 +154,24 @@ def rate(nm, bw_Bps):
                        jnp.asarray(1, I64))
 
 
+def alive_rows(nm: NetemBlock, hoff, h: int):
+    """`alive` for one mesh shard: rows [hoff, hoff+h) of the replicated
+    overlay (parallel/mesh.py keeps the whole nm block on every shard so
+    route_overlay can gather by global ids; per-host consumers slice)."""
+    return jax.lax.dynamic_slice_in_dim(nm.host_up, hoff, h) > 0
+
+
+def rate_rows(nm, bw_Bps, hoff, h: int):
+    """`rate` for one mesh shard: bw_Bps is already the shard's local
+    [h] slice, the replicated overlay's scale column is sliced to
+    match."""
+    if nm is None:
+        return bw_Bps
+    scale = jax.lax.dynamic_slice_in_dim(nm.bw_x1000, hoff, h)
+    return jnp.maximum((bw_Bps * scale.astype(I64)) // SCALE_ONE,
+                       jnp.asarray(1, I64))
+
+
 def min_lat_scale_x1000(events) -> int:
     """Smallest latency scale any event in a host-side schedule can set
     (x1000); the conservative window must shrink by this factor at

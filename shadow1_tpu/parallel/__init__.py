@@ -10,15 +10,31 @@ leading-`hosts`-axis array of the simulation state over a
 lets XLA/GSPMD insert the ICI collectives that realize the inter-host
 packet exchange and the min-next-event reduction (the analog of the
 master's window advance, master.c:450-480).
+
+Two entries share the layout policy (docs/parallel.md):
+
+* `sharded_run_until` -- GSPMD: shard the inputs, jit the unchanged
+  engine, let the compiler infer collectives.
+* `mesh_run_until` -- explicit: the window loop inside `shard_map` with
+  hand-placed collectives (dst-bucketed all_to_all exchange, pmin window
+  advance), bitwise identical to single-device execution.
 """
 
+from .mesh import mesh_run_chunked, mesh_run_until
 from .sharding import (HOST_AXIS, assert_packed_pool_sharding, make_mesh,
-                       shard_params, shard_state, sharded_run_until)
+                       pad_params_to_mesh, pad_state_to_mesh,
+                       pad_world_to_mesh, shard_params, shard_state,
+                       sharded_run_until)
 
 __all__ = [
     "HOST_AXIS",
     "assert_packed_pool_sharding",
     "make_mesh",
+    "mesh_run_chunked",
+    "mesh_run_until",
+    "pad_params_to_mesh",
+    "pad_state_to_mesh",
+    "pad_world_to_mesh",
     "shard_params",
     "shard_state",
     "sharded_run_until",

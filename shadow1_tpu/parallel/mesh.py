@@ -1,0 +1,197 @@
+"""Explicit sharded execution: the window loop under `shard_map`.
+
+`sharded_run_until` (sharding.py) lets GSPMD infer collectives from
+input shardings -- fine for correctness, but the compiler re-derives the
+communication pattern of the boundary exchange from a scatter into a
+fully-sharded inbox, and the loop-carried reductions get re-partitioned
+per iteration.  `mesh_run_until` instead runs the engine's window loop
+INSIDE `jax.experimental.shard_map.shard_map` on a 1-D `hosts` mesh with
+hand-placed collectives, mirroring the reference's explicit scheduler
+protocol (/root/reference/src/main/core/scheduler/scheduler.c:359-414):
+
+* hosts partition contiguously: shard k owns global hosts
+  [k*h, (k+1)*h).  Every host/pool/inbox-leading leaf shards that axis;
+  the engine body sees an ordinary (smaller) world plus `state.hoff`,
+  the shard's global row offset.
+* the window advance `jnp.min(t_h)` gets a cross-shard `pmin` (the
+  reference's master window-advance reduction, master.c:450-480);
+* the boundary exchange becomes a dst-bucketed `all_to_all` over
+  superblock ranks followed by the unchanged local splice
+  (engine._exchange_body_mesh);
+* per-host params ride in PRE-SLICED via in_specs (so the engine's
+  token-bucket/CPU/autotune code is untouched); `host_vertex` and
+  `route_blk` stay replicated because packets carry GLOBAL ids end to
+  end -- only slab addressing is local.
+
+Determinism contract: docs/parallel.md.  Every cross-shard decision
+(slot assignment, overflow choice, ACK-shed regime, window trip counts)
+is reduced to a canonical global order or a uniform predicate before
+use, so a world that divides the mesh runs leaf-for-leaf bitwise
+identical on 1, 2, 4, or 8 shards, for any chunking.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..core import engine
+from .sharding import (HOST_AXIS, PARAM_SPECS, _leaf_name, make_mesh,
+                       pad_world_to_mesh)
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+# Per-host param leaves that enter the shard_map body pre-sliced to the
+# shard's rows.  host_vertex and route_blk are deliberately NOT here:
+# emission stamps global vertex ids and the routing gather is keyed by
+# (src_vertex, dst_vertex) of arbitrary remote hosts, so both stay
+# replicated under the explicit mesh (unlike the GSPMD path, which may
+# shard route_blk rows and let the compiler insert the gather
+# collective).
+_PARAM_LOCAL = frozenset(
+    name for name, spec in PARAM_SPECS.items() if spec == P(HOST_AXIS)
+) - {"route_blk", "host_vertex"}
+
+
+def _state_specs(state):
+    """Partition specs for a SimState: shard every leaf whose leading
+    axis is the host axis (host tables, both packet pools, [H]-leading
+    app leaves); replicate scalars, telemetry, and the whole netem block
+    (route_overlay gathers by GLOBAL src/dst, and the event schedule
+    must advance identically on every shard)."""
+    h = state.hosts.num_hosts
+    host_rows = {h, state.pool.capacity, state.inbox.capacity}
+
+    def spec(path, leaf):
+        if getattr(path[0], "name", "") == "nm":
+            return P()
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
+                and leaf.shape[0] in host_rows:
+            return P(HOST_AXIS)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, state)
+
+
+def _param_specs(params):
+    def spec(path, leaf):
+        return P(HOST_AXIS) if _leaf_name(path) in _PARAM_LOCAL else P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+# (app, mesh, treedefs, specs) -> jitted shard_map entry.  jit's own
+# signature cache handles shape changes within a key.
+_MESH_CACHE: dict = {}
+
+
+def _build(app, mesh, sspecs, pspecs):
+    n_shards = mesh.devices.size
+
+    def body(state, params, t_target):
+        h = state.hosts.num_hosts  # shard-local rows
+        hoff = (jax.lax.axis_index(HOST_AXIS) * h).astype(I32)
+        st = state.replace(hoff=hoff)
+        n_ev0 = st.n_events
+        tr0 = st.tr
+        killed0 = None if st.nm is None else st.nm.killed
+
+        st = engine.run_until_impl(st, params, app, t_target)
+
+        # Finalize cross-shard aggregates so every shard returns the
+        # IDENTICAL value for every replicated leaf (out_specs P() with
+        # check_rep=False trusts, but does not create, replication):
+        # counters entered replicated, so global = start + psum(delta);
+        # err is a bitmask -> all_gather + OR (psum would double-count
+        # bits, pmax would drop them).  now/n_steps/n_windows/exchanges
+        # are uniform for free: every loop predicate is pmin/pmax'd, so
+        # all shards run identical trip counts.
+        errs = jax.lax.all_gather(st.err, HOST_AXIS)
+        err = errs[0]
+        for i in range(1, n_shards):
+            err = err | errs[i]
+        st = st.replace(
+            err=err,
+            n_events=n_ev0 + jax.lax.psum(st.n_events - n_ev0, HOST_AXIS))
+        if killed0 is not None:
+            st = st.replace(nm=st.nm.replace(
+                killed=killed0
+                + jax.lax.psum(st.nm.killed - killed0, HOST_AXIS)))
+        if tr0 is not None:
+            st = st.replace(tr=st.tr.replace(
+                pkts_exchanged=tr0.pkts_exchanged + jax.lax.psum(
+                    st.tr.pkts_exchanged - tr0.pkts_exchanged, HOST_AXIS),
+                occ_max=jax.lax.pmax(st.tr.occ_max, HOST_AXIS)))
+        return st.replace(hoff=None)
+
+    return jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(sspecs, pspecs, P()),
+        out_specs=sspecs, check_rep=False))
+
+
+def mesh_run_until(state, params, app, t_target, mesh=None):
+    """Run the engine to t_target with hosts sharded over `mesh`.
+
+    The world must DIVIDE the mesh (host count a multiple of the device
+    count; state and params agreeing on it) -- pad first with
+    parallel.pad_world_to_mesh(state, params, n_devices) if it doesn't.
+    Capture/log rings are single-device-only observability; run those
+    worlds through engine.run_until / sharded_run_until instead.
+
+    Returns the state fully finalized (global counters, hoff stripped),
+    so chunked runs are just repeated calls."""
+    if mesh is None:
+        mesh = make_mesh()
+    d = mesh.devices.size
+    if state.hoff is not None:
+        raise ValueError("mesh_run_until: state.hoff is set -- already "
+                         "inside a mesh shard?")
+    if state.cap is not None or state.log is not None:
+        raise ValueError(
+            "mesh_run_until does not support capture/log rings (their "
+            "append cursors are global); drop them or run single-device")
+    h = state.hosts.num_hosts
+    hp = params.host_vertex.shape[0]
+    if hp != h:
+        raise ValueError(
+            f"mesh_run_until: params built for {hp} hosts but state has "
+            f"{h}; pad them together with "
+            f"parallel.pad_world_to_mesh(state, params, {d})")
+    if h % d != 0:
+        raise ValueError(
+            f"mesh_run_until: {h} hosts do not divide {d} devices; pad "
+            f"the world first with "
+            f"parallel.pad_world_to_mesh(state, params, {d})")
+
+    sspecs = _state_specs(state)
+    pspecs = _param_specs(params)
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731
+    key = (app, mesh,
+           jax.tree_util.tree_structure((state, params)),
+           tuple(map(str, jax.tree_util.tree_leaves(sspecs,
+                                                    is_leaf=is_spec))),
+           tuple(map(str, jax.tree_util.tree_leaves(pspecs,
+                                                    is_leaf=is_spec))))
+    fn = _MESH_CACHE.get(key)
+    if fn is None:
+        fn = _build(app, mesh, sspecs, pspecs)
+        _MESH_CACHE[key] = fn
+    with mesh:
+        return fn(state, params, jnp.asarray(t_target, I64))
+
+
+def mesh_run_chunked(state, params, app, t_target: int, mesh=None,
+                     chunk_ns: int = engine.CHUNK_NS):
+    """Host-side loop of bounded mesh launches (engine.run_chunked's mesh
+    twin); chunking is trajectory-invariant -- see docs/parallel.md."""
+    if mesh is None:
+        mesh = make_mesh()
+    t = int(state.now)
+    t_target = int(t_target)
+    while t < t_target:
+        t = min(t + chunk_ns, t_target)
+        state = mesh_run_until(state, params, app, t, mesh=mesh)
+    return state

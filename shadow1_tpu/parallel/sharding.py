@@ -29,11 +29,14 @@ reduction (master.c:450-480) as one collective.
 from __future__ import annotations
 
 import functools
+import warnings
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import engine
+from ..core import state as state_mod
 
 HOST_AXIS = "hosts"
 
@@ -46,11 +49,160 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (HOST_AXIS,))
 
 
+def _concat_rows(a, b):
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.concatenate([x, y], axis=0), a, b)
+
+
+def pad_state_to_mesh(state, n_devices: int):
+    """Grow the world to the next multiple of `n_devices` hosts by
+    appending INERT hosts, world-consistently: fresh (empty) rows for the
+    host/socket tables, whole fresh per-host slabs for both packet pools
+    (so `capacity // num_hosts` is unchanged), zero rows for [H]-leading
+    app leaves, and an up/neutral overlay row for netem.  Padded hosts
+    never emit (no app state, sockets closed) and anything a global app
+    draw routes at them dies at the unbound-port drop, deterministically
+    -- but note the padded world is a DIFFERENT world: global-host-count-
+    keyed draws (e.g. phold's dst pick) see the padded count, so its
+    trajectory is not bitwise-comparable to the unpadded one.  It IS
+    bitwise identical across mesh shapes that divide it.  Identity when
+    the host count already divides."""
+    h = state.hosts.num_hosts
+    d = int(n_devices)
+    hp = -(-h // d) * d
+    if hp == h:
+        return state
+    if state.hoff is not None:
+        raise ValueError("pad_state_to_mesh: state is already inside a "
+                         "mesh shard (hoff set)")
+    pad = hp - h
+    ko = state.pool.capacity // h
+    ki = state.inbox.capacity // h
+    padded = ["hosts", "socks", "pool", "inbox"]
+
+    app = state.app
+    if app is not None:
+        # Apps whose zero row is NOT inert declare per-leaf fills via a
+        # class-level PAD_VALUES dict (e.g. tgen: cur=-1 "no program",
+        # t_next=INV "no tick due"); unlisted leaves pad with zeros.
+        fills = getattr(type(app), "PAD_VALUES", {})
+
+        def pad_app(path, leaf):
+            if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
+                    and leaf.shape[0] == h:
+                name = _leaf_name(path)
+                padded.append("app." + name)
+                fill = jnp.full((pad,) + leaf.shape[1:],
+                                fills.get(name, 0), leaf.dtype)
+                return jnp.concatenate([leaf, fill], axis=0)
+            return leaf
+        app = jax.tree_util.tree_map_with_path(pad_app, app)
+
+    nm = state.nm
+    if nm is not None:
+        from ..netem.state import SCALE_ONE
+        padded.append("nm")
+        nm = nm.replace(
+            host_up=jnp.concatenate(
+                [nm.host_up, jnp.ones((pad,), nm.host_up.dtype)]),
+            group=jnp.concatenate(
+                [nm.group, jnp.zeros((pad,), nm.group.dtype)]),
+            bw_x1000=jnp.concatenate(
+                [nm.bw_x1000,
+                 jnp.full((pad,), SCALE_ONE, nm.bw_x1000.dtype)]))
+
+    log_level = state.log_level
+    if log_level is not None:
+        padded.append("log_level")
+        log_level = jnp.concatenate(
+            [log_level, jnp.zeros((pad,), log_level.dtype)])
+
+    warnings.warn(
+        f"parallel: padded world from {h} to {hp} hosts (next multiple of "
+        f"{d} devices); padded leaves: {', '.join(padded)}")
+    return state.replace(
+        pool=_concat_rows(state.pool,
+                          state_mod.make_packet_pool(
+                              pad * ko, cols=state.pool.blk.shape[1])),
+        inbox=_concat_rows(state.inbox,
+                           state_mod.make_inbox(
+                               pad, ki, cols=state.inbox.blk.shape[1])),
+        socks=_concat_rows(state.socks,
+                           state_mod.make_socket_table(
+                               pad, state.socks.slots)),
+        hosts=_concat_rows(state.hosts, state_mod.make_host_table(pad)),
+        app=app, nm=nm, log_level=log_level)
+
+
+# Row fill for padded NetParams leaves.  bw gets a huge-but-finite rate
+# (a zero rate would divide-by-zero in nic.time_until if a stray packet
+# ever reaches a padded host); everything else is the neutral value.
+_PARAM_PAD_FILL = {
+    "host_vertex": 0,
+    "bw_up_Bps": 1 << 30,
+    "bw_down_Bps": 1 << 30,
+    "cpu_ns_per_event": 0,
+    "autotune_snd": 0,
+    "autotune_rcv": 0,
+    "iface_buf_pkts": 0,
+    "pcap_mask": 0,
+}
+
+
+def pad_params_to_mesh(params, n_devices: int):
+    """NetParams counterpart of pad_state_to_mesh: pad every [H]-leading
+    leaf with inert rows.  route_blk is NEVER padded -- its row count
+    encodes the vertex count (V*V for the narrow table), so extra rows
+    would corrupt routing; when its rows don't divide the mesh it
+    replicates instead (shard_params warns).  Identity when everything
+    already divides."""
+    d = int(n_devices)
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    hv = [leaf for path, leaf in flat if _leaf_name(path) == "host_vertex"]
+    if not hv:
+        return params
+    h = hv[0].shape[0]
+    hp = -(-h // d) * d
+    padded = []
+
+    def pad_leaf(path, leaf):
+        name = _leaf_name(path)
+        if name not in _PARAM_PAD_FILL or not hasattr(leaf, "ndim"):
+            return leaf
+        rows = hp - h
+        if rows == 0:
+            return leaf
+        padded.append(name)
+        fill = jnp.full((rows,) + leaf.shape[1:],
+                        _PARAM_PAD_FILL[name]).astype(leaf.dtype)
+        return jnp.concatenate([leaf, fill], axis=0)
+
+    out = jax.tree_util.tree_map_with_path(pad_leaf, params)
+    if padded:
+        warnings.warn(
+            f"parallel: padded NetParams leaves to a multiple of {d} "
+            f"devices: {', '.join(padded)}")
+    return out
+
+
+def pad_world_to_mesh(state, params, n_devices: int):
+    """Pad a (state, params) pair together -- they must agree on the host
+    count, so always pad them as a unit."""
+    return (pad_state_to_mesh(state, n_devices),
+            pad_params_to_mesh(params, n_devices))
+
+
 def shard_state(state, mesh: Mesh):
     """Place a SimState onto the mesh: every array's leading axis is hosts
     (tables) or pool (packets) and shards; scalars replicate.  Uniform by
     design -- SimState's layout invariant is exactly 'leading axis is the
-    parallel axis' (core/state.py)."""
+    parallel axis' (core/state.py).  Host/pool axes that don't divide the
+    mesh are PADDED up to a multiple first (pad_state_to_mesh, which
+    warns naming each padded leaf); only genuinely non-host axes (netem
+    schedules, app item tables) fall back to replication."""
+    state = pad_state_to_mesh(state, mesh.devices.size)
+    h = state.hosts.num_hosts
+    host_rows = {h, state.pool.capacity, state.inbox.capacity}
 
     def place(path, leaf):
         if leaf is None:
@@ -59,7 +211,11 @@ def shard_state(state, mesh: Mesh):
             else P(HOST_AXIS)
         if hasattr(leaf, "ndim") and leaf.ndim >= 1 and \
                 leaf.shape[0] % mesh.devices.size != 0:
-            spec = P()  # non-divisible axes replicate (tiny test shapes)
+            # Post-padding this can only be a non-host axis (netem event
+            # schedules, app item tables): replication is the intended
+            # layout, not a silent degradation of the host axis.
+            assert leaf.shape[0] not in host_rows
+            spec = P()
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(place, state)
@@ -99,8 +255,12 @@ def _leaf_name(path) -> str:
 
 
 def shard_params(params, mesh: Mesh):
-    """Place NetParams onto the mesh via the explicit PARAM_SPECS table."""
+    """Place NetParams onto the mesh via the explicit PARAM_SPECS table.
+    Non-divisible host axes are padded up front (pad_params_to_mesh, which
+    warns); a leaf that still can't shard falls back to replication with
+    a warning naming it, never silently."""
     n = mesh.devices.size
+    params = pad_params_to_mesh(params, n)
 
     def place(path, leaf):
         if leaf is None:
@@ -116,7 +276,11 @@ def shard_params(params, mesh: Mesh):
                 f"axis, P() to replicate)") from None
         if spec != P() and hasattr(leaf, "ndim") and (
                 leaf.ndim == 0 or leaf.shape[0] % n != 0):
-            spec = P()  # non-divisible axes replicate (tiny test shapes)
+            warnings.warn(
+                f"parallel: NetParams leaf {name!r} (shape "
+                f"{getattr(leaf, 'shape', ())}) cannot shard over "
+                f"{n} devices even after padding; replicating it")
+            spec = P()
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(place, params)

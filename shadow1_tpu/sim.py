@@ -190,14 +190,37 @@ def add_churn(state, params, rate_per_s: float,
     return netem.install(state, params, tl)
 
 
-def run(state, params, app, until=None, profiler=None):
+def run(state, params, app, until=None, profiler=None, devices=None):
     """Run to `until` (default: params.stop_time).
 
     With `profiler` (a trace.Profiler), the run is profiled: the
     profiler is installed, device counters ride the state, and the run
     executes through the chunked launcher so device spans are recorded.
+
+    With `devices=N` (N > 1) the run shards across the first N visible
+    devices (parallel.mesh_run_until, docs/parallel.md): the world is
+    padded to a multiple of N hosts if needed, and the trajectory is
+    bitwise-identical to a single-device run of the (padded) world.
+    Incompatible with `profiler` and with capture/log rings.
     """
     t = params.stop_time if until is None else until
+    if devices is not None and int(devices) > 1:
+        if profiler is not None:
+            raise ValueError("sim.run: profiler + devices is unsupported "
+                             "(the profiler's chunked launcher is "
+                             "single-device; see docs/parallel.md)")
+        import jax as _jax
+
+        from . import parallel
+        n = int(devices)
+        devs = _jax.devices()
+        if len(devs) < n:
+            raise ValueError(f"sim.run: devices={n} but only {len(devs)} "
+                             f"{_jax.default_backend()} device(s) visible")
+        mesh = parallel.make_mesh(devs[:n])
+        state, params = parallel.pad_world_to_mesh(state, params, n)
+        return parallel.mesh_run_chunked(state, params, app, int(t),
+                                         mesh=mesh)
     if profiler is None:
         return engine.run_until(state, params, app, t)
     from . import trace

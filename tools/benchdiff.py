@@ -106,13 +106,23 @@ def _kernel_world(d: dict):
     return (kc.get("backend"), tuple(sorted(kc["world"].items())))
 
 
+def _n_devices(d: dict) -> int:
+    """Device count of the recorded run.  Files written before bench.py
+    stamped env.n_devices were all single-device measurements, so a
+    missing field normalizes to 1 (keeping legacy BENCH_r{N} baselines
+    gateable against today's default single-device runs)."""
+    env = d.get("env")
+    n = env.get("n_devices") if isinstance(env, dict) else None
+    return 1 if n is None else int(n)
+
+
 def _env(d: dict):
-    """The recorded execution environment (backend, cpu_count), or None
-    for files written before bench.py stamped one."""
+    """The recorded execution environment (backend, cpu_count,
+    n_devices), or None for files written before bench.py stamped one."""
     env = d.get("env")
     if not isinstance(env, dict):
         return None
-    return (env.get("backend"), env.get("cpu_count"))
+    return (env.get("backend"), env.get("cpu_count"), _n_devices(d))
 
 
 def _direction(name: str):
@@ -201,6 +211,16 @@ def main(argv=None) -> int:
                   f"different worlds (old={wo!r}, new={wn!r})",
                   file=sys.stderr)
             return 2
+    do, dn = _n_devices(old), _n_devices(new)
+    if do != dn:
+        # Throughput buckets by mesh size: ev/s at 8 devices vs 1 device
+        # measures scaling, not regression -- like the netem refusal,
+        # a cross-bucket compare is an error, not a gate.
+        print(f"benchdiff: refusing to compare runs across device "
+              f"counts (old n_devices={do}, new n_devices={dn}); "
+              f"events_per_sec gates within the same --devices bucket",
+              file=sys.stderr)
+        return 2
     eo, en = _env(old), _env(new)
     # Both-absent compares (hand-written JSONs, pre-env recordings on
     # one machine) keep the legacy full gate; a one-sided or mismatched

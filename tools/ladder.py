@@ -10,6 +10,9 @@ excluded; steady-state wall time per simulated second reported):
   rung 5: 10k-host onion circuits         (sim.build_onion(2000))
   rung 6: 500-node Bitcoin gossip flood   (sim.build_gossip(500))
   rung 7: phold under netem chaos churn   (sim.add_churn, docs/netem.md)
+  rung 8: phold on an 8-device mesh       (parallel.mesh_run_until on 8
+          virtual CPU devices; FAILS on any bitwise trajectory
+          divergence from single-device -- docs/parallel.md)
 
     python tools/ladder.py [rung ...]     # default: 1 2 3 5 6
 """
@@ -151,8 +154,22 @@ def rung_gossip():
     return res
 
 
+def rung_multichip(n_devices: int = 8):
+    # The sharded-execution rung: real mesh_run_until on a virtual CPU
+    # mesh (self-provisioned child interpreter; __graft_entry__), which
+    # ASSERTS bitwise equality with single-device execution at two
+    # horizons before reporting its rate -- a divergence fails the rung.
+    import pathlib
+    import sys as _sys
+    root = pathlib.Path(__file__).resolve().parent.parent
+    if str(root) not in _sys.path:
+        _sys.path.insert(0, str(root))
+    import __graft_entry__ as graft
+    return graft.dryrun_multichip(n_devices)
+
+
 def main(rungs):
-    unknown = set(rungs) - {"1", "2", "3", "4", "5", "6", "7"}
+    unknown = set(rungs) - {"1", "2", "3", "4", "5", "6", "7", "8"}
     if unknown:
         raise SystemExit(f"unknown ladder rungs: {sorted(unknown)}")
     results = {"backend": jax.default_backend()}
@@ -185,6 +202,8 @@ def main(rungs):
         record("gossip_500", rung_gossip)
     if "7" in rungs:
         record("phold_16k_churn", rung_phold_churn)
+    if "8" in rungs:
+        record("phold_multichip", rung_multichip)
     print(json.dumps(results))
 
 
