@@ -267,6 +267,11 @@ def _mesh_child(n_devices: int) -> int:
         pool_capacity=MESH_HOSTS * 8,
         rx_batch=2,
     )
+    # Flight recorder: per-window exchange matrices ride the rung so the
+    # scaling record shows how much traffic actually crossed shards at
+    # each device count (the recorder is replicated; its cost is the
+    # same at every rung, so ev/s stays comparable within the record).
+    state = trace.ensure_flight_recorder(state, shards=n_devices)
     warm = parallel.mesh_run_until(
         state, params, app, 10 * simtime.SIMTIME_ONE_MILLISECOND,
         mesh=mesh)
@@ -284,12 +289,33 @@ def _mesh_child(n_devices: int) -> int:
     wall, out, _ = best
     events = int(out.app.recv.sum() - warm.app.recv.sum()) \
         + int(out.app.sent.sum() - warm.app.sent.sum())
+    # Exchange totals for the measured pass (the sim is deterministic,
+    # so both passes move the same packets) plus the all-to-all share of
+    # wall time: exchange_probe_ms times one exchange in isolation (the
+    # send buffer is fixed-size, so an idle probe is representative) and
+    # the share scales it by the measured window count.
+    wins = int(out.n_windows) - int(warm.n_windows)
+    movers = int(out.fr.ex_cnt_sum.sum()) - int(warm.fr.ex_cnt_sum.sum())
+    xbytes = int(out.fr.ex_bytes_sum.sum()) \
+        - int(warm.fr.ex_bytes_sum.sum())
+    probe_ms = parallel.exchange_probe_ms(out, params, mesh)
+    share = round(min(1.0, probe_ms / 1000.0 * wins / wall), 4) \
+        if wall > 0 else None
     print(json.dumps({
         "devices": n_devices,
         "events_per_sec": round(events / wall, 2),
         "events": events,
         "wall_sec": round(wall, 3),
         "err": int(out.err),
+        "flight": {"capacity": int(out.fr.capacity),
+                   "shards": int(out.fr.n_shards)},
+        "exchange": {
+            "movers": movers,
+            "bytes": xbytes,
+            "windows": wins,
+            "alltoall_ms": round(probe_ms, 4),
+            "alltoall_share": share,
+        },
     }))
     return 0
 
@@ -347,12 +373,20 @@ def main_multichip(n_devices: int, gate_against: str | None = None) -> int:
             "rx_batch": 2,
             "engine": "mesh_run_until",
             "netem": None,
+            # Recorder shape: benchdiff refuses to compare a run whose
+            # flight config differs (recorder on/off changes the traced
+            # graph), mirroring the netem refusal.
+            "flight": top.get("flight"),
         },
         "env": {
             "backend": top["backend"],
             "cpu_count": os.cpu_count(),
             "n_devices": n_devices,
         },
+        # profile.flight.* is machine-bound in benchdiff (probe times
+        # depend on the backend); the per-rung blocks live in
+        # multichip.scaling[].exchange.
+        "profile": {"flight": top.get("exchange")},
         "multichip": {"scaling": rungs},
     }
     print(json.dumps(result))

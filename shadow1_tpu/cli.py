@@ -99,6 +99,11 @@ def _parser():
                         "p50/p95 wall times, transfer bytes, JIT compile "
                         "count) to the data directory and print a phase "
                         "summary table (see docs/observability.md)")
+    r.add_argument("--progress", action="store_true",
+                   help="print a one-line live status to stderr every few "
+                        "seconds of wall time: sim time covered, event "
+                        "rate, window rate, ETA -- for long runs that "
+                        "would otherwise be silent")
     r.add_argument("--quiet", action="store_true")
     r.add_argument("--devices", type=int, default=1, metavar="N",
                    help="shard the run across N devices "
@@ -106,10 +111,10 @@ def _parser():
                         "shard_map with a dst-bucketed all-to-all exchange; "
                         "bitwise-identical to single-device, see "
                         "docs/parallel.md).  Worlds whose host count does "
-                        "not divide N are padded with inert hosts.  "
-                        "Incompatible with the single-device observability "
-                        "rings (--pcap, --log-level, --profile) and with "
-                        "real-process plugins")
+                        "not divide N are padded with inert hosts.  The "
+                        "observability stack (--pcap, --log-level, "
+                        "--profile, heartbeats) runs sharded; only "
+                        "real-process plugins remain single-device")
     return p
 
 
@@ -170,6 +175,10 @@ def run_config(args) -> int:
         if not args.quiet:
             print(f"[shadow1-tpu] netem: {tl.describe()}", file=sys.stderr)
 
+    # Observability rings are built in the mesh layout when the run will
+    # shard (per-shard segments + cursors; docs/observability.md).
+    n_dev = max(1, args.devices)
+
     want_pcap = args.pcap or (asm.pcap_mask is not None
                               and asm.pcap_mask.any())
     if want_pcap:
@@ -178,7 +187,8 @@ def run_config(args) -> int:
                   file=sys.stderr)
             return 2
         from .core.state import make_capture_ring
-        state = state.replace(cap=make_capture_ring(args.pcap_ring))
+        state = state.replace(cap=make_capture_ring(args.pcap_ring,
+                                                    shards=n_dev))
         if args.pcap:
             # An explicit global capture must not be filtered down by
             # per-host logpcap masks.
@@ -215,7 +225,7 @@ def run_config(args) -> int:
             # interval.  Auto-grow.
             ring = (1 << 20) if max(host_lvls) >= 2 else (1 << 16)
         state = state.replace(
-            log=make_log_ring(ring),
+            log=make_log_ring(ring, shards=n_dev),
             log_level=jnp_.asarray(host_lvls, jnp_.int32))
         drain = LogDrain(
             __import__("os").path.join(args.data_directory, "shadow.log"),
@@ -263,23 +273,17 @@ def run_config(args) -> int:
     mesh = None
     parallel_mod = None
     if args.devices > 1:
-        # The mesh path runs the window loop under shard_map; the
-        # capture/log rings and the substrate bridge are single-device
-        # mechanisms (global append cursors, per-host RPC), so refuse the
-        # combination instead of silently de-interleaving.
-        incompat = []
-        if want_pcap:
-            incompat.append("--pcap / <host logpcap>")
-        if drain is not None:
-            incompat.append("--log-level / <host loglevel>")
-        if profiler is not None:
-            incompat.append("--profile")
+        # The observability stack runs sharded (rings built with
+        # shards=N above, counters finalized across shards); only the
+        # substrate bridge remains single-device (per-host syscall RPC
+        # serialized through one device).
         if substrate is not None:
-            incompat.append("real-process plugins")
-        if incompat:
-            print(f"error: --devices is incompatible with "
-                  f"{', '.join(incompat)} (single-device only; see "
-                  f"docs/parallel.md)", file=sys.stderr)
+            print("error: --devices is incompatible with real-process "
+                  "plugins (<plugin> with a real executable): the "
+                  "substrate bridge drives one device.  That is the only "
+                  "remaining refusal -- --pcap, --log-level, --profile, "
+                  "--progress and heartbeats all run sharded (see "
+                  "docs/parallel.md)", file=sys.stderr)
             return 2
         from . import parallel as parallel_mod
         devs = jax.devices()
@@ -295,6 +299,21 @@ def run_config(args) -> int:
             print(f"[shadow1-tpu] mesh: {args.devices} devices, "
                   f"{int(state.hosts.num_hosts) // args.devices} hosts "
                   f"per shard", file=sys.stderr)
+
+    flight = None
+    if profiler is not None:
+        # Per-window flight recorder (installed AFTER mesh padding so the
+        # shard matrices match the padded host count); drained at the
+        # same chunk boundaries as the counters -- no extra syncs.
+        state = trace.ensure_flight_recorder(state, shards=n_dev)
+        flight = trace.FlightDrain(
+            __import__("os").path.join(args.data_directory,
+                                       "windows.jsonl"))
+
+    progress = None
+    if args.progress:
+        from .observe import Progress
+        progress = Progress(int(stop))
 
     t = int(state.now)
     hb_next = 0
@@ -318,6 +337,12 @@ def run_config(args) -> int:
             drain.drain(state)
         if profiler is not None:
             trace.fetch_counters(state, profiler)
+        if flight is not None:
+            flight.drain(state, profiler)
+        if progress is not None:
+            progress.update(state, t)
+    if progress is not None:
+        progress.update(state, t, force=True)
     jax.block_until_ready(state)
     wall = time.perf_counter() - t_wall
 
@@ -386,6 +411,11 @@ def run_config(args) -> int:
     if profiler is not None:
         import os as _os2
         trace.fetch_counters(state, profiler)
+        if flight is not None:
+            flight.drain(state, profiler)
+            flight.close()
+            profiler.set_flight(
+                flight.rows, flight.summary(state, n_devices=n_dev))
         trace_path = _os2.path.join(args.data_directory, "trace.json")
         metrics_path = _os2.path.join(args.data_directory, "metrics.json")
         profiler.write_trace(trace_path)
@@ -393,6 +423,8 @@ def run_config(args) -> int:
             metrics_path, extra={"simulated_seconds": t / SEC})
         summary["profile"] = {"trace": trace_path, "metrics": metrics_path,
                               "compile_count": m["compile"]["count"]}
+        if flight is not None:
+            summary["profile"]["windows"] = flight.path
         if not args.quiet:
             print(profiler.summary_table(), file=sys.stderr)
         trace.install(None)

@@ -175,10 +175,17 @@ def _cap_append(state: SimState, mask, *, time_v, src, dst, sport, dport,
     then advance by what was *written*, not staged, or the writer would
     treat never-written slots as valid records."""
     cap = state.cap
-    c = cap.capacity
+    c = cap.capacity        # local segment size under a mesh shard
+    if cap.total.ndim == 1 and cap.total.shape[0] != 1:
+        raise ValueError(
+            "sharded capture ring outside a mesh: a ring built with "
+            "make_capture_ring(shards=N) only runs under "
+            "parallel.mesh_run_until (each shard needs its own cursor "
+            "slice); build it with shards=1 for single-device runs")
+    tot0 = cap.total.reshape(())   # scalar, or this shard's [1] cursor
     crank = jnp.cumsum(mask) - 1
     n_new = jnp.minimum(jnp.sum(mask).astype(I64), c)
-    pos = ((cap.total + crank) % c).astype(I32)
+    pos = ((tot0 + crank) % c).astype(I32)
     idx = jnp.where(mask & (crank < c), pos, c)  # c = dropped write
 
     def cw(a, val, dtype=None):
@@ -207,18 +214,31 @@ def _log_append(state: SimState, mask, code: int, level: int, time_v,
                 host_v, arg_v):
     """Append one event per set mask element into the log ring (traced
     away entirely when logging is off).  `mask`/`time_v`/`host_v`/`arg_v`
-    are flat arrays of equal length; per-host level gating applies."""
+    are flat arrays of equal length; per-host level gating applies.
+
+    `host_v` carries GLOBAL host ids (identical to local rows off-mesh):
+    the ring records global ids for the drain, while the level lookup
+    shifts them back to this shard's local log_level rows."""
     if state.log is None:
         return state
     lg = state.log
-    c = lg.capacity
-    lvl_ok = state.log_level[jnp.clip(host_v, 0,
+    c = lg.capacity         # local segment size under a mesh shard
+    if lg.total.ndim == 1 and lg.total.shape[0] != 1:
+        raise ValueError(
+            "sharded log ring outside a mesh: a ring built with "
+            "make_log_ring(shards=N) only runs under "
+            "parallel.mesh_run_until (each shard needs its own cursor "
+            "slice); build it with shards=1 for single-device runs")
+    tot0 = lg.total.reshape(())    # scalar, or this shard's [1] cursor
+    loc = host_v if state.hoff is None \
+        else host_v - state.hoff.astype(host_v.dtype)
+    lvl_ok = state.log_level[jnp.clip(loc, 0,
                                       state.log_level.shape[0] - 1)] >= level
     m = mask & lvl_ok
     rank = jnp.cumsum(m) - 1
     n_tot = jnp.sum(m).astype(I64)
     n_new = jnp.minimum(n_tot, c)
-    pos = ((lg.total + rank) % c).astype(I32)
+    pos = ((tot0 + rank) % c).astype(I32)
     idx = jnp.where(m & (rank < c), pos, c)
     return state.replace(log=lg.replace(
         time=lg.time.at[idx].set(time_v, mode="drop"),
@@ -433,6 +453,25 @@ def _exchange_body(state: SimState, params) -> SimState:
             + jnp.sum(fit.astype(I64)),
             occ_max=jnp.maximum(state.tr.occ_max, occ.astype(I32))))
 
+    # Flight recorder (state.FlightRecorder): this window's src->dst
+    # LOGICAL-SHARD traffic matrix, counted over offered movers.  The
+    # shard of a host is id // (h // D), matching the mesh partition, so
+    # a single-device run of a D-sharded world writes bitwise the same
+    # matrix the mesh exchange derives from its all_to_all ranking.
+    # Pool rows are src-major (slab per source host), so a row's source
+    # shard is just row // (p0 // D).
+    if state.fr is not None:
+        dm = state.fr.n_shards
+        src_sh = jnp.arange(p0, dtype=I32) // (p0 // dm)
+        dst_sh = (dst // (h // dm)).astype(I32)
+        ones_m = jnp.where(moving, 1, 0).astype(I32)
+        byt_m = jnp.where(moving, pool.blk[:, ICOL_LEN], 0).astype(I64)
+        state = state.replace(fr=state.fr.replace(
+            cur_ex_cnt=jnp.zeros((dm, dm), I32).at[src_sh, dst_sh]
+            .add(ones_m),
+            cur_ex_bytes=jnp.zeros((dm, dm), I64).at[src_sh, dst_sh]
+            .add(byt_m)))
+
     # Movers leave the outbox whether they fit or overflowed.  Shed pure
     # ACKs are accounted as thinning; DATA/control overflow is a counted
     # drop and raises the capacity escape-hatch flag.
@@ -506,7 +545,19 @@ def _exchange_body_mesh(state: SimState, params) -> SimState:
     pad = npad - p0
     devp = jnp.pad(dev, (0, pad))
     mvp = jnp.pad(moving, (0, pad))
-    brank, _ = _rank_by_dst(mvp, devp, d, m)
+    brank, bt = _rank_by_dst(mvp, devp, d, m)
+
+    # Flight recorder: `bt` is this shard's movers per destination shard
+    # -- exactly one row of the src->dst traffic matrix.  all_gather
+    # stacks the rows src-major, leaving the full [D, D] matrix
+    # replicated on every shard (the recorder block stays replicated).
+    if state.fr is not None:
+        lenp = jnp.pad(pool.blk[:, ICOL_LEN], (0, pad))
+        bby = jnp.zeros((d,), I64).at[devp].add(
+            jnp.where(mvp, lenp, 0).astype(I64))
+        state = state.replace(fr=state.fr.replace(
+            cur_ex_cnt=jax.lax.all_gather(bt, MESH_AXIS).astype(I32),
+            cur_ex_bytes=jax.lax.all_gather(bby, MESH_AXIS)))
 
     # Spliced rows exactly as the single-device exchange forwards them
     # (TIME columns refreshed from the authoritative `time` array).
@@ -606,7 +657,19 @@ def _exchange_body_mesh(state: SimState, params) -> SimState:
     # shards before returning (nothing inside the run branches on it).
     err = state.err | jnp.where(jnp.any(data_drops > 0), ERR_POOL_OVERFLOW,
                                 0).astype(state.err.dtype)
-    return state.replace(pool=pool, inbox=ib, hosts=hosts, err=err)
+    state = state.replace(pool=pool, inbox=ib, hosts=hosts, err=err)
+    if state.log is not None:
+        # Mesh parity with the single-device tail: records carry GLOBAL
+        # host ids (the drain maps them to names) and land in this
+        # shard's log segment.
+        from .state import LOG_ACK_THIN
+        rows_g = host_ids(state, I32)
+        now_v = jnp.broadcast_to(state.now, (h,))
+        state = _log_append(state, data_drops > 0, LOG_DROP_POOL,
+                            LOG_WARNING, now_v, rows_g, data_drops)
+        state = _log_append(state, acks_shed > 0, LOG_ACK_THIN,
+                            LOG_WARNING, now_v, rows_g, acks_shed)
+    return state
 
 
 def _exchange(state: SimState, params) -> SimState:
@@ -621,6 +684,74 @@ def _exchange(state: SimState, params) -> SimState:
                             lambda s: s, state)
     return jax.lax.cond(moving, lambda s: _exchange_body(s, params),
                         lambda s: s, state)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: per-window row write (state.FlightRecorder)
+# ---------------------------------------------------------------------------
+
+
+def _fr_snapshot(state: SimState):
+    """Window-open bookkeeping for the flight recorder: zero the exchange
+    scratch matrix (a skipped exchange must record zero traffic, and the
+    cond may bypass the body entirely) and capture the counters whose
+    per-window deltas become the row.  Traced away when no recorder is
+    installed."""
+    fr = state.fr
+    state = state.replace(fr=fr.replace(
+        cur_ex_cnt=jnp.zeros_like(fr.cur_ex_cnt),
+        cur_ex_bytes=jnp.zeros_like(fr.cur_ex_bytes)))
+    snap = (state.n_events,
+            state.n_steps,
+            jnp.sum(state.hosts.pkts_recv.astype(I64)),
+            jnp.sum(state.hosts.pkts_dropped_inet.astype(I64))
+            + jnp.sum(state.hosts.pkts_dropped_router.astype(I64))
+            + jnp.sum(state.hosts.pkts_dropped_pool.astype(I64)),
+            jnp.asarray(0, I64) if state.nm is None
+            else state.nm.killed.astype(I64))
+    return state, snap
+
+
+def _fr_record(state: SimState, snap, ws, we) -> SimState:
+    """Append one row for the window that just closed: the exchange that
+    opened it (scratch matrix) plus the micro-step activity inside it
+    (counter deltas vs the _fr_snapshot).  Under a mesh the shard-local
+    deltas psum to globals, so the replicated recorder block stays
+    bitwise identical on every shard -- and identical to a single-device
+    run of the same world with the same chunking."""
+    fr = state.fr
+    mesh = _on_mesh(state)
+    ev0, steps0, recv0, drop0, kill0 = snap
+    d_ev = state.n_events - ev0
+    d_recv = jnp.sum(state.hosts.pkts_recv.astype(I64)) - recv0
+    d_drop = (jnp.sum(state.hosts.pkts_dropped_inet.astype(I64))
+              + jnp.sum(state.hosts.pkts_dropped_router.astype(I64))
+              + jnp.sum(state.hosts.pkts_dropped_pool.astype(I64))) - drop0
+    d_kill = (jnp.asarray(0, I64) if state.nm is None
+              else state.nm.killed.astype(I64) - kill0)
+    if mesh:
+        # n_steps is uniform across shards (uniform loop predicates);
+        # these four are shard-local partials inside the window loop.
+        d_ev = jax.lax.psum(d_ev, MESH_AXIS)
+        d_recv = jax.lax.psum(d_recv, MESH_AXIS)
+        d_drop = jax.lax.psum(d_drop, MESH_AXIS)
+        if state.nm is not None:
+            d_kill = jax.lax.psum(d_kill, MESH_AXIS)
+    idx = (fr.total % fr.capacity).astype(I32)
+    return state.replace(fr=fr.replace(
+        win_start=fr.win_start.at[idx].set(ws),
+        win_end=fr.win_end.at[idx].set(we),
+        steps=fr.steps.at[idx].set((state.n_steps - steps0).astype(I32)),
+        events=fr.events.at[idx].set(d_ev.astype(I64)),
+        routed=fr.routed.at[idx].set(jnp.sum(fr.cur_ex_cnt.astype(I64))),
+        delivered=fr.delivered.at[idx].set(d_recv),
+        dropped=fr.dropped.at[idx].set(d_drop),
+        killed=fr.killed.at[idx].set(d_kill),
+        ex_cnt=fr.ex_cnt.at[idx].set(fr.cur_ex_cnt),
+        ex_bytes=fr.ex_bytes.at[idx].set(fr.cur_ex_bytes),
+        ex_cnt_sum=fr.ex_cnt_sum + fr.cur_ex_cnt.astype(I64),
+        ex_bytes_sum=fr.ex_bytes_sum + fr.cur_ex_bytes,
+        total=fr.total + 1))
 
 
 # ---------------------------------------------------------------------------
@@ -701,8 +832,9 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
     # an interface buffer.
     if state.cap is not None and params.has_iface_buf:
         from .state import CAP_RDROP
+        # Capture records carry GLOBAL host ids (identity off-mesh).
         rows_b = jnp.broadcast_to(
-            jnp.arange(h, dtype=I32)[:, None], (h, ki))
+            host_ids(state, I32)[:, None], (h, ki))
         td_mask = (tail_drop & params.pcap_mask[:, None]).reshape(-1)
         blk = ib.blk
         state = _cap_append(
@@ -876,10 +1008,12 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
             hosts=hosts)
         ib = state.inbox
 
-        # Event log (traced away when disabled).
+        # Event log (traced away when disabled).  Records carry GLOBAL
+        # host ids (rows_g == rows off-mesh).
         if state.log is not None:
             if r == 0:
-                rows2 = jnp.broadcast_to(rows[:, None], (h, ki)).reshape(-1)
+                rows2 = jnp.broadcast_to(rows_g[:, None],
+                                         (h, ki)).reshape(-1)
                 src_col = state.inbox.blk[:, ICOL_SRC]
                 t_flat = jnp.broadcast_to(tick_t[:, None],
                                           (h, ki)).reshape(-1)
@@ -887,12 +1021,12 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
                                     LOG_DROP_TAIL, LOG_WARNING, t_flat,
                                     rows2, src_col)
             state = _log_append(state, drop, LOG_DROP_ROUTER, LOG_WARNING,
-                                t_eff, rows, pkt.src)
+                                t_eff, rows_g, pkt.src)
             if nm_kill is not None:
                 state = _log_append(state, nm_kill, LOG_NETEM_DOWN,
-                                    LOG_WARNING, t_eff, rows, pkt.src)
+                                    LOG_WARNING, t_eff, rows_g, pkt.src)
             state = _log_append(state, deliver, LOG_DELIVER, LOG_DEBUG,
-                                t_eff, rows, pkt.src)
+                                t_eff, rows_g, pkt.src)
 
         # Receive-direction capture (reference captures both directions
         # per interface, network_interface.c:337-373,415-418): delivered
@@ -902,7 +1036,7 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
             from .state import CAP_DELIVER, CAP_RDROP
             rec_rx = (deliver | drop) & params.pcap_mask
             state = _cap_append(
-                state, rec_rx, time_v=t_eff, src=pkt.src, dst=rows,
+                state, rec_rx, time_v=t_eff, src=pkt.src, dst=rows_g,
                 sport=pkt.sport, dport=pkt.dport, proto=pkt.proto,
                 flags=pkt.flags, length=pkt.length, seq=pkt.seq,
                 ack=pkt.ack,
@@ -1567,6 +1701,8 @@ def run_until_impl(state: SimState, params, app, t_target):
 
     def window_body(carry):
         st, _, _, _ = carry
+        if st.fr is not None:
+            st, fr_snap = _fr_snapshot(st)
         # Boundary exchange first: everything in flight becomes visible
         # in the destination slabs before the window's scan.
         st = _exchange(st, params)
@@ -1597,6 +1733,8 @@ def run_until_impl(state: SimState, params, app, t_target):
 
         st, t_h, gmin = jax.lax.while_loop(icond, ibody, (st, t_h, gmin))
         st = st.replace(now=we, n_windows=st.n_windows + 1)
+        if st.fr is not None:
+            st = _fr_record(st, fr_snap, ws, we)
         return st, t_h, gmin, outbox_pending(st)
 
     t_h0, gmin0 = scan(state)

@@ -671,7 +671,17 @@ CAP_DELIVER = 1
 CAP_RDROP = 2
 
 
-def make_capture_ring(capacity: int = 1 << 16) -> CaptureRing:
+def make_capture_ring(capacity: int = 1 << 16,
+                      shards: int = 1) -> CaptureRing:
+    """shards > 1 builds the MESH layout (parallel/mesh.py): the slot
+    arrays grow to a multiple of `shards` and partition into per-shard
+    segments, and `total` becomes a [shards] cursor vector so every
+    shard appends into its own segment with its own cursor.  The drain
+    side (observe.write_pcap) merges segments in time order.  shards=1
+    keeps the original single-cursor layout byte-for-byte."""
+    capacity = -(-capacity // shards) * shards
+    total = jnp.asarray(0, I64) if shards == 1 \
+        else _zeros((shards,), I64)
     return CaptureRing(
         time=_zeros((capacity,), I64),
         src=_zeros((capacity,), I32),
@@ -684,7 +694,7 @@ def make_capture_ring(capacity: int = 1 << 16) -> CaptureRing:
         seq=_zeros((capacity,), U32),
         ack=_zeros((capacity,), U32),
         kind=_zeros((capacity,), I32),
-        total=jnp.asarray(0, I64),
+        total=total,
     )
 
 
@@ -730,14 +740,100 @@ class LogRing:
         return self.time.shape[0]
 
 
-def make_log_ring(capacity: int = 1 << 16) -> LogRing:
+def make_log_ring(capacity: int = 1 << 16, shards: int = 1) -> LogRing:
+    """shards > 1 builds the MESH layout (parallel/mesh.py): slot arrays
+    grow to a multiple of `shards` and partition into per-shard segments,
+    and `total`/`lost` become [shards] vectors so each shard appends into
+    its own segment with its own cursor.  observe.LogDrain merges the
+    segments in sim-time order.  shards=1 keeps the original
+    single-cursor layout byte-for-byte."""
+    capacity = -(-capacity // shards) * shards
+    if shards == 1:
+        total = jnp.asarray(0, I64)
+        lost = jnp.asarray(0, I64)
+    else:
+        total = _zeros((shards,), I64)
+        lost = _zeros((shards,), I64)
     return LogRing(
         time=_zeros((capacity,), I64),
         host=_zeros((capacity,), I32),
         code=_zeros((capacity,), I32),
         arg=_zeros((capacity,), I32),
+        total=total,
+        lost=lost,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder (per-window run telemetry; trace.py drains it)
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class FlightRecorder:
+    """Fixed-capacity device-side ring recording ONE ROW PER WINDOW --
+    the run's black box.  Present in SimState only when installed
+    (trace.ensure_flight_recorder), so recorder-less runs trace
+    byte-identical graphs, like cap/log/tr/nm.
+
+    A row covers the boundary exchange that OPENED window w plus the
+    micro-steps run DURING w.  The ring is written entirely inside the
+    compiled window loop and drained at chunk boundaries together with
+    the trace counters, so recording adds zero extra host syncs.
+
+    `ex_cnt`/`ex_bytes` are [C, D, D] src->dst LOGICAL-SHARD traffic
+    matrices, D = `n_shards` chosen at install time.  On a D-device mesh
+    a cell is the packets one shard sent another in that window's
+    exchange (derived from the all_to_all send ranking); off-mesh the
+    same matrix is computed from host ids, so a single-device run of a
+    D-sharded world produces bitwise the same matrices as the mesh run.
+    The cur_* scratch holds the current window's matrix between the
+    exchange and the row write; the *_sum accumulators are lifetime
+    totals that survive ring wrap (bench reads those)."""
+
+    win_start: jnp.ndarray  # [C] i64 window start (ws)
+    win_end: jnp.ndarray    # [C] i64 window end (we)
+    steps: jnp.ndarray      # [C] i32 micro-steps run in the window
+    events: jnp.ndarray     # [C] i64 events drained (deliveries+emissions)
+    routed: jnp.ndarray     # [C] i64 packets moved by the opening exchange
+    delivered: jnp.ndarray  # [C] i64 packets delivered to sockets
+    dropped: jnp.ndarray    # [C] i64 inet+router+pool drops
+    killed: jnp.ndarray     # [C] i64 netem delivery kills (0 w/o netem)
+    ex_cnt: jnp.ndarray     # [C, D, D] i32 exchange movers per src->dst shard
+    ex_bytes: jnp.ndarray   # [C, D, D] i64 exchange payload bytes per pair
+    cur_ex_cnt: jnp.ndarray    # [D, D] i32 scratch: this window's matrix
+    cur_ex_bytes: jnp.ndarray  # [D, D] i64 scratch
+    ex_cnt_sum: jnp.ndarray    # [D, D] i64 lifetime movers (wrap-proof)
+    ex_bytes_sum: jnp.ndarray  # [D, D] i64 lifetime bytes
+    total: jnp.ndarray      # i64 scalar: lifetime rows written
+
+    @property
+    def capacity(self) -> int:
+        return self.win_start.shape[0]
+
+    @property
+    def n_shards(self) -> int:
+        return self.cur_ex_cnt.shape[0]
+
+
+def make_flight_recorder(capacity: int = 4096,
+                         shards: int = 1) -> FlightRecorder:
+    return FlightRecorder(
+        win_start=_zeros((capacity,), I64),
+        win_end=_zeros((capacity,), I64),
+        steps=_zeros((capacity,), I32),
+        events=_zeros((capacity,), I64),
+        routed=_zeros((capacity,), I64),
+        delivered=_zeros((capacity,), I64),
+        dropped=_zeros((capacity,), I64),
+        killed=_zeros((capacity,), I64),
+        ex_cnt=_zeros((capacity, shards, shards), I32),
+        ex_bytes=_zeros((capacity, shards, shards), I64),
+        cur_ex_cnt=_zeros((shards, shards), I32),
+        cur_ex_bytes=_zeros((shards, shards), I64),
+        ex_cnt_sum=_zeros((shards, shards), I64),
+        ex_bytes_sum=_zeros((shards, shards), I64),
         total=jnp.asarray(0, I64),
-        lost=jnp.asarray(0, I64),
     )
 
 
@@ -799,6 +895,11 @@ class SimState:
     # Per-host log level mask (LOG_*), only consulted when log is set.
     log_level: any = struct.field(pytree_node=True, default=None)  # [H] i32
     tr: any = struct.field(pytree_node=True, default=None)  # TraceCounters | None
+    # Per-window flight recorder (trace.ensure_flight_recorder): present
+    # only when installed, so recorder-less runs trace byte-identical
+    # graphs.  Replicated (never sharded) under a mesh -- every shard
+    # computes identical rows from psum/all_gather-reduced inputs.
+    fr: any = struct.field(pytree_node=True, default=None)  # FlightRecorder | None
     # Network dynamics / fault injection (netem/state.py): present only
     # when a fault schedule is installed, so static worlds compile the
     # whole overlay away.

@@ -156,12 +156,25 @@ def write_pcap(path: str, cap, ip_of_host=None, host_filter=None):
             return (10 << 24) | (int(i) & 0xFFFFFF)
 
     t = np.asarray(cap.time)
-    total = int(cap.total)
+    # A sharded ring (make_capture_ring shards=N, mesh runs) has a [N]
+    # cursor vector and per-shard segments; a single-device ring is the
+    # N=1 degenerate case with a scalar cursor.
+    tot_a = np.atleast_1d(np.asarray(cap.total))
+    shards = tot_a.shape[0]
     c = t.shape[0]
-    n = min(total, c)
-    # Oldest-first order; ring wraps at `total % c`.
-    start = total % c if total > c else 0
-    order = (np.arange(n) + start) % c
+    per = c // shards
+    segs = []
+    for s in range(shards):
+        total = int(tot_a[s])
+        n = min(total, per)
+        # Oldest-first order within the segment; wraps at `total % per`.
+        start = total % per if total > per else 0
+        segs.append(s * per + (np.arange(n) + start) % per)
+    order = np.concatenate(segs)
+    if shards > 1:
+        # Merge shard segments into global time order (stable, so the
+        # shard-major walk breaks ties deterministically).
+        order = order[np.argsort(t[order], kind="stable")]
 
     src = np.asarray(cap.src)
     dst = np.asarray(cap.dst)
@@ -228,12 +241,17 @@ class LogDrain:
     The two-tier ShadowLogger analog (core/logger/shadow_logger.c:25-58):
     the device ring buffers records, the host merges and writes them
     between chunks.  Overflow (more records than ring capacity between
-    drains) is reported, not silently lost."""
+    drains) is reported, not silently lost.
+
+    Sharded rings (make_log_ring shards=N, mesh runs) drain per shard
+    segment and merge into global sim-time order; record host ids are
+    global on every layout, so the hostname mapping is unchanged."""
 
     def __init__(self, path, hostnames):
         self.path = path
         self.hostnames = list(hostnames)
         self._last_total = 0
+        self._last_tot = None   # [shards] per-segment cursors, lazy
         self._lost_reported = 0
         self._f = open(path, "w")
 
@@ -246,29 +264,45 @@ class LogDrain:
         lg = state.log
         if lg is None:
             return 0
-        total, lost = (int(v) for v in jax.device_get((lg.total, lg.lost)))
+        tot_a, lost_a = jax.device_get((lg.total, lg.lost))
+        tot_a = np.atleast_1d(np.asarray(tot_a, np.int64))
+        lost_a = np.atleast_1d(np.asarray(lost_a, np.int64))
+        shards = tot_a.shape[0]
         trace.current().transfer(16, count=1)
+        lost = int(lost_a.sum())
         if lost > self._lost_reported:
             self._f.write(f"[log] WARNING: {lost - self._lost_reported} "
                           f"records lost inside oversized appends\n")
             self._lost_reported = lost
+        if self._last_tot is None:
+            self._last_tot = np.zeros(shards, np.int64)
+        total = int(tot_a.sum())
         if total == self._last_total:
             return 0
         t, host, code, arg = jax.device_get(
             (lg.time, lg.host, lg.code, lg.arg))
         trace.current().transfer(
             t.nbytes + host.nbytes + code.nbytes + arg.nbytes, count=1)
-        c = t.shape[0]
+        per = t.shape[0] // shards
         new = total - self._last_total
-        if new <= 0:
-            return 0
-        if new > c:
-            self._f.write(f"[log] WARNING: {new - c} records lost "
-                          f"(ring capacity {c})\n")
-            start = total - c
-        else:
-            start = self._last_total
-        idx = np.arange(start, total) % c
+        wrap_lost = 0
+        parts = []
+        for s in range(shards):
+            total_s = int(tot_a[s])
+            ns = total_s - int(self._last_tot[s])
+            if ns <= 0:
+                continue
+            if ns > per:
+                wrap_lost += ns - per
+                start = total_s - per
+            else:
+                start = int(self._last_tot[s])
+            parts.append(s * per + (np.arange(start, total_s) % per))
+            self._last_tot[s] = total_s
+        if wrap_lost:
+            self._f.write(f"[log] WARNING: {wrap_lost} records lost "
+                          f"(ring capacity {per})\n")
+        idx = np.concatenate(parts)
         order = np.argsort(t[idx], kind="stable")
         for k in idx[order]:
             name = self.hostnames[host[k]] if host[k] < len(self.hostnames) \
@@ -303,3 +337,68 @@ def census(state) -> dict:
         "sockets_udp": int((stype == SOCK_UDP).sum()),
         "sockets_tcp": int((stype == SOCK_TCP).sum()),
     }
+
+
+def _si(v: float) -> str:
+    """Compact SI-ish rate formatting: 1234567 -> '1.23M'."""
+    for div, suffix in ((1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if v >= div:
+            return f"{v / div:.2f}{suffix}"
+    return f"{v:.0f}"
+
+
+class Progress:
+    """One-line live status for long runs (the CLI's --progress): sim
+    time covered, event rate, window rate, and a wall-clock ETA, written
+    to stderr at most once per `min_interval_s` of wall time.
+
+    Each report costs one small device_get (n_events + n_windows, both
+    replicated scalars under a mesh) and a `progress` profiler span, at
+    chunk cadence -- cheap enough to leave on for multi-hour runs, which
+    is the point (the reference prints its own heartbeat lines through
+    the logger; our heartbeats go to CSV, so silence needed a channel).
+    """
+
+    def __init__(self, stop_ns: int, out=None, min_interval_s: float = 2.0):
+        import sys
+        import time as _time
+        self.stop_ns = int(stop_ns)
+        self.out = out if out is not None else sys.stderr
+        self.min_interval = min_interval_s
+        self._clock = _time.perf_counter
+        self._wall_last = self._clock()
+        self._ev_last = 0
+        self._win_last = 0
+        self._t_last = 0
+
+    def update(self, state, t_ns: int, force: bool = False):
+        now = self._clock()
+        dt = now - self._wall_last
+        if not force and dt < self.min_interval:
+            return
+        import jax
+        with trace.current().span("progress"):
+            ev, wins = (int(v) for v in jax.device_get(
+                (state.n_events, state.n_windows)))
+            trace.current().transfer(16, count=1)
+        dt = max(dt, 1e-9)
+        ev_s = (ev - self._ev_last) / dt
+        win_s = (wins - self._win_last) / dt
+        sim_per_wall = ((int(t_ns) - self._t_last) / SEC) / dt
+        remain_s = max(self.stop_ns - int(t_ns), 0) / SEC
+        if sim_per_wall > 0 and remain_s / sim_per_wall < 360000:
+            e = int(remain_s / sim_per_wall)
+            eta = f"{e // 3600}:{(e // 60) % 60:02d}:{e % 60:02d}"
+        else:
+            eta = "-:--:--"
+        pct = 100.0 * int(t_ns) / max(self.stop_ns, 1)
+        self.out.write(
+            f"[progress] sim {int(t_ns) / SEC:.1f}s/"
+            f"{self.stop_ns / SEC:.1f}s ({pct:.0f}%) | "
+            f"{_si(ev_s)} ev/s | {wins} windows ({win_s:.1f}/s) | "
+            f"ETA {eta}\n")
+        self.out.flush()
+        self._wall_last = now
+        self._ev_last = ev
+        self._win_last = wins
+        self._t_last = int(t_ns)

@@ -20,7 +20,7 @@ Two entries share the layout policy (docs/parallel.md):
   advance), bitwise identical to single-device execution.
 """
 
-from .mesh import mesh_run_chunked, mesh_run_until
+from .mesh import exchange_probe_ms, mesh_run_chunked, mesh_run_until
 from .sharding import (HOST_AXIS, assert_packed_pool_sharding, make_mesh,
                        pad_params_to_mesh, pad_state_to_mesh,
                        pad_world_to_mesh, shard_params, shard_state,
@@ -29,6 +29,7 @@ from .sharding import (HOST_AXIS, assert_packed_pool_sharding, make_mesh,
 __all__ = [
     "HOST_AXIS",
     "assert_packed_pool_sharding",
+    "exchange_probe_ms",
     "make_mesh",
     "mesh_run_chunked",
     "mesh_run_until",

@@ -66,7 +66,20 @@ def _state_specs(state):
     host_rows = {h, state.pool.capacity, state.inbox.capacity}
 
     def spec(path, leaf):
-        if getattr(path[0], "name", "") == "nm":
+        name = getattr(path[0], "name", "")
+        if name in ("nm", "fr"):
+            # Replicated blocks: netem gathers by global ids; the flight
+            # recorder computes identical rows on every shard from
+            # psum/all_gather-reduced inputs (engine._fr_record).
+            return P()
+        if name in ("log", "cap"):
+            # Sharded observability rings (make_log_ring/make_capture_ring
+            # with shards=D): slot arrays partition into per-shard
+            # segments and the [D] cursors into per-shard scalars, so
+            # each shard appends independently; observe.LogDrain /
+            # write_pcap merge the segments in sim-time order.
+            if hasattr(leaf, "ndim") and leaf.ndim >= 1:
+                return P(HOST_AXIS)
             return P()
         if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
                 and leaf.shape[0] in host_rows:
@@ -138,8 +151,10 @@ def mesh_run_until(state, params, app, t_target, mesh=None):
     The world must DIVIDE the mesh (host count a multiple of the device
     count; state and params agreeing on it) -- pad first with
     parallel.pad_world_to_mesh(state, params, n_devices) if it doesn't.
-    Capture/log rings are single-device-only observability; run those
-    worlds through engine.run_until / sharded_run_until instead.
+    Capture/log rings must be built in the sharded layout
+    (make_capture_ring/make_log_ring with shards=n_devices: per-shard
+    segments + cursors); a flight recorder must be installed with
+    matching shards (trace.ensure_flight_recorder).
 
     Returns the state fully finalized (global counters, hoff stripped),
     so chunked runs are just repeated calls."""
@@ -149,10 +164,23 @@ def mesh_run_until(state, params, app, t_target, mesh=None):
     if state.hoff is not None:
         raise ValueError("mesh_run_until: state.hoff is set -- already "
                          "inside a mesh shard?")
-    if state.cap is not None or state.log is not None:
+    for ring, label, maker in ((state.cap, "capture", "make_capture_ring"),
+                               (state.log, "log", "make_log_ring")):
+        if ring is None:
+            continue
+        shards = ring.total.shape[0] if ring.total.ndim == 1 else 1
+        if shards != d or ring.capacity % d != 0:
+            raise ValueError(
+                f"mesh_run_until: the {label} ring was built for "
+                f"{shards} shard(s) but the mesh has {d} devices; build "
+                f"it with core.state.{maker}(capacity, shards={d}) so "
+                f"every shard gets its own segment and cursor")
+    if state.fr is not None and state.fr.n_shards != d:
         raise ValueError(
-            "mesh_run_until does not support capture/log rings (their "
-            "append cursors are global); drop them or run single-device")
+            f"mesh_run_until: flight recorder built for "
+            f"{state.fr.n_shards} shard(s) but the mesh has {d} devices; "
+            f"install it with trace.ensure_flight_recorder(state, "
+            f"shards={d})")
     h = state.hosts.num_hosts
     hp = params.host_vertex.shape[0]
     if hp != h:
@@ -186,12 +214,54 @@ def mesh_run_until(state, params, app, t_target, mesh=None):
 def mesh_run_chunked(state, params, app, t_target: int, mesh=None,
                      chunk_ns: int = engine.CHUNK_NS):
     """Host-side loop of bounded mesh launches (engine.run_chunked's mesh
-    twin); chunking is trajectory-invariant -- see docs/parallel.md."""
+    twin); chunking is trajectory-invariant -- see docs/parallel.md.
+
+    When a profiler is active (trace.install), each launch records a
+    `device_step` span exactly like the single-device launcher, so
+    metrics.json phase tables are comparable across device counts."""
+    from .. import trace
     if mesh is None:
         mesh = make_mesh()
     t = int(state.now)
     t_target = int(t_target)
+    prof = trace.current()
     while t < t_target:
         t = min(t + chunk_ns, t_target)
-        state = mesh_run_until(state, params, app, t, mesh=mesh)
+        with prof.span("device_step", t_ns=t):
+            state = mesh_run_until(state, params, app, t, mesh=mesh)
+            if prof.sync:
+                jax.block_until_ready(state)
     return state
+
+
+def exchange_probe_ms(state, params, mesh, reps: int = 5) -> float:
+    """Median wall-clock milliseconds of ONE boundary-exchange pass
+    (shard rank + tiled all_to_all + local splice) on `mesh`.
+
+    The send buffer is fixed-size (every shard always ships d blocks of
+    its full local pool capacity), so the collective's cost is mover-
+    count independent -- probing an idle state is representative of any
+    window.  bench.py uses this to attribute what share of window time
+    the all-to-all costs at each device count."""
+    import time as _time
+
+    sspecs = _state_specs(state)
+    pspecs = _param_specs(params)
+
+    def body(st, pr):
+        h = st.hosts.num_hosts
+        hoff = (jax.lax.axis_index(HOST_AXIS) * h).astype(I32)
+        st = engine._exchange_body_mesh(st.replace(hoff=hoff), pr)
+        return st.replace(hoff=None)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(sspecs, pspecs),
+                           out_specs=sspecs, check_rep=False))
+    with mesh:
+        jax.block_until_ready(fn(state, params))   # compile + warm
+        times = []
+        for _ in range(reps):
+            t0 = _time.perf_counter()
+            jax.block_until_ready(fn(state, params))
+            times.append(_time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2] * 1e3

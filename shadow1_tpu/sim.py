@@ -201,14 +201,13 @@ def run(state, params, app, until=None, profiler=None, devices=None):
     devices (parallel.mesh_run_until, docs/parallel.md): the world is
     padded to a multiple of N hosts if needed, and the trajectory is
     bitwise-identical to a single-device run of the (padded) world.
-    Incompatible with `profiler` and with capture/log rings.
+    `profiler` composes with `devices`: the mesh launcher records the
+    same `device_step` spans, and the counter deltas finalize across
+    shards (docs/observability.md), so telemetry rows match the
+    single-device run bitwise.
     """
     t = params.stop_time if until is None else until
     if devices is not None and int(devices) > 1:
-        if profiler is not None:
-            raise ValueError("sim.run: profiler + devices is unsupported "
-                             "(the profiler's chunked launcher is "
-                             "single-device; see docs/parallel.md)")
         import jax as _jax
 
         from . import parallel
@@ -219,8 +218,19 @@ def run(state, params, app, until=None, profiler=None, devices=None):
                              f"{_jax.default_backend()} device(s) visible")
         mesh = parallel.make_mesh(devs[:n])
         state, params = parallel.pad_world_to_mesh(state, params, n)
-        return parallel.mesh_run_chunked(state, params, app, int(t),
-                                         mesh=mesh)
+        if profiler is None:
+            return parallel.mesh_run_chunked(state, params, app, int(t),
+                                             mesh=mesh)
+        from . import trace
+        trace.install(profiler)
+        try:
+            state = trace.ensure_counters(state)
+            state = parallel.mesh_run_chunked(state, params, app, int(t),
+                                              mesh=mesh)
+            trace.fetch_counters(state, profiler)
+            return state
+        finally:
+            trace.install(None)
     if profiler is None:
         return engine.run_until(state, params, app, t)
     from . import trace

@@ -164,6 +164,8 @@ class Profiler:
         self.counter_samples = []   # (t_rel_s, {name: value})
         self.kernelcount = None     # tools/kernelcount.py report|None
         self.extra_metrics = {}     # {name: number} via set_metric
+        self.flight_rows = []       # drained FlightRecorder rows
+        self.flight_summary = None  # aggregate `mesh` section|None
 
     # -- recording hooks ----------------------------------------------------
 
@@ -192,6 +194,15 @@ class Profiler:
         profiled artifact carries the compiled-graph size alongside the
         wall times (benchdiff gates on it with --kernels)."""
         self.kernelcount = report
+
+    def set_flight(self, rows: list, summary: dict | None):
+        """Attach drained flight-recorder rows (FlightDrain.rows) + their
+        aggregate.  The aggregate becomes the `mesh` section of
+        metrics(); the rows become a simulated-time track (pid 2) in
+        trace_events(), so the Chrome trace shows wall time and sim time
+        side by side."""
+        self.flight_rows = list(rows)
+        self.flight_summary = summary
 
     def set_metric(self, name: str, value):
         """Attach one named scalar metric (e.g. a measured phase cost
@@ -231,6 +242,8 @@ class Profiler:
             out["device_counters"] = self.counter_samples[-1][1]
         if self.kernelcount is not None:
             out["kernelcount"] = self.kernelcount
+        if self.flight_summary is not None:
+            out["mesh"] = self.flight_summary
         out.update(self.extra_metrics)
         return out
 
@@ -263,6 +276,27 @@ class Profiler:
                             "args": {k: v}})
         meta = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": i,
                  "args": {"name": n}} for n, i in tids.items()]
+        if self.flight_rows:
+            # Simulated-time track: pid 2's clock is SIM nanoseconds
+            # (rendered as trace microseconds), one span per window plus
+            # events/routed counter tracks -- wall time (pid 1) and sim
+            # time (pid 2) side by side in the same viewer.
+            meta.append({"name": "process_name", "ph": "M", "pid": 2,
+                         "args": {"name": "simulated time (windows)"}})
+            meta.append({"name": "thread_name", "ph": "M", "pid": 2,
+                         "tid": 1, "args": {"name": "window"}})
+            for r in self.flight_rows:
+                ts = round(r["t_start"] / 1e3, 3)
+                dur = round(max(r["t_end"] - r["t_start"], 1) / 1e3, 3)
+                evs.append({"name": "window", "cat": "sim", "ph": "X",
+                            "pid": 2, "tid": 1, "ts": ts, "dur": dur,
+                            "args": {k: r[k] for k in
+                                     ("window", "steps", "events",
+                                      "routed", "delivered", "dropped",
+                                      "killed")}})
+                for k in ("events", "routed"):
+                    evs.append({"name": k, "cat": "sim", "ph": "C",
+                                "pid": 2, "ts": ts, "args": {k: r[k]}})
         return meta + evs
 
     def write_trace(self, path: str):
@@ -351,3 +385,136 @@ def fetch_counters(state, profiler=None) -> dict:
     p.transfer(sum(getattr(v, "nbytes", 8) for v in fetched), count=1)
     p.counter_sample(out)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder (the FlightRecorder ring on SimState; core/state.py)
+# ---------------------------------------------------------------------------
+
+
+def ensure_flight_recorder(state, capacity: int = 4096, shards: int = 1):
+    """Return `state` with a per-window FlightRecorder ring installed
+    (idempotent).  `shards` sizes the src->dst exchange matrices and
+    must match the device count of a mesh run (1 for single-device);
+    the host count and pool capacity must divide it so the logical
+    shard of a host is well defined."""
+    if state.fr is not None:
+        return state
+    from .core.state import make_flight_recorder
+    h = int(state.hosts.num_hosts)
+    if shards < 1 or h % shards or int(state.pool.capacity) % shards:
+        raise ValueError(
+            f"ensure_flight_recorder: shards={shards} must divide the "
+            f"host count ({h}) and pool capacity "
+            f"({int(state.pool.capacity)}); pad the world to the mesh "
+            f"first (parallel.pad_world_to_mesh)")
+    return state.replace(fr=make_flight_recorder(capacity, shards))
+
+
+class FlightDrain:
+    """Host-side drain of the flight recorder: fetches new rows at chunk
+    boundaries (one scalar probe + one bulk fetch only when rows are
+    new -- riding the existing sync points, no extra per-window syncs),
+    appends them to ``windows.jsonl`` when a path is given, and keeps
+    them for Profiler.set_flight / aggregation.
+
+    Ring wrap between drains loses the oldest rows; lifetime totals are
+    still exact because the recorder accumulates wrap-proof sums on the
+    device (`ex_*_sum`) -- the drain reports `rows_lost` so a summary
+    reader knows row-derived aggregates are partial."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self.rows = []
+        self.rows_lost = 0
+        self.shards = None      # learned from the ring at first drain
+        self.capacity = None
+        self._last = 0
+        self._f = open(path, "w") if path else None
+
+    def drain(self, state, profiler=None) -> int:
+        """Fetch rows appended since the last drain; returns how many."""
+        fr = getattr(state, "fr", None)
+        if fr is None:
+            return 0
+        import jax
+        p = profiler if profiler is not None else _active
+        with p.span("flight_drain"):
+            total = int(jax.device_get(fr.total))
+            p.transfer(8, count=1)
+            new = total - self._last
+            if new <= 0:
+                return 0
+            self.shards = fr.n_shards
+            self.capacity = c = fr.capacity
+            arrs = jax.device_get((fr.win_start, fr.win_end, fr.steps,
+                                   fr.events, fr.routed, fr.delivered,
+                                   fr.dropped, fr.killed, fr.ex_cnt,
+                                   fr.ex_bytes))
+            p.transfer(sum(a.nbytes for a in arrs), count=1)
+            if new > c:
+                self.rows_lost += new - c
+                start = total - c
+            else:
+                start = self._last
+            ws, we, steps, ev, rt, dl, dp, kl, xc, xb = arrs
+            for w in range(start, total):
+                k = w % c
+                row = {"window": w,
+                       "t_start": int(ws[k]), "t_end": int(we[k]),
+                       "steps": int(steps[k]), "events": int(ev[k]),
+                       "routed": int(rt[k]), "delivered": int(dl[k]),
+                       "dropped": int(dp[k]), "killed": int(kl[k]),
+                       "ex_cnt": xc[k].tolist(),
+                       "ex_bytes": xb[k].tolist()}
+                self.rows.append(row)
+                if self._f is not None:
+                    self._f.write(json.dumps(row) + "\n")
+            if self._f is not None:
+                self._f.flush()
+            self._last = total
+            return new
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def summary(self, state=None, n_devices: int = 1) -> dict:
+        """Aggregate the drained rows into the `mesh` metrics section.
+        Pass the final state to include the device-side wrap-proof
+        exchange totals (exact even when rows were lost to wrap)."""
+        d = self.shards or 1
+        agg = {k: sum(r[k] for r in self.rows)
+               for k in ("steps", "events", "routed", "delivered",
+                         "dropped", "killed")}
+        mat_c = [[0] * d for _ in range(d)]
+        mat_b = [[0] * d for _ in range(d)]
+        for r in self.rows:
+            for i in range(d):
+                for j in range(d):
+                    mat_c[i][j] += r["ex_cnt"][i][j]
+                    mat_b[i][j] += r["ex_bytes"][i][j]
+        if state is not None and getattr(state, "fr", None) is not None:
+            import jax
+            mat_c, mat_b = (a.tolist() for a in jax.device_get(
+                (state.fr.ex_cnt_sum, state.fr.ex_bytes_sum)))
+        out = {
+            "n_devices": n_devices,
+            "recorder": {"capacity": self.capacity, "shards": d},
+            "windows": self._last,
+            "rows_lost": self.rows_lost,
+        }
+        out.update(agg)
+        out["exchange"] = {
+            "movers": sum(map(sum, mat_c)),
+            "bytes": sum(map(sum, mat_b)),
+            "matrix_movers": mat_c,
+            "matrix_bytes": mat_b,
+        }
+        if self.rows:
+            sim_s = (self.rows[-1]["t_end"]
+                     - self.rows[0]["t_start"]) / 1e9
+            if sim_s > 0:
+                out["windows_per_sim_s"] = round(len(self.rows) / sim_s, 3)
+        return out

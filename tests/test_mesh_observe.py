@@ -1,0 +1,273 @@
+"""Mesh-native observability: the telemetry-parity contract.
+
+docs/observability.md promises that every observability surface --
+heartbeat telemetry, the leveled log ring, the packet capture ring, and
+the flight recorder -- produces the SAME data whether a world runs on
+one device or sharded across a mesh.  Heartbeats and flight-recorder
+rows are bitwise identical (both are finalized by cross-shard
+reductions of per-shard partials, or computed replicated); the log and
+capture rings shard their slots and merge drains in sim-time order, so
+their record MULTISETS match while equal-timestamp interleavings may
+differ from the single-cursor append order.
+
+These tests verify that contract on the 8-virtual-device CPU platform
+the conftest forces, plus the flight recorder's own invariants:
+trajectory neutrality, chunking-invariant aggregates, and exact sums
+across row-ring wraps.
+"""
+
+import json
+import os
+import struct
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shadow1_tpu import observe, sim, trace
+from shadow1_tpu.core import engine, simtime
+from shadow1_tpu.core import state as state_mod
+from shadow1_tpu.parallel import (make_mesh, mesh_run_chunked,
+                                  pad_world_to_mesh)
+
+MS = simtime.SIMTIME_ONE_MILLISECOND
+SEC = simtime.SIMTIME_ONE_SECOND
+
+FR_LEAVES = ("total", "win_start", "win_end", "steps", "events",
+             "routed", "delivered", "dropped", "killed",
+             "ex_cnt", "ex_bytes", "ex_cnt_sum", "ex_bytes_sum")
+
+
+def _drive(state, params, app, stop_ns, step_ns, runner, tracker=None,
+           drain=None):
+    """The CLI's run loop in miniature: chunked launches with a
+    heartbeat sample and a log drain at every boundary."""
+    t = 0
+    while t < stop_ns:
+        t = min(t + step_ns, stop_ns)
+        state = runner(state, t)
+        if tracker is not None:
+            tracker.heartbeat(state, int(t))
+        if drain is not None:
+            drain.drain(state)
+    return state
+
+
+def _fr_equal(a, b):
+    for name in FR_LEAVES:
+        xa, xb = np.asarray(getattr(a, name)), np.asarray(getattr(b, name))
+        assert np.array_equal(xa, xb), f"fr.{name} differs"
+
+
+def _pcap_records(path):
+    """(ts_sec, ts_usec, payload) triples of a classic pcap file."""
+    b = open(path, "rb").read()
+    out, off = [], 24
+    while off < len(b):
+        ts, tu, cl, _ol = struct.unpack("<IIII", b[off:off + 16])
+        out.append((ts, tu, b[off + 16:off + 16 + cl]))
+        off += 16 + cl
+    return out
+
+
+class TestMeshHeartbeats:
+    def test_phold_heartbeat_csv_bitwise(self, tmp_path):
+        # Same world, same chunk boundaries, a heartbeat at every
+        # boundary: the CSV must be byte-for-byte identical because the
+        # telemetry block's counters finalize across shards before any
+        # host-side read.
+        kw = dict(num_hosts=16, msgs_per_host=2, mean_delay_ns=10 * MS,
+                  stop_time=3 * SEC, pool_capacity=1 << 10, seed=4)
+        names = [f"h{i}" for i in range(16)]
+
+        state, params, app = sim.build_phold(**kw)
+        tr1 = observe.Tracker(str(tmp_path / "one"), names)
+        _drive(state, params, app, 2 * SEC, SEC,
+               lambda s, t: engine.run_chunked(s, params, app, t),
+               tracker=tr1)
+
+        state2, params2, _ = sim.build_phold(**kw)
+        mesh = make_mesh(jax.devices()[:8])
+        tr8 = observe.Tracker(str(tmp_path / "mesh"), names)
+        _drive(state2, params2, app, 2 * SEC, SEC,
+               lambda s, t: mesh_run_chunked(s, params2, app, t,
+                                             mesh=mesh),
+               tracker=tr8)
+
+        one = (tmp_path / "one" / "heartbeat.csv").read_bytes()
+        eight = (tmp_path / "mesh" / "heartbeat.csv").read_bytes()
+        assert one.count(b"\n") > 16  # header + 2 intervals x 16 hosts
+        assert one == eight
+
+
+class TestShardedRings:
+    """Log + capture rings under the mesh: per-shard segments, merged
+    drains.  The tgen 2-host file transfer is the record source (its
+    TCP stack logs and captures real packets); the PADDED 8-host world
+    runs on one device with the classic single-cursor rings and on the
+    8-device mesh with sharded rings."""
+
+    def _world(self, shards):
+        from shadow1_tpu.config import assemble
+        path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                            "tgen-2host", "shadow.config.xml")
+        asm = assemble.load(path)
+        st, pr = asm.state, asm.params
+        pr = pr.replace(pcap_mask=jnp.ones_like(pr.pcap_mask))
+        st = st.replace(
+            cap=state_mod.make_capture_ring(1 << 14, shards=shards),
+            log=state_mod.make_log_ring(1 << 14, shards=shards),
+            log_level=jnp.full((2,), 2, jnp.int32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            st, pr = pad_world_to_mesh(st, pr, 8)
+        return st, pr, asm.app, asm.hostnames
+
+    def test_tgen_log_and_pcap_merge_matches_single(self, tmp_path):
+        t_end, step = 6 * SEC, 2 * SEC
+        st, pr, app, names = self._world(shards=1)
+        d1 = observe.LogDrain(str(tmp_path / "one.log"), names)
+        out1 = _drive(st, pr, app, t_end, step,
+                      lambda s, t: engine.run_chunked(s, pr, app, t),
+                      drain=d1)
+        d1.close()
+        n1 = observe.write_pcap(str(tmp_path / "one.pcap"), out1.cap)
+
+        st8, pr8, app8, _ = self._world(shards=8)
+        mesh = make_mesh(jax.devices()[:8])
+        d8 = observe.LogDrain(str(tmp_path / "mesh.log"), names)
+        out8 = _drive(st8, pr8, app8, t_end, step,
+                      lambda s, t: mesh_run_chunked(s, pr8, app8, t,
+                                                    mesh=mesh),
+                      drain=d8)
+        d8.close()
+        n8 = observe.write_pcap(str(tmp_path / "mesh.pcap"),
+                                jax.device_get(out8.cap))
+
+        lines1 = (tmp_path / "one.log").read_text().splitlines()
+        lines8 = (tmp_path / "mesh.log").read_text().splitlines()
+        assert len(lines1) > 0
+        assert sorted(lines1) == sorted(lines8)
+
+        assert n1 == n8 and n1 > 0
+        r1 = _pcap_records(str(tmp_path / "one.pcap"))
+        r8 = _pcap_records(str(tmp_path / "mesh.pcap"))
+        assert sorted(r1) == sorted(r8)
+
+    def test_sharded_ring_off_mesh_raises(self):
+        # A sharded ring's shard-0 cursor against the full slot array
+        # would silently corrupt on one device; the append helpers
+        # refuse at trace time instead.
+        state, params, app = sim.build_phold(16, stop_time=SEC)
+        bad = state.replace(log=state_mod.make_log_ring(256, shards=8),
+                            log_level=jnp.full((16,), 2, jnp.int32))
+        with pytest.raises(ValueError, match="outside a mesh"):
+            engine.run_until(bad, params, app, SEC)
+
+
+class TestFlightRecorder:
+    def _phold(self, **over):
+        kw = dict(num_hosts=16, msgs_per_host=2, mean_delay_ns=10 * MS,
+                  stop_time=2 * SEC, pool_capacity=1 << 7, seed=4)
+        kw.update(over)
+        return sim.build_phold(**kw)
+
+    def test_rows_bitwise_single_vs_mesh(self):
+        # The recorder is replicated: every shard computes every row
+        # from psum'd deltas and all_gather'd exchange matrices, and a
+        # single device running the same 8-shard-shaped recorder maps
+        # hosts/pool rows onto logical shards identically.
+        state, params, app = self._phold()
+        state = trace.ensure_flight_recorder(state, shards=8)
+        single = engine.run_chunked(state, params, app, SEC)
+        mesh = make_mesh(jax.devices()[:8])
+        out = mesh_run_chunked(state, params, app, SEC, mesh=mesh)
+        assert int(single.fr.total) > 0
+        assert int(np.asarray(single.fr.ex_cnt_sum).sum()) > 0
+        _fr_equal(single.fr, out.fr)
+
+    def test_chunking_invariant_aggregates(self):
+        # Chunk boundaries truncate windows, so ROWS legitimately
+        # differ across chunkings -- but the lifetime aggregates count
+        # the same trajectory and must match exactly.  Exchange totals
+        # are invariant up to packets still staged at the horizon (a
+        # finer chunking's extra boundary window may have moved a
+        # packet the coarser one still holds in the pool), so the
+        # conserved quantity is movers + staged.
+        state, params, app = self._phold()
+        state = trace.ensure_flight_recorder(state, shards=8)
+        a = engine.run_chunked(state, params, app, SEC)
+        b = _drive(state, params, app, SEC, 250 * MS,
+                   lambda s, t: engine.run_chunked(s, params, app, t))
+        assert int(a.fr.total) != int(b.fr.total)  # different windows
+        for name in ("events", "delivered", "dropped", "killed"):
+            sa = int(np.asarray(getattr(a.fr, name)).sum())
+            sb = int(np.asarray(getattr(b.fr, name)).sum())
+            assert sa == sb, f"fr.{name} aggregate differs"
+
+        def conserved(out):
+            staged = np.asarray(out.pool.stage) == \
+                state_mod.STAGE_IN_FLIGHT
+            lens = np.asarray(out.pool.blk[:, state_mod.ICOL_LEN])
+            movers = int(np.asarray(out.fr.ex_cnt_sum).sum())
+            byts = int(np.asarray(out.fr.ex_bytes_sum).sum())
+            return (movers + int(staged.sum()),
+                    byts + int(lens[staged].sum()))
+        assert conserved(a) == conserved(b)
+
+    def test_recorder_is_trajectory_neutral(self):
+        # Attaching the recorder must not perturb the simulation: every
+        # non-fr leaf of the final state is bitwise identical.
+        state, params, app = self._phold()
+        bare = engine.run_until(state, params, app, SEC)
+        rec = engine.run_until(trace.ensure_flight_recorder(state),
+                               params, app, SEC)
+        assert rec.fr is not None and bare.fr is None
+        _la, ta = jax.tree_util.tree_flatten(bare)
+        _lb, tb = jax.tree_util.tree_flatten(rec.replace(fr=None))
+        assert ta == tb
+        for x, y in zip(_la, _lb):
+            assert np.array_equal(np.asarray(x), np.asarray(y))
+
+    def test_recorder_absent_graph_identical(self):
+        # fr=None is trace-time static: a world that never had the
+        # recorder and one that had it attached then detached lower to
+        # byte-identical HLO (so recorder-absent runs pay zero compiled
+        # ops -- the kernelcount gate's structural guarantee).
+        state, params, app = self._phold()
+        txt = engine.run_until.lower(state, params, app,
+                                     SEC).as_text()
+        rt = trace.ensure_flight_recorder(state).replace(fr=None)
+        txt_rt = engine.run_until.lower(rt, params, app, SEC).as_text()
+        assert txt == txt_rt
+        with_fr = trace.ensure_flight_recorder(state)
+        txt_fr = engine.run_until.lower(with_fr, params, app,
+                                        SEC).as_text()
+        assert txt_fr != txt  # the test can fail: the recorder traces in
+
+    def test_row_ring_wrap_keeps_exact_sums(self, tmp_path):
+        # ~100 windows through a 16-row ring: the drain reports the
+        # lost rows, and the summary's exchange totals still come from
+        # the wrap-proof on-device sums, not the surviving rows.
+        state, params, app = self._phold()
+        full = engine.run_chunked(
+            trace.ensure_flight_recorder(state), params, app, SEC)
+        wrapped = engine.run_chunked(
+            trace.ensure_flight_recorder(state, capacity=16), params,
+            app, SEC)
+        fd = trace.FlightDrain(str(tmp_path / "windows.jsonl"))
+        fd.drain(wrapped)
+        fd.close()
+        s = fd.summary(wrapped, n_devices=1)
+        assert s["rows_lost"] > 0 and len(fd.rows) == 16
+        assert s["exchange"]["movers"] == \
+            int(np.asarray(full.fr.ex_cnt_sum).sum())
+        assert s["exchange"]["bytes"] == \
+            int(np.asarray(full.fr.ex_bytes_sum).sum())
+        # The JSONL file holds exactly the surviving rows.
+        lines = [json.loads(ln) for ln in
+                 (tmp_path / "windows.jsonl").read_text().splitlines()]
+        assert [r["window"] for r in lines] == \
+            [r["window"] for r in fd.rows]

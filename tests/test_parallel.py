@@ -159,13 +159,16 @@ class TestMeshRunUntil:
         with pytest.raises(ValueError, match="pad_world_to_mesh"):
             mesh_run_until(state, params, app, SEC, mesh=mesh)
 
-    def test_log_ring_worlds_are_rejected(self):
+    def test_scalar_cursor_log_ring_is_rejected_with_recipe(self):
+        # A ring built for one shard has a single cursor the 8 shards
+        # would race on; the refusal names the shards= recipe.  Sharded
+        # ring runs themselves are covered in test_mesh_observe.py.
         from shadow1_tpu.core import state as state_mod
 
         state, params, app = sim.build_phold(16, stop_time=SEC)
         state = state.replace(log=state_mod.make_log_ring(1 << 8))
         mesh = make_mesh(jax.devices()[:8])
-        with pytest.raises(ValueError, match="capture/log"):
+        with pytest.raises(ValueError, match=r"shards=8"):
             mesh_run_until(state, params, app, SEC, mesh=mesh)
 
 
@@ -303,11 +306,21 @@ class TestSimRunDevices:
         out = sim.run(state, params, app, until=200 * MS, devices=8)
         _assert_trees_equal(jax.device_get(ref), jax.device_get(out))
 
-    def test_sim_run_devices_rejects_profiler(self):
+    def test_sim_run_devices_composes_with_profiler(self):
+        # The profiler used to be refused under devices>1; it now
+        # composes: counter deltas finalize across shards, so the
+        # fetched telemetry equals the single-device profiled run's.
         from shadow1_tpu import trace
-        state, params, app = sim.build_phold(
-            num_hosts=8, msgs_per_host=1, stop_time=100 * MS,
-            pool_capacity=1 << 9)
-        with pytest.raises(ValueError, match="profiler"):
-            sim.run(state, params, app, until=100 * MS,
-                    profiler=trace.Profiler(), devices=8)
+        kw = dict(num_hosts=8, msgs_per_host=1, stop_time=100 * MS,
+                  pool_capacity=1 << 9)
+        state, params, app = sim.build_phold(**kw)
+        p1 = trace.Profiler()
+        ref = sim.run(state, params, app, until=100 * MS, profiler=p1)
+
+        state2, params2, _ = sim.build_phold(**kw)
+        p8 = trace.Profiler()
+        out = sim.run(state2, params2, app, until=100 * MS,
+                      profiler=p8, devices=8)
+        assert p8.metrics()["device_counters"] == \
+            p1.metrics()["device_counters"]
+        _assert_trees_equal(jax.device_get(ref), jax.device_get(out))

@@ -27,7 +27,8 @@ import sys
 _HIGHER_BETTER = ("events_per_sec", "value", "vs_baseline",
                   "events_per_microstep")
 _LOWER_BETTER = ("wall_sec", "wall_s", "p50_ms", "p95_ms", "max_ms",
-                 "total_s", "compile_s", "stage_emissions_ms")
+                 "total_s", "compile_s", "stage_emissions_ms",
+                 "alltoall_ms")
 
 # Machine-bound leaves: wall-clock / throughput numbers that only
 # compare between runs on the same backend + core count.  Across
@@ -39,6 +40,17 @@ _LOWER_BETTER = ("wall_sec", "wall_s", "p50_ms", "p95_ms", "max_ms",
 _MACHINE_BOUND = ("events_per_sec", "value", "vs_baseline", "wall_sec",
                   "wall_s", "p50_ms", "p95_ms", "max_ms", "total_s",
                   "compile_s", "stage_emissions_ms")
+
+# Whole machine-bound subtrees: everything the flight recorder / mesh
+# telemetry times (exchange probe ms, window rates) depends on the
+# backend, so the dotted prefix downgrades the entire block -- a probe
+# time never flags across environments.
+_MACHINE_BOUND_PREFIXES = ("profile.flight.", "mesh.")
+
+
+def _machine_bound(name: str) -> bool:
+    return (name.rsplit(".", 1)[-1] in _MACHINE_BOUND
+            or name.startswith(_MACHINE_BOUND_PREFIXES))
 
 # Compiled-kernel-count leaves (tools/kernelcount.py reports, standalone
 # or embedded under profile.kernelcount): deterministic integers, so
@@ -90,6 +102,21 @@ def _netem_config(d: dict):
     if not isinstance(cfg, dict):
         return None
     return cfg.get("netem") or None
+
+
+def _flight_config(d: dict):
+    """Normalized flight-recorder config of a run: None when the
+    recorder was off (including files recorded before it existed), else
+    its {capacity, shards} dict.  Read from a bench JSON's config.flight
+    stamp or a metrics.json's mesh.recorder block -- both carry the same
+    keys, so the two formats compare against each other."""
+    cfg = d.get("config")
+    if isinstance(cfg, dict) and cfg.get("flight"):
+        return cfg["flight"]
+    mesh = d.get("mesh")
+    if isinstance(mesh, dict) and isinstance(mesh.get("recorder"), dict):
+        return mesh["recorder"]
+    return None
 
 
 def _kernel_world(d: dict):
@@ -153,7 +180,7 @@ def diff(old: dict, new: dict, threshold_pct: float,
         if kernel and not kernels:
             continue
         gated = not kernel or name.rsplit(".", 1)[-1] in _KERNEL_GATED
-        if not same_env and name.rsplit(".", 1)[-1] in _MACHINE_BOUND:
+        if not same_env and _machine_bound(name):
             gated = False
         d = "down" if kernel else _direction(name)
         if d is None:
@@ -201,6 +228,16 @@ def main(argv=None) -> int:
               f"fault-injection configs (old netem={nm_old!r}, "
               f"new netem={nm_new!r}); rerun with matching --churn/"
               f"netem settings", file=sys.stderr)
+        return 2
+    fl_old, fl_new = _flight_config(old), _flight_config(new)
+    if fl_old != fl_new:
+        # The recorder changes the traced graph (an extra ring write per
+        # window), so recorder-on vs recorder-off -- or different ring
+        # shapes -- measure different programs, like the netem rule.
+        print(f"benchdiff: refusing to compare runs with different "
+              f"flight-recorder configs (old flight={fl_old!r}, "
+              f"new flight={fl_new!r}); rerun with matching recorder "
+              f"settings", file=sys.stderr)
         return 2
     if args.kernels:
         wo, wn = _kernel_world(old), _kernel_world(new)

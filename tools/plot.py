@@ -9,6 +9,12 @@ Reads `heartbeat.csv` (observe.Tracker format) and writes:
   drops.png        -- drops PER HEARTBEAT INTERVAL (wire + router)
   queues.png       -- total tx/rx queue occupancy over time
 
+When the run also wrote `windows.jsonl` (the flight recorder's
+per-window rows, trace.FlightDrain format) two more panels appear;
+both are skipped silently when the file is absent:
+  exchange.png     -- src-shard x dst-shard heatmap of exchanged packets
+  windows.png      -- engine windows closed per simulated second
+
 Rate columns are step-held per host between its rows, so hosts on
 different per-host heartbeat cadences aggregate without sawtooth
 artifacts; delta columns (packets, drops) are summed at the timestamps
@@ -18,6 +24,7 @@ they were reported.
 from __future__ import annotations
 
 import csv
+import json
 import os
 import sys
 from collections import defaultdict
@@ -34,6 +41,21 @@ def load(data_dir: str):
         for rec in csv.DictReader(f):
             rows.append(rec)
     return rows
+
+
+def load_windows(data_dir: str):
+    """Flight-recorder rows from windows.jsonl, or None when the run
+    had no recorder (no --profile, or a build predating it)."""
+    path = os.path.join(data_dir, "windows.jsonl")
+    if not os.path.exists(path):
+        return None
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows or None
 
 
 RATE_COLS = ("bytes_sent_per_s", "bytes_recv_per_s",
@@ -100,6 +122,46 @@ def main(data_dir: str, out_dir: str | None = None) -> list:
                ("drops_router", "router (CoDel/tail)")])
         chart("queues", "Queue occupancy", "packets",
               [("tx_queued", "tx queued"), ("rx_queued", "rx queued")])
+
+    wrows = load_windows(data_dir)
+    if wrows:
+        # Exchange heatmap: per-window [shards, shards] mover matrices
+        # summed over the run (row = source shard, column = destination).
+        d = len(wrows[0]["ex_cnt"])
+        mat = [[0] * d for _ in range(d)]
+        for r in wrows:
+            for i, row in enumerate(r["ex_cnt"]):
+                for j, v in enumerate(row):
+                    mat[i][j] += v
+        f, ax = plt.subplots(figsize=(5.5, 4.5))
+        im = ax.imshow(mat, cmap="viridis")
+        ax.set_title("Exchanged packets by shard pair")
+        ax.set_xlabel("destination shard")
+        ax.set_ylabel("source shard")
+        f.colorbar(im, ax=ax, label="packets")
+        p = os.path.join(out_dir, "exchange.png")
+        f.savefig(p, dpi=110, bbox_inches="tight")
+        plt.close(f)
+        written.append(p)
+
+        # Window rate: windows closed per simulated second (buckets by
+        # the second each window ended in).  A flat line means the
+        # conservative window advance is healthy; dips mark sim-time
+        # regions where lookahead collapsed.
+        buckets = defaultdict(int)
+        for r in wrows:
+            buckets[int(r["t_end"] // 1_000_000_000)] += 1
+        secs = sorted(buckets)
+        f, ax = plt.subplots(figsize=(8, 4.5))
+        ax.step(secs, [buckets[t] for t in secs], where="post")
+        ax.set_title("Engine windows per simulated second")
+        ax.set_xlabel("simulated time (s)")
+        ax.set_ylabel("windows/s")
+        p = os.path.join(out_dir, "windows.png")
+        f.savefig(p, dpi=110, bbox_inches="tight")
+        plt.close(f)
+        written.append(p)
+
     for p in written:
         print(p)
     return written
