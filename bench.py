@@ -229,6 +229,11 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
         "profile": {
             "phases": metrics["phases"],
             "compile": metrics["compile"],
+            # Flat compile metrics for benchdiff: the count gates at 0%
+            # (a graph property -- a new compile means a shape or static
+            # changed), the wall time is machine-bound/informational.
+            "compiles": metrics["compiles"],
+            "compile_ms": metrics["compile_ms"],
             "transfers": metrics["transfers"],
             "device_counters": counters,
             "kernelcount": metrics.get("kernelcount"),

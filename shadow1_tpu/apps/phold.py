@@ -114,10 +114,11 @@ class Phold:
         h = a.pending.shape[0]
         # GLOBAL host ids (identity off-mesh): they key every RNG draw and
         # the dst pick, so draws are mesh-invariant.  The world's global
-        # host count is host_vertex's length, not the (possibly shard-
-        # local) state row count.
+        # host count comes from params.global_hosts() -- the REAL count
+        # even when the arrays carry bucket-padding rows -- never the
+        # (possibly shard-local, possibly padded) state row count.
         rows = host_ids(state, U32)
-        hg = params.host_vertex.shape[0]
+        hg = params.global_hosts()
         slot = jnp.full((h,), self.sock_slot, I32)
 
         # Consume delivered messages from the socket ring: each one becomes

@@ -105,6 +105,14 @@ def _parser():
                         "rate, window rate, ETA -- for long runs that "
                         "would otherwise be silent")
     r.add_argument("--quiet", action="store_true")
+    r.add_argument("--bucket", action="store_true",
+                   help="pad the world up to its shape bucket "
+                        "(shapes.pad_world_to_bucket: host count rounded "
+                        "up the geometric ladder, real-host rows bitwise "
+                        "identical to the exact-size run) so different-"
+                        "sized configs reuse one compiled graph -- see "
+                        "docs/shapes.md.  Composes with --devices: bucket "
+                        "first, then mesh-pad")
     r.add_argument("--devices", type=int, default=1, metavar="N",
                    help="shard the run across N devices "
                         "(parallel.mesh_run_until: the window loop under "
@@ -115,6 +123,19 @@ def _parser():
                         "observability stack (--pcap, --log-level, "
                         "--profile, heartbeats) runs sharded; only "
                         "real-process plugins remain single-device")
+
+    w = sub.add_parser(
+        "warm",
+        help="pre-compile the standard shape buckets into the "
+             "persistent XLA cache (docs/shapes.md)")
+    w.add_argument("--buckets", type=int, nargs="+", default=None,
+                   metavar="H",
+                   help="host bucket sizes to warm (default: the "
+                        "standard set, shapes.STANDARD_HOST_BUCKETS)")
+    w.add_argument("--apps", nargs="+", default=("phold", "bulk"),
+                   choices=("phold", "bulk"),
+                   help="world flavors to warm (default: both)")
+    w.add_argument("--quiet", action="store_true")
     return p
 
 
@@ -269,6 +290,17 @@ def run_config(args) -> int:
         from . import trace
         # Device-side per-window counters, fetched once per drain point.
         state = trace.ensure_counters(state)
+
+    if args.bucket:
+        # Bucket BEFORE any mesh padding: ladder rungs divide every
+        # power-of-two device count up to 64, so the mesh pass below is
+        # normally an identity on a bucketed world (docs/shapes.md).
+        from . import shapes
+        h0 = int(state.hosts.num_hosts)
+        state, params = shapes.pad_world_to_bucket(state, params)
+        if not args.quiet and int(state.hosts.num_hosts) != h0:
+            print(f"[shadow1-tpu] bucket: {h0} -> "
+                  f"{int(state.hosts.num_hosts)} hosts", file=sys.stderr)
 
     mesh = None
     parallel_mod = None
@@ -434,10 +466,27 @@ def run_config(args) -> int:
     return 0 if int(state.err) == 0 else 2
 
 
+def warm_cmd(args) -> int:
+    from . import shapes
+    log = None
+    if not args.quiet:
+        def log(rec):  # noqa: E306
+            print(f"[shadow1-tpu] warm {rec['app']} @ "
+                  f"{rec['bucket_hosts']} hosts: lower "
+                  f"{rec['lower_s']}s, compile {rec['compile_s']}s",
+                  file=sys.stderr)
+    records = shapes.warm_buckets(buckets=args.buckets, apps=args.apps,
+                                  log=log)
+    print(json.dumps({"warmed": records}))
+    return 0
+
+
 def main(argv=None) -> int:
     args = _parser().parse_args(argv)
     if args.cmd == "run":
         return run_config(args)
+    if args.cmd == "warm":
+        return warm_cmd(args)
     return 1
 
 

@@ -119,8 +119,16 @@ def _plugin_kind(cfg, plugin_id: str) -> str:
 def build(cfg, seed: int = 1, sock_slots: int | None = None,
           pool_slab: int = 128, qdisc: str = "fifo",
           cpu_threshold_us: int = -1,
-          cpu_precision_us: int = 200, cong: str = "reno") -> Assembled:
-    """Assemble a parsed ShadowConfig into (state, params, app)."""
+          cpu_precision_us: int = 200, cong: str = "reno",
+          bucket: bool = False) -> Assembled:
+    """Assemble a parsed ShadowConfig into (state, params, app).
+
+    With `bucket=True` the assembled world is padded up to its shape
+    bucket (shapes.pad_world_to_bucket, docs/shapes.md): real-host rows
+    stay bitwise identical to the exact-size run, and configs sharing a
+    bucket reuse one compiled graph.  Host-side tables (hostnames, DNS,
+    pcap masks) keep the real host count.
+    """
     names, specs = _expand_hosts(cfg)
     h = len(names)
     if h == 0:
@@ -364,6 +372,10 @@ def build(cfg, seed: int = 1, sock_slots: int | None = None,
             app = devapp.SubstrateTx()
     else:
         app = tgen_app.Tgen()
+
+    if bucket:
+        from .. import shapes
+        state, params = shapes.pad_world_to_bucket(state, params)
 
     return Assembled(state=state, params=params, app=app, hostnames=names,
                      dns=dns, topology=topo, config=cfg,

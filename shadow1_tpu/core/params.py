@@ -79,6 +79,15 @@ class NetParams:
     # recorded when its source OR destination host is marked.  Only
     # consulted when a CaptureRing is installed.
     pcap_mask: jnp.ndarray         # [H] bool
+    # Traced REAL host count (present-or-None, the SimState.hoff
+    # pattern): installed by shapes.pad_world_to_bucket when a world is
+    # padded up to a shape bucket, so app-level global draws (phold's
+    # dst pick) see the real count while every [H] array carries padded
+    # rows.  None is a trace-time static -- un-bucketed worlds compile
+    # byte-identical graphs to before this field existed.  When present
+    # it is a runtime input, so every world padded into the same bucket
+    # shares ONE compiled graph (docs/shapes.md).
+    hosts_real: any = struct.field(pytree_node=True, default=None)  # i32 scalar | None
     # Congestion-control algorithm (reference --tcp-congestion-control,
     # tcp_cong.h hook table): STATIC -- part of the compiled step's
     # identity, so the untaken algorithm traces away.
@@ -108,6 +117,18 @@ class NetParams:
     # so tests can run both variants and assert exactly that
     # (tests/test_kernel_diet.py).
     kernel_diet: bool = struct.field(pytree_node=False, default=True)
+
+    def global_hosts(self):
+        """Global host count for app-level draws ("pick a random host"):
+        the traced `hosts_real` scalar when installed (bucket-padded
+        world, where the static row count would see the PADDED size and
+        change every draw), else the static row count (a Python int, so
+        the graph is byte-identical to pre-bucketing code).  Row counts
+        stay exact in f32 up to 2**24, far above the 1M-host ladder cap,
+        so the draw arithmetic is bitwise the same either way."""
+        if self.hosts_real is not None:
+            return self.hosts_real
+        return self.host_vertex.shape[0]
 
     @property
     def n_vertices(self) -> int:

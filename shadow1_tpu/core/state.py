@@ -927,6 +927,15 @@ def host_ids(state, dtype=I32) -> jnp.ndarray:
     return ids + state.hoff.astype(dtype)
 
 
+# Known-bad region of the TPU tunnel backend (BASELINE.md;
+# tools/repro_tunnel_crash.py r4 finding): slab >= 128 at >= 10k hosts
+# reproducibly faults the tunnel worker.  One source of truth for the
+# thresholds -- warn_known_bad_pool warns at world build and
+# shapes.bucket_for refuses to ROUND a world into the region.
+KNOWN_BAD_POOL_SLAB = 128
+KNOWN_BAD_POOL_HOSTS = 10_000
+
+
 def warn_known_bad_pool(num_hosts: int, slab: int) -> None:
     """Loud warning for the known-bad region of the TPU tunnel backend
     (BASELINE.md; tools/repro_tunnel_crash.py r4 finding): the exchange-
@@ -934,8 +943,8 @@ def warn_known_bad_pool(num_hosts: int, slab: int) -> None:
     hosts reproducibly faults the tunnel worker during the first
     simulated second.  Slab 64 is measured stable at the same scale.
     Called from make_sim_state so every world builder (config assemble,
-    sim.build_onion's slab-128 default, hand-built states) is covered."""
-    if slab >= 128 and num_hosts >= 10_000:
+    hand-built states) is covered."""
+    if slab >= KNOWN_BAD_POOL_SLAB and num_hosts >= KNOWN_BAD_POOL_HOSTS:
         import warnings
         warnings.warn(
             f"pool slab {slab} at {num_hosts} hosts is in the known-bad "

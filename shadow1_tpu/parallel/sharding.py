@@ -54,26 +54,32 @@ def _concat_rows(a, b):
         lambda x, y: jnp.concatenate([x, y], axis=0), a, b)
 
 
-def pad_state_to_mesh(state, n_devices: int):
-    """Grow the world to the next multiple of `n_devices` hosts by
-    appending INERT hosts, world-consistently: fresh (empty) rows for the
-    host/socket tables, whole fresh per-host slabs for both packet pools
-    (so `capacity // num_hosts` is unchanged), zero rows for [H]-leading
-    app leaves, and an up/neutral overlay row for netem.  Padded hosts
-    never emit (no app state, sockets closed) and anything a global app
-    draw routes at them dies at the unbound-port drop, deterministically
-    -- but note the padded world is a DIFFERENT world: global-host-count-
-    keyed draws (e.g. phold's dst pick) see the padded count, so its
-    trajectory is not bitwise-comparable to the unpadded one.  It IS
-    bitwise identical across mesh shapes that divide it.  Identity when
-    the host count already divides."""
+def pad_state_to_hosts(state, target_hosts: int, why: str):
+    """Grow the world to exactly `target_hosts` hosts by appending INERT
+    hosts, world-consistently: fresh (empty) rows for the host/socket
+    tables, whole fresh per-host slabs for both packet pools (so
+    `capacity // num_hosts` is unchanged), zero rows for [H]-leading app
+    leaves, and an up/neutral overlay row for netem.  Padded hosts never
+    emit (no app state, sockets closed) and anything a global app draw
+    routes at them dies at the unbound-port drop, deterministically.
+
+    Shared by the two padding front ends: pad_state_to_mesh (pad to the
+    next multiple of the device count; global-host-count-keyed draws see
+    the PADDED count, so the result is a DIFFERENT world -- bitwise
+    identical across mesh shapes that divide it, not to the unpadded
+    run) and shapes.pad_world_to_bucket (pad to a shape-bucket size with
+    params.hosts_real carrying the REAL count, so real-host rows stay
+    bitwise identical to the exact-size trajectory -- docs/shapes.md).
+    Identity when the host count already matches."""
     h = state.hosts.num_hosts
-    d = int(n_devices)
-    hp = -(-h // d) * d
+    hp = int(target_hosts)
     if hp == h:
         return state
+    if hp < h:
+        raise ValueError(f"pad_state_to_hosts: cannot shrink a world "
+                         f"({h} hosts -> {hp})")
     if state.hoff is not None:
-        raise ValueError("pad_state_to_mesh: state is already inside a "
+        raise ValueError("pad_state_to_hosts: state is already inside a "
                          "mesh shard (hoff set)")
     pad = hp - h
     ko = state.pool.capacity // h
@@ -118,8 +124,8 @@ def pad_state_to_mesh(state, n_devices: int):
             [log_level, jnp.zeros((pad,), log_level.dtype)])
 
     warnings.warn(
-        f"parallel: padded world from {h} to {hp} hosts (next multiple of "
-        f"{d} devices); padded leaves: {', '.join(padded)}")
+        f"parallel: padded world from {h} to {hp} hosts ({why}); "
+        f"padded leaves: {', '.join(padded)}")
     return state.replace(
         pool=_concat_rows(state.pool,
                           state_mod.make_packet_pool(
@@ -132,6 +138,16 @@ def pad_state_to_mesh(state, n_devices: int):
                                pad, state.socks.slots)),
         hosts=_concat_rows(state.hosts, state_mod.make_host_table(pad)),
         app=app, nm=nm, log_level=log_level)
+
+
+def pad_state_to_mesh(state, n_devices: int):
+    """Grow the world to the next multiple of `n_devices` hosts (see
+    pad_state_to_hosts for the padding protocol and its semantics).
+    Identity when the host count already divides."""
+    h = state.hosts.num_hosts
+    d = int(n_devices)
+    hp = -(-h // d) * d
+    return pad_state_to_hosts(state, hp, f"next multiple of {d} devices")
 
 
 # Row fill for padded NetParams leaves.  bw gets a huge-but-finite rate
@@ -149,20 +165,26 @@ _PARAM_PAD_FILL = {
 }
 
 
-def pad_params_to_mesh(params, n_devices: int):
-    """NetParams counterpart of pad_state_to_mesh: pad every [H]-leading
-    leaf with inert rows.  route_blk is NEVER padded -- its row count
+def pad_params_to_hosts(params, target_hosts: int, why: str):
+    """NetParams counterpart of pad_state_to_hosts: pad every [H]-leading
+    leaf (the _PARAM_PAD_FILL table) with inert rows up to exactly
+    `target_hosts`.  route_blk is NEVER padded here -- its row count
     encodes the vertex count (V*V for the narrow table), so extra rows
-    would corrupt routing; when its rows don't divide the mesh it
-    replicates instead (shard_params warns).  Identity when everything
-    already divides."""
-    d = int(n_devices)
+    would corrupt routing (shapes.pad_world_to_bucket re-lays it out as
+    a whole [Vb,Vb] matrix instead).  hosts_real, when present, is a
+    scalar and passes through untouched -- padding must never change the
+    world's real host count.  Identity when nothing needs rows."""
     flat, _ = jax.tree_util.tree_flatten_with_path(params)
     hv = [leaf for path, leaf in flat if _leaf_name(path) == "host_vertex"]
     if not hv:
         return params
     h = hv[0].shape[0]
-    hp = -(-h // d) * d
+    hp = int(target_hosts)
+    if hp == h:
+        return params
+    if hp < h:
+        raise ValueError(f"pad_params_to_hosts: cannot shrink params "
+                         f"({h} hosts -> {hp})")
     padded = []
 
     def pad_leaf(path, leaf):
@@ -180,9 +202,22 @@ def pad_params_to_mesh(params, n_devices: int):
     out = jax.tree_util.tree_map_with_path(pad_leaf, params)
     if padded:
         warnings.warn(
-            f"parallel: padded NetParams leaves to a multiple of {d} "
-            f"devices: {', '.join(padded)}")
+            f"parallel: padded NetParams leaves to {hp} hosts ({why}): "
+            f"{', '.join(padded)}")
     return out
+
+
+def pad_params_to_mesh(params, n_devices: int):
+    """Pad NetParams [H] leaves to the next multiple of `n_devices` (see
+    pad_params_to_hosts).  Identity when everything already divides."""
+    d = int(n_devices)
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    hv = [leaf for path, leaf in flat if _leaf_name(path) == "host_vertex"]
+    if not hv:
+        return params
+    h = hv[0].shape[0]
+    return pad_params_to_hosts(params, -(-h // d) * d,
+                               f"next multiple of {d} devices")
 
 
 def pad_world_to_mesh(state, params, n_devices: int):
@@ -243,6 +278,9 @@ PARAM_SPECS: dict[str, P] = {
     "cpu_threshold_ns": P(),
     "cpu_precision_ns": P(),
     "qdisc": P(),
+    # Traced real-host-count scalar (shapes.pad_world_to_bucket); absent
+    # (None, not a leaf) on un-bucketed worlds.
+    "hosts_real": P(),
 }
 
 

@@ -1,0 +1,27 @@
+"""Shape-polymorphic world buckets (docs/shapes.md).
+
+Compiled graphs are keyed by input shapes; this subsystem canonicalizes
+a world's shape determinants (`ShapeKey`), rounds host/vertex counts up
+a geometric ladder (`bucket_for`), and pads worlds into their bucket
+with real-host rows bitwise identical to the exact-size trajectory
+(`pad_world_to_bucket`) -- so a sweep of different-sized scenarios
+shares one compiled run_until graph instead of paying the 30-60s XLA
+compile per world.  `warm_buckets` pre-compiles the standard bucket set
+into the persistent XLA cache (`shadow1-tpu warm`).
+"""
+
+from .key import (HOST_LADDER, VERTEX_LADDER, ShapeKey, bucket_for,
+                  shape_key)
+from .bucket import pad_world_to_bucket
+from .warm import STANDARD_HOST_BUCKETS, warm_buckets
+
+__all__ = [
+    "HOST_LADDER",
+    "VERTEX_LADDER",
+    "STANDARD_HOST_BUCKETS",
+    "ShapeKey",
+    "bucket_for",
+    "pad_world_to_bucket",
+    "shape_key",
+    "warm_buckets",
+]

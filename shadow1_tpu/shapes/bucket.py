@@ -1,0 +1,82 @@
+"""Pad a world up to its shape bucket, bitwise-neutrally.
+
+`pad_world_to_bucket` reuses the mesh padding machinery
+(parallel/sharding.py pad_state_to_hosts / pad_params_to_hosts: fresh
+per-host slabs, app PAD_VALUES inert fills, up/neutral netem rows) and
+adds the two pieces mesh padding does not have:
+
+* `params.hosts_real` -- a traced i32 scalar carrying the REAL host
+  count.  App-level global draws (phold's dst pick) read it via
+  params.global_hosts(), so no draw ever changes under padding and no
+  packet ever targets a padded host.  Because it is a runtime input
+  (not a Python int baked into the graph), every world padded into the
+  same bucket shares ONE compiled run_until graph.
+
+* route_blk V-padding: the [V*V, 5] packed routing block is re-laid out
+  as a [Vb, Vb] matrix with zero rows for padded vertices.  Real
+  vertices keep their indices, n_vertices (derived from the row count)
+  becomes Vb, and padded rows are never gathered at runtime -- every
+  live packet's src/dst is a real host on a real vertex.
+
+The contract, enforced leaf-for-leaf by tests/test_shapes.py: a padded
+world's real-host rows are BITWISE identical to the exact-size world's
+trajectory at any horizon.  A world already exactly bucket-shaped
+passes through untouched (same objects), so its compiled graph -- and
+kernel counts -- are unchanged by bucketing.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+
+from ..core.state import I32
+from ..parallel import sharding as _sh
+from .key import ShapeKey, bucket_for, shape_key
+
+
+def _pad_route_blk(blk, v: int, vb: int):
+    """Re-lay the packed [v*v, C] routing block out as [vb*vb, C] with
+    zero rows for padded vertex pairs (latency 0 = "no route"; never
+    gathered at runtime).  Row-major (vs, vd) indexing is preserved for
+    real pairs because the whole matrix moves, not just rows."""
+    c = blk.shape[1]
+    m = jnp.zeros((vb, vb, c), blk.dtype)
+    m = m.at[:v, :v, :].set(blk.reshape(v, v, c))
+    return m.reshape(vb * vb, c)
+
+
+def pad_world_to_bucket(state, params, bucket: ShapeKey | None = None):
+    """Pad (state, params) up to `bucket` (default: bucket_for of the
+    world's own ShapeKey).  Returns the padded pair; identity -- the
+    same objects, hence byte-identical graphs -- when the world already
+    sits exactly on the bucket's (hosts, vertices)."""
+    key = shape_key(state, params)
+    if bucket is None:
+        bucket = bucket_for(key)
+    if bucket.hosts < key.hosts or bucket.vertices < key.vertices:
+        raise ValueError(f"pad_world_to_bucket: bucket ({bucket.hosts} "
+                         f"hosts, {bucket.vertices} vertices) is smaller "
+                         f"than the world ({key.hosts}, {key.vertices})")
+    if bucket.hosts == key.hosts and bucket.vertices == key.vertices:
+        return state, params
+    if params.hosts_real is not None:
+        raise ValueError("pad_world_to_bucket: params.hosts_real is "
+                         "already set -- the world is already bucket-"
+                         "padded; bucket once, then pad_world_to_mesh")
+    # The real count rides params as a traced scalar BEFORE any row
+    # padding: from here on, "how many hosts" and "how many rows" are
+    # different questions with different answers.
+    params = params.replace(hosts_real=jnp.asarray(key.hosts, I32))
+    if bucket.vertices > key.vertices:
+        warnings.warn(
+            f"shapes: padded routing matrix from {key.vertices} to "
+            f"{bucket.vertices} vertices (bucket)")
+        params = params.replace(route_blk=_pad_route_blk(
+            params.route_blk, key.vertices, bucket.vertices))
+    if bucket.hosts > key.hosts:
+        why = f"shape bucket {bucket.hosts}"
+        state = _sh.pad_state_to_hosts(state, bucket.hosts, why)
+        params = _sh.pad_params_to_hosts(params, bucket.hosts, why)
+    return state, params

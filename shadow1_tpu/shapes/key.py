@@ -1,0 +1,153 @@
+"""Shape identity and bucketing for compiled worlds.
+
+XLA compiles one executable per distinct input SHAPE (plus the static
+flags baked into the graph), and a run_until compile costs ~30-60s on
+the tunnel backend -- so a sweep of dozens of world configs pays the
+compile tax dozens of times (ROADMAP: "kill the 35s-per-world compile
+tax").  This module makes that tax amortizable:
+
+* `ShapeKey` canonicalizes every determinant of the compiled run_until
+  graph's shape: host count H, the per-host pool/inbox slabs, the packed
+  block widths (18 UDP-only / 28 TCP), socket slots, the routing vertex
+  count V (route_blk is [V*V, 5]), the static NetParams flags
+  (cong/has_iface_buf/pds_trail/has_loss/has_jitter/kernel_diet, with
+  route_narrow implied by has_jitter), and which present-or-None blocks
+  ride the state (nm/cap/log/log_level/tr/fr/hoff) with their leaf
+  shapes.
+
+* `bucket_for(key)` rounds H (and V) up a small geometric ladder so
+  different-sized scenarios land on the SAME shape; pad_world_to_bucket
+  (bucket.py) then pads the world to the bucket while keeping real-host
+  rows bitwise identical to the exact-size trajectory.
+
+Two worlds sharing a bucketed ShapeKey share one compiled graph
+PROVIDED their jit statics also match: the app object (__eq__/__hash__
+over its config) and the NetParams statics are part of the jit cache
+key.  Builders that want sharing should size pools per-slab
+(pool_capacity = num_hosts * slab), since a fixed total capacity makes
+the slab -- a shape determinant -- vary with H.  See docs/shapes.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+
+import jax
+
+from ..core.state import KNOWN_BAD_POOL_HOSTS, KNOWN_BAD_POOL_SLAB
+
+# Geometric host ladder (x4 per rung): small enough that padding waste
+# is bounded (<4x rows, and padded rows are inert so they cost little
+# work), large enough that a whole scenario sweep lands on a handful of
+# buckets.  Every rung is divisible by any power-of-two device count up
+# to 64, so bucketed worlds compose with pad_world_to_mesh without a
+# second padding pass (docs/parallel.md).
+HOST_LADDER = (64, 256, 1024, 4096, 16384, 65536, 262144, 1048576)
+
+# Vertex ladder for route_blk's [V*V] row axis: quadratic cost, so it
+# gets smaller rungs.  Builders cap V at 256 (sim.build_phold) but
+# config topologies can exceed it.
+VERTEX_LADDER = (16, 64, 256, 1024, 4096)
+
+# The present-or-None SimState blocks whose presence (and shape) changes
+# the traced graph.  `app` is keyed separately by type + leaf shapes.
+_STATE_BLOCKS = ("nm", "cap", "log", "log_level", "tr", "fr", "hoff")
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeKey:
+    """Canonical shape identity of a (state, params, app) world.  Two
+    worlds with equal ShapeKeys (and equal jit statics: app config,
+    NetParams flags already folded in here) trace identical graphs."""
+
+    hosts: int
+    vertices: int
+    pool_slab: int
+    inbox_slab: int
+    sock_slots: int
+    cols: int           # packed pool/outbox width: 18 UDP-only, 28 TCP
+    icols: int          # inbox width: 14 UDP-only, 24 TCP
+    has_loss: bool
+    has_jitter: bool
+    kernel_diet: bool
+    cong: str
+    has_iface_buf: bool
+    pds_trail: bool
+    app: str | None             # app state type name, or None
+    blocks: tuple               # ((name, leaf-shape signature), ...)
+
+    @property
+    def route_narrow(self) -> bool:
+        """Jitter-free worlds gather the narrow 3-column routing rows
+        (core/params.py route_narrow); implied by has_jitter."""
+        return not self.has_jitter
+
+
+def _leaf_shapes(obj):
+    """Shape signature of a pytree block: the tuple of its leaf shapes.
+    Good enough to distinguish any two blocks that trace differently."""
+    return tuple(tuple(getattr(leaf, "shape", ()))
+                 for leaf in jax.tree_util.tree_leaves(obj))
+
+
+def shape_key(state, params) -> ShapeKey:
+    """Read the ShapeKey off a built world."""
+    h = int(state.hosts.num_hosts)
+    blocks = tuple(
+        (name, _leaf_shapes(getattr(state, name)))
+        for name in _STATE_BLOCKS if getattr(state, name) is not None)
+    return ShapeKey(
+        hosts=h,
+        vertices=int(params.n_vertices),
+        pool_slab=int(state.pool.capacity) // h,
+        inbox_slab=int(state.inbox.capacity) // h,
+        sock_slots=int(state.socks.slots),
+        cols=int(state.pool.blk.shape[1]),
+        icols=int(state.inbox.blk.shape[1]),
+        has_loss=bool(params.has_loss),
+        has_jitter=bool(params.has_jitter),
+        kernel_diet=bool(params.kernel_diet),
+        cong=str(params.cong),
+        has_iface_buf=bool(params.has_iface_buf),
+        pds_trail=bool(params.pds_trail),
+        app=(type(state.app).__name__ if state.app is not None else None),
+        blocks=blocks,
+    )
+
+
+def _round_up(n: int, ladder) -> int:
+    for rung in ladder:
+        if rung >= n:
+            return rung
+    return n
+
+
+def bucket_for(key: ShapeKey, ladder=HOST_LADDER) -> ShapeKey:
+    """The bucket a world belongs to: hosts rounded up the geometric
+    ladder, vertices rounded up VERTEX_LADDER; every other determinant
+    (slab, widths, flags, blocks) is preserved exactly -- rounding a
+    slab is trajectory-visible (overflow drops, slot indices), so slabs
+    never bucket.
+
+    Slab-aware (core/state.py known-bad region): when rounding hosts up
+    would move a world INTO the known-bad (hosts, slab) region that the
+    exact-size world is not in, the host count stays exact (warning) --
+    bucketing must never fabricate a backend-faulting configuration.
+    Worlds already in the region bucket normally (they were warned at
+    build time).  Beyond the ladder the host count also stays exact."""
+    hb = _round_up(key.hosts, ladder)
+    slab = max(key.pool_slab, key.inbox_slab)
+    if (hb != key.hosts and slab >= KNOWN_BAD_POOL_SLAB
+            and hb >= KNOWN_BAD_POOL_HOSTS
+            and key.hosts < KNOWN_BAD_POOL_HOSTS):
+        warnings.warn(
+            f"shapes: not bucketing {key.hosts} hosts up to {hb}: slab "
+            f"{slab} at >={KNOWN_BAD_POOL_HOSTS} hosts is the known-bad "
+            f"tunnel-backend region (core/state.py warn_known_bad_pool);"
+            f" rebuild with pool_slab<{KNOWN_BAD_POOL_SLAB} to bucket")
+        return key
+    vb = _round_up(key.vertices, VERTEX_LADDER)
+    if hb == key.hosts and vb == key.vertices:
+        return key
+    return dataclasses.replace(key, hosts=hb, vertices=vb)

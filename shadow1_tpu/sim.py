@@ -190,22 +190,34 @@ def add_churn(state, params, rate_per_s: float,
     return netem.install(state, params, tl)
 
 
-def run(state, params, app, until=None, profiler=None, devices=None):
+def run(state, params, app, until=None, profiler=None, devices=None,
+        bucket=False):
     """Run to `until` (default: params.stop_time).
 
     With `profiler` (a trace.Profiler), the run is profiled: the
     profiler is installed, device counters ride the state, and the run
     executes through the chunked launcher so device spans are recorded.
 
+    With `bucket=True` the world is first padded up to its shape bucket
+    (shapes.pad_world_to_bucket, docs/shapes.md): real-host rows stay
+    bitwise-identical to the exact-size run, and every world sharing
+    the bucket reuses one compiled graph.
+
     With `devices=N` (N > 1) the run shards across the first N visible
     devices (parallel.mesh_run_until, docs/parallel.md): the world is
     padded to a multiple of N hosts if needed, and the trajectory is
     bitwise-identical to a single-device run of the (padded) world.
-    `profiler` composes with `devices`: the mesh launcher records the
-    same `device_step` spans, and the counter deltas finalize across
-    shards (docs/observability.md), so telemetry rows match the
-    single-device run bitwise.
+    `bucket` composes with `devices` -- bucket first, then mesh-pad the
+    bucketed size (ladder rungs divide every power-of-two device count
+    up to 64, so the mesh pass is normally an identity).  `profiler`
+    composes with `devices`: the mesh launcher records the same
+    `device_step` spans, and the counter deltas finalize across shards
+    (docs/observability.md), so telemetry rows match the single-device
+    run bitwise.
     """
+    if bucket:
+        from . import shapes
+        state, params = shapes.pad_world_to_bucket(state, params)
     t = params.stop_time if until is None else until
     if devices is not None and int(devices) > 1:
         import jax as _jax
@@ -251,7 +263,7 @@ def build_onion(num_circuits: int,
                 stop_time: int = 120 * simtime.SIMTIME_ONE_SECOND,
                 seed: int = 1,
                 sock_slots: int = 8,
-                pool_slab: int = 128,
+                pool_slab: int = 64,
                 inbox_slab: int | None = None,
                 bw_Bps: int = 1 << 27):
     """Tor-like onion-circuit world (apps/onion.py): `num_circuits` chains

@@ -237,6 +237,13 @@ class Profiler:
             "compile": {"count": len(self.compiles),
                         "total_s": round(sum(d for _t, d in self.compiles),
                                          3)},
+            # Flat aliases for benchdiff gating (tools/benchdiff.py):
+            # "compiles" is a graph property (0-tolerance -- a new
+            # compile in a sweep means a shape bucket broke), while
+            # "compile_ms" is machine-bound wall time.
+            "compiles": len(self.compiles),
+            "compile_ms": round(
+                sum(d for _t, d in self.compiles) * 1e3, 1),
         }
         if self.counter_samples:
             out["device_counters"] = self.counter_samples[-1][1]

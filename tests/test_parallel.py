@@ -213,6 +213,14 @@ class TestParamSpecs:
         _, worlds["netem"] = netem.install(st, params, tl)
         _, worlds["narrow-pool"], _ = sim.build_phold(
             16, stop_time=SEC, pool_capacity=1 << 7)
+        # Bucket-padded flavor: the only one whose hosts_real is an
+        # actual leaf (None elsewhere, hence invisible to the audit).
+        from shadow1_tpu import shapes
+        st, params, _ = sim.build_phold(12, stop_time=SEC,
+                                        pool_capacity=12 * 8)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            _, worlds["bucketed"] = shapes.pad_world_to_bucket(st, params)
 
         seen = set()
         for flavor, params in worlds.items():

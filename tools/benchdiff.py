@@ -15,6 +15,13 @@ Direction-aware comparison: throughput metrics (events/sec) regress when
 they go DOWN; latency/wall metrics (wall_sec, per-phase p50/p95) regress
 when they go UP.  Any regression beyond --threshold percent prints a
 flagged row and exits nonzero, so CI / future rounds can gate on it.
+
+The compile COUNT ("compiles", stamped by trace.Profiler.metrics() and
+bench.py's profile block) gates at zero tolerance regardless of
+--threshold: it is a property of the traced graphs (shape buckets,
+docs/shapes.md), so any growth is a real regression.  The compile WALL
+time ("compile_ms") is machine-bound and only gates between same-env
+runs, like the other wall metrics.
 """
 
 from __future__ import annotations
@@ -27,8 +34,8 @@ import sys
 _HIGHER_BETTER = ("events_per_sec", "value", "vs_baseline",
                   "events_per_microstep")
 _LOWER_BETTER = ("wall_sec", "wall_s", "p50_ms", "p95_ms", "max_ms",
-                 "total_s", "compile_s", "stage_emissions_ms",
-                 "alltoall_ms")
+                 "total_s", "compile_s", "compile_ms",
+                 "stage_emissions_ms", "alltoall_ms")
 
 # Machine-bound leaves: wall-clock / throughput numbers that only
 # compare between runs on the same backend + core count.  Across
@@ -39,7 +46,7 @@ _LOWER_BETTER = ("wall_sec", "wall_s", "p50_ms", "p95_ms", "max_ms",
 # compiled graph / trajectory and gate regardless.
 _MACHINE_BOUND = ("events_per_sec", "value", "vs_baseline", "wall_sec",
                   "wall_s", "p50_ms", "p95_ms", "max_ms", "total_s",
-                  "compile_s", "stage_emissions_ms")
+                  "compile_s", "compile_ms", "stage_emissions_ms")
 
 # Whole machine-bound subtrees: everything the flight recorder / mesh
 # telemetry times (exchange probe ms, window rates) depends on the
@@ -51,6 +58,13 @@ _MACHINE_BOUND_PREFIXES = ("profile.flight.", "mesh.")
 def _machine_bound(name: str) -> bool:
     return (name.rsplit(".", 1)[-1] in _MACHINE_BOUND
             or name.startswith(_MACHINE_BOUND_PREFIXES))
+
+# Zero-tolerance graph leaves: the compile COUNT is a property of the
+# traced graphs (shape buckets, docs/shapes.md), not of the machine --
+# one extra compile in a sweep means a bucket or a jit static broke.
+# Gates always (no --kernels opt-in: a compile count, unlike a kernel
+# count, is comparable across backends and jax versions) at 0%.
+_GRAPH_ZERO = ("compiles",)
 
 # Compiled-kernel-count leaves (tools/kernelcount.py reports, standalone
 # or embedded under profile.kernelcount): deterministic integers, so
@@ -176,25 +190,29 @@ def diff(old: dict, new: dict, threshold_pct: float,
     fo, fn = _flatten(old), _flatten(new)
     rows, regressions = [], []
     for name in sorted(set(fo) & set(fn)):
+        leaf = name.rsplit(".", 1)[-1]
         kernel = _is_kernel(name)
         if kernel and not kernels:
             continue
-        gated = not kernel or name.rsplit(".", 1)[-1] in _KERNEL_GATED
+        zero_tol = leaf in _GRAPH_ZERO
+        gated = not kernel or leaf in _KERNEL_GATED
         if not same_env and _machine_bound(name):
             gated = False
-        d = "down" if kernel else _direction(name)
+        d = "down" if (kernel or zero_tol) else _direction(name)
         if d is None:
             continue
         a, b = fo[name], fn[name]
         if a == 0:
-            # A zero-count kernel metric can still regress by appearing.
-            if not (kernel and b > 0):
+            # A zero-count kernel/graph metric can still regress by
+            # appearing.
+            if not ((kernel or zero_tol) and b > 0):
                 continue
             pct, worse = float("inf"), float("inf")
         else:
             pct = (b - a) / abs(a) * 100
             worse = -pct if d == "up" else pct
-        limit = kernel_threshold_pct if kernel else threshold_pct
+        limit = (0.0 if zero_tol
+                 else kernel_threshold_pct if kernel else threshold_pct)
         flag = gated and worse > limit
         rows.append((name, a, b, pct, flag))
         if flag:

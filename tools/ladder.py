@@ -13,6 +13,9 @@ excluded; steady-state wall time per simulated second reported):
   rung 8: phold on an 8-device mesh       (parallel.mesh_run_until on 8
           virtual CPU devices; FAILS on any bitwise trajectory
           divergence from single-device -- docs/parallel.md)
+  rung 9: shape-bucket compile sharing    (three differently-sized phold
+          worlds through shapes.pad_world_to_bucket; FAILS if run_until
+          compiles more than one graph for the sweep -- docs/shapes.md)
 
     python tools/ladder.py [rung ...]     # default: 1 2 3 5 6
 """
@@ -168,8 +171,51 @@ def rung_multichip(n_devices: int = 8):
     return graft.dryrun_multichip(n_devices)
 
 
+def rung_buckets(sizes=(40, 48, 56), slab: int = 8, span_s: int = 2):
+    """Three differently-sized phold worlds padded into one shape bucket
+    (shapes.pad_world_to_bucket) and run back to back.  Asserts the
+    whole sweep costs at most ONE run_until compile -- the property the
+    shapes subsystem exists to provide (docs/shapes.md).  Also reports
+    the profiler's compile count/wall for the sweep."""
+    from shadow1_tpu import shapes, trace
+
+    worlds = []
+    for h in sizes:
+        s, p, a = sim.build_phold(num_hosts=h, pool_capacity=h * slab,
+                                  stop_time=span_s * SEC)
+        worlds.append(shapes.pad_world_to_bucket(s, p) + (a,))
+    buckets = {int(s.hosts.num_hosts) for s, _p, _a in worlds}
+    # Profile ONLY the run loop: world building compiles a pile of tiny
+    # host-side ops that would drown the number under test (how many
+    # graphs the sweep itself costs).  Scalar pulls happen after.
+    profiler = trace.install(trace.Profiler())
+    jit_before = engine.run_until._cache_size()
+    t0 = time.perf_counter()
+    outs = [engine.run_until(s, p, a, span_s * SEC) for s, p, a in worlds]
+    jax.block_until_ready(outs)
+    wall = time.perf_counter() - t0
+    graphs = engine.run_until._cache_size() - jit_before
+    m = profiler.metrics()
+    trace.install(None)
+    sent = [int(out.hosts.pkts_sent.sum()) for out in outs]
+    for out in outs:
+        assert int(out.err) == 0, f"err flags {int(out.err)}"
+    assert graphs <= len(buckets), (
+        f"bucket sweep compiled {graphs} run_until graphs for "
+        f"{len(buckets)} bucket(s): shape bucketing is broken")
+    return {
+        "world_sizes": list(sizes),
+        "buckets": sorted(buckets),
+        "run_until_graphs": graphs,
+        "compiles": m["compiles"],
+        "compile_ms": m["compile_ms"],
+        "wall_seconds": round(wall, 3),
+        "pkts_sent": sent,
+    }
+
+
 def main(rungs):
-    unknown = set(rungs) - {"1", "2", "3", "4", "5", "6", "7", "8"}
+    unknown = set(rungs) - {"1", "2", "3", "4", "5", "6", "7", "8", "9"}
     if unknown:
         raise SystemExit(f"unknown ladder rungs: {sorted(unknown)}")
     results = {"backend": jax.default_backend()}
@@ -204,6 +250,8 @@ def main(rungs):
         record("phold_16k_churn", rung_phold_churn)
     if "8" in rungs:
         record("phold_multichip", rung_multichip)
+    if "9" in rungs:
+        record("phold_buckets", rung_buckets)
     print(json.dumps(results))
 
 
