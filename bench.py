@@ -214,6 +214,10 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
             "rx_batch": app.rx_batch,
             "app_tx_lanes": int(getattr(app, "app_tx_lanes", 1)),
             "netem": netem_cfg,
+            # Flowscope stamp: benchdiff refuses a sampled-vs-unsampled
+            # compare (the ring writes change the traced graph), like
+            # the netem/flight refusals.  bench.py never samples.
+            "scope": None,
         },
         # Wall-clock numbers are only comparable between runs on the
         # same backend and core count; benchdiff downgrades machine-
@@ -382,6 +386,7 @@ def main_multichip(n_devices: int, gate_against: str | None = None) -> int:
             # flight config differs (recorder on/off changes the traced
             # graph), mirroring the netem refusal.
             "flight": top.get("flight"),
+            "scope": None,
         },
         "env": {
             "backend": top["backend"],

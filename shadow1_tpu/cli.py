@@ -123,6 +123,17 @@ def _parser():
                         "observability stack (--pcap, --log-level, "
                         "--profile, heartbeats) runs sharded; only "
                         "real-process plugins remain single-device")
+    r.add_argument("--scope", metavar="SPEC", default=None,
+                   help="flowscope: sample per-flow TCP state (cwnd, "
+                        "ssthresh, srtt, inflight, retransmits, bytes) "
+                        "and/or per-host link state (bytes forwarded, "
+                        "queue depth, netem-scaled capacity, drops) on "
+                        "the device at a sim-time cadence, drained to "
+                        "flows.jsonl/links.jsonl in the data directory.  "
+                        "SPEC is 'flows[,links][:interval]', e.g. "
+                        "'flows', 'flows,links:50ms' (default interval "
+                        "100ms).  Sampling never perturbs the "
+                        "trajectory; see docs/observability.md")
 
     w = sub.add_parser(
         "warm",
@@ -133,8 +144,12 @@ def _parser():
                    help="host bucket sizes to warm (default: the "
                         "standard set, shapes.STANDARD_HOST_BUCKETS)")
     w.add_argument("--apps", nargs="+", default=("phold", "bulk"),
-                   choices=("phold", "bulk"),
-                   help="world flavors to warm (default: both)")
+                   choices=("phold", "bulk", "tgen", "onion", "gossip",
+                            "bulk-scope"),
+                   help="world flavors to warm (default: phold + bulk; "
+                        "tgen/onion/gossip cover the example-ladder "
+                        "worlds, bulk-scope the --scope-sampled variant "
+                        "so flowscope runs hit the warm cache too)")
     w.add_argument("--quiet", action="store_true")
     return p
 
@@ -150,6 +165,19 @@ def run_config(args) -> int:
             return 2
         from . import trace
         profiler = trace.install(trace.Profiler(sync=True))
+
+    scope_kw = None
+    if args.scope:
+        if not args.data_directory:
+            print("error: --scope requires --data-directory",
+                  file=sys.stderr)
+            return 2
+        from . import trace as _trace_mod
+        try:
+            scope_kw = _trace_mod.parse_scope_spec(args.scope)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
 
     t_wall = time.perf_counter()
     asm = assemble.load(args.config, seed=args.seed,
@@ -342,6 +370,23 @@ def run_config(args) -> int:
             __import__("os").path.join(args.data_directory,
                                        "windows.jsonl"))
 
+    scope = None
+    if scope_kw is not None:
+        # Flowscope sampling block (same AFTER-mesh-padding rule: each
+        # shard owns a ring segment sized off the padded host count).
+        from . import trace as _trace_mod
+        _os_s = __import__("os")
+        state = _trace_mod.ensure_flowscope(state, shards=n_dev,
+                                            **scope_kw)
+        scope = _trace_mod.ScopeDrain(
+            flows_path=_os_s.path.join(args.data_directory, "flows.jsonl")
+            if scope_kw["flows"] else None,
+            links_path=_os_s.path.join(args.data_directory, "links.jsonl")
+            if scope_kw["links"] else None,
+            real_hosts=len(asm.hostnames))
+        if not args.quiet:
+            print(f"[shadow1-tpu] scope: {args.scope}", file=sys.stderr)
+
     progress = None
     if args.progress:
         from .observe import Progress
@@ -371,6 +416,8 @@ def run_config(args) -> int:
             trace.fetch_counters(state, profiler)
         if flight is not None:
             flight.drain(state, profiler)
+        if scope is not None:
+            scope.drain(state, profiler)
         if progress is not None:
             progress.update(state, t)
     if progress is not None:
@@ -425,6 +472,13 @@ def run_config(args) -> int:
     if drain is not None:
         drain.drain(state)
         drain.close()
+    if scope is not None:
+        scope.drain(state, profiler)
+        scope.close()
+        summary["net"] = scope.summary()
+        if profiler is not None:
+            profiler.set_scope(scope.flow_rows, scope.link_rows,
+                               summary["net"])
     if tracker is not None:
         tracker.summary(summary, state)
     if substrate is not None:

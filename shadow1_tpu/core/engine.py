@@ -755,6 +755,125 @@ def _fr_record(state: SimState, snap, ws, we) -> SimState:
 
 
 # ---------------------------------------------------------------------------
+# Flowscope: cadence-gated flow/link sampling (state.FlowScope)
+# ---------------------------------------------------------------------------
+
+
+def _u32_dist(a, b):
+    """i32 distance a-b in u32 sequence space (local copy of the
+    transport's wrap-safe diff; core must not import transport)."""
+    return (a.astype(U32) - b.astype(U32)).astype(I32)
+
+
+def _ring_append(arrays, values, tot0, c, mask):
+    """Masked bulk append into one ring segment (the _log_append
+    recipe): first-`c`-of-batch deterministic overflow, drop-sentinel
+    scatter.  Returns (updated arrays dict, n_new, n_lost)."""
+    rank = jnp.cumsum(mask) - 1
+    n_tot = jnp.sum(mask).astype(I64)
+    n_new = jnp.minimum(n_tot, c)
+    pos = ((tot0 + rank) % c).astype(I32)
+    idx = jnp.where(mask & (rank < c), pos, c)  # c = dropped write
+    out = {k: arrays[k].at[idx].set(v.reshape(-1).astype(arrays[k].dtype),
+                                    mode="drop")
+           for k, v in values.items()}
+    return out, n_new, n_tot - n_new
+
+
+def _scope_sample(state: SimState, ctx, we) -> SimState:
+    """One flowscope sample epoch, taken when the closing window reached
+    the cadence boundary (`we >= next_due`); otherwise an exact no-op.
+    Traced away entirely when no scope block is installed.
+
+    Flow rows: every TCP socket past LISTEN (handshake through
+    teardown) on this shard's hosts.  Link rows: every local host NIC.
+    Host ids are GLOBAL; rows land in this shard's ring segment under
+    its own cursor.  `we` is uniform across shards (pmin'd window
+    predicates) and next_due/samples replicated, so every shard takes
+    the same branch here -- the cond is collective-safe."""
+    from .state import SOCK_TCP, TCPS_CLOSED, TCPS_LISTEN
+
+    scope = state.scope
+    if scope.f_total.ndim == 1 and scope.f_total.shape[0] != 1:
+        raise ValueError(
+            "sharded flowscope outside a mesh: a block built with "
+            "make_flowscope(shards=N) only runs under "
+            "parallel.mesh_run_until (each shard needs its own cursor "
+            "slice); build it with shards=1 for single-device runs")
+
+    socks, hosts = state.socks, state.hosts
+    h = hosts.num_hosts
+    bw_up = ctx[0]
+    gids = host_ids(state, I32)
+
+    def _take(scope):
+        if scope.sample_flows:
+            s_n = socks.slots
+            live = (socks.stype == SOCK_TCP) & \
+                (socks.tcp_state != TCPS_CLOSED) & \
+                (socks.tcp_state != TCPS_LISTEN)
+            fm = live.reshape(-1)
+            inflight = _u32_dist(socks.snd_nxt, socks.snd_una)
+            acked = jnp.maximum(
+                socks.bytes_sent - jnp.maximum(inflight, 0).astype(I64), 0)
+            c = scope.flow_capacity
+            arrays = {k: getattr(scope, "f_" + k) for k in (
+                "time", "host", "slot", "peer", "cwnd", "ssthresh",
+                "srtt", "inflight", "retx", "acked", "sent", "recv")}
+            values = {
+                "time": jnp.broadcast_to(we, (h * s_n,)),
+                "host": jnp.broadcast_to(gids[:, None], (h, s_n)),
+                "slot": jnp.broadcast_to(
+                    jnp.arange(s_n, dtype=I32)[None, :], (h, s_n)),
+                "peer": socks.peer_host,
+                "cwnd": socks.cwnd,
+                "ssthresh": socks.ssthresh,
+                "srtt": socks.srtt,
+                "inflight": inflight,
+                "retx": socks.retx_segs,
+                "acked": acked,
+                "sent": socks.bytes_sent,
+                "recv": socks.bytes_recv,
+            }
+            out, n_new, n_lost = _ring_append(
+                arrays, values, scope.f_total.reshape(()), c, fm)
+            scope = scope.replace(
+                f_total=scope.f_total + n_new,
+                f_lost=scope.f_lost + n_lost,
+                **{"f_" + k: v for k, v in out.items()})
+
+        if scope.sample_links:
+            c = scope.link_capacity
+            arrays = {k: getattr(scope, "l_" + k) for k in (
+                "time", "host", "tx", "rx", "qdepth", "cap", "drops")}
+            values = {
+                "time": jnp.broadcast_to(we, (h,)),
+                "host": gids,
+                "tx": hosts.bytes_sent,
+                "rx": hosts.bytes_recv,
+                "qdepth": hosts.tx_queued + hosts.rx_queued,
+                "cap": bw_up.astype(I64),
+                "drops": (hosts.pkts_dropped_inet
+                          + hosts.pkts_dropped_router
+                          + hosts.pkts_dropped_pool),
+            }
+            out, n_new, n_lost = _ring_append(
+                arrays, values, scope.l_total.reshape(()), c,
+                jnp.ones((h,), bool))
+            scope = scope.replace(
+                l_total=scope.l_total + n_new,
+                l_lost=scope.l_lost + n_lost,
+                **{"l_" + k: v for k, v in out.items()})
+
+        return scope.replace(
+            samples=scope.samples + 1,
+            next_due=(we // scope.interval + 1) * scope.interval)
+
+    scope = jax.lax.cond(we >= scope.next_due, _take, lambda s: s, scope)
+    return state.replace(scope=scope)
+
+
+# ---------------------------------------------------------------------------
 # Phase A: inbox enqueue -> NIC receive (token bucket + CoDel) -> delivery
 # ---------------------------------------------------------------------------
 
@@ -1735,6 +1854,10 @@ def run_until_impl(state: SimState, params, app, t_target):
         st = st.replace(now=we, n_windows=st.n_windows + 1)
         if st.fr is not None:
             st = _fr_record(st, fr_snap, ws, we)
+        if st.scope is not None:
+            # Sample at window close: the cadence check and cursors are
+            # replicated, so every shard takes the same branch.
+            st = _scope_sample(st, ctx, we)
         return st, t_h, gmin, outbox_pending(st)
 
     t_h0, gmin0 = scan(state)

@@ -18,6 +18,13 @@ TPU-shaped differences:
 * CoDel drops at most one packet per dequeue; the engine re-ticks the
   host at the same instant to continue draining, which reproduces the
   reference's dequeue-while-dropping loop across micro-steps.
+
+The rate fed to `time_until` is the netem-scaled effective uplink rate
+(netem.apply.effective_rates), and that same per-window value is what
+the flowscope link ring records as `cap_Bps` (`--scope links`,
+engine._scope_sample) -- so link-utilization numbers in
+tools/parse.py / plot.py are fractions of the capacity the NIC actually
+enforced during that window, faults included.
 """
 
 from __future__ import annotations

@@ -838,6 +838,146 @@ def make_flight_recorder(capacity: int = 4096,
 
 
 # ---------------------------------------------------------------------------
+# Flowscope (per-flow TCP + per-link NIC telemetry; trace.ScopeDrain)
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class FlowScope:
+    """Device-resident network telemetry sampler: a FLOW ring of
+    per-sampled-socket TCP rows and a LINK ring of per-host-NIC rows,
+    both appended inside the compiled window loop at a sim-time cadence
+    (`interval`) and drained at chunk boundaries (trace.ScopeDrain).
+    Present in SimState only when installed (trace.ensure_flowscope),
+    so scope-less runs trace byte-identical graphs -- the same
+    present-or-None contract as cap/log/tr/fr/nm.
+
+    Rows carry CUMULATIVE lifetime counters (bytes sent/recv/acked,
+    retransmitted segments, forwarded bytes, drops), so a ring wrap
+    loses time resolution but never totals -- the newest surviving row
+    of a flow or link still states its exact lifetime sums.  `f_total`/
+    `l_total` count lifetime appends (the drain's wrap accounting) and
+    `samples` counts sample epochs; `f_lost`/`l_lost` count rows a
+    single oversized epoch could not fit (size the rings above
+    sampled-rows-per-epoch to keep them zero).
+
+    Host ids are GLOBAL (host_ids: shifted by `hoff` under a mesh).
+    Under a mesh each shard samples its local hosts/sockets into its
+    own ring segment with its own cursor slice (make_flowscope
+    shards=N, the cap/log layout); the drain merges segments in
+    sim-time order.  `interval`/`next_due`/`samples` are replicated --
+    uniform window predicates advance them identically on every shard.
+
+    Row timestamps are window-quantized (samples fire at the close of
+    the first window that reaches `next_due`, stamped at the window
+    end), so the exact row times depend on windowing but never on
+    chunking -- and sampling never perturbs the simulation itself
+    (bitwise trajectory-neutral; tests/test_flowscope.py)."""
+
+    interval: jnp.ndarray   # i64 scalar: sampling cadence (sim ns)
+    next_due: jnp.ndarray   # i64 scalar: next sample epoch boundary
+    samples: jnp.ndarray    # i64 scalar: lifetime sample epochs taken
+
+    # Flow ring [Cf]: one row per sampled ESTABLISHED-ish TCP socket.
+    f_time: jnp.ndarray      # [Cf] i64 sample time (window end)
+    f_host: jnp.ndarray      # [Cf] i32 GLOBAL host id
+    f_slot: jnp.ndarray      # [Cf] i32 socket slot (host+slot+peer = flow)
+    f_peer: jnp.ndarray      # [Cf] i32 peer host id
+    f_cwnd: jnp.ndarray      # [Cf] i32 congestion window (bytes)
+    f_ssthresh: jnp.ndarray  # [Cf] i32
+    f_srtt: jnp.ndarray      # [Cf] i64 smoothed RTT (ns, 0 = no sample)
+    f_inflight: jnp.ndarray  # [Cf] i32 bytes in flight (snd_nxt - snd_una)
+    f_retx: jnp.ndarray      # [Cf] i32 lifetime retransmitted segments
+    f_acked: jnp.ndarray     # [Cf] i64 lifetime bytes acked (sent-inflight)
+    f_sent: jnp.ndarray      # [Cf] i64 lifetime stream bytes sent (no retx)
+    f_recv: jnp.ndarray      # [Cf] i64 lifetime stream bytes received
+    f_total: jnp.ndarray     # i64 scalar | [D]: lifetime rows appended
+    f_lost: jnp.ndarray      # i64 scalar | [D]: rows dropped (epoch > ring)
+
+    # Link ring [Cl]: one row per host NIC per sample epoch.
+    l_time: jnp.ndarray      # [Cl] i64 sample time (window end)
+    l_host: jnp.ndarray      # [Cl] i32 GLOBAL host id
+    l_tx: jnp.ndarray        # [Cl] i64 lifetime bytes forwarded (sent)
+    l_rx: jnp.ndarray        # [Cl] i64 lifetime bytes received
+    l_qdepth: jnp.ndarray    # [Cl] i32 packets parked (tx+rx queues)
+    l_cap: jnp.ndarray       # [Cl] i64 netem-scaled up-link capacity (B/s)
+    l_drops: jnp.ndarray     # [Cl] i64 lifetime drops (inet+router+pool)
+    l_total: jnp.ndarray     # i64 scalar | [D]: lifetime rows appended
+    l_lost: jnp.ndarray      # i64 scalar | [D]: rows dropped
+
+    # Static enables (part of the jit cache key, like block presence):
+    # a disabled ring's sampling pass traces away entirely and its slot
+    # arrays shrink to one slot per shard.
+    sample_flows: bool = struct.field(pytree_node=False, default=True)
+    sample_links: bool = struct.field(pytree_node=False, default=True)
+
+    @property
+    def flow_capacity(self) -> int:
+        return self.f_time.shape[0]
+
+    @property
+    def link_capacity(self) -> int:
+        return self.l_time.shape[0]
+
+    @property
+    def n_shards(self) -> int:
+        return 1 if self.f_total.ndim == 0 else self.f_total.shape[0]
+
+
+def make_flowscope(flow_capacity: int = 1 << 16,
+                   link_capacity: int = 1 << 14,
+                   interval_ns: int = 100_000_000,
+                   shards: int = 1,
+                   flows: bool = True,
+                   links: bool = True) -> FlowScope:
+    """Build the sampler block.  `flows=False`/`links=False` disable a
+    ring statically: its sampling pass traces away and its slot arrays
+    shrink to one slot per shard (the fields must exist for pytree
+    stability, but cost nothing).  shards > 1 builds the MESH layout
+    (cap/log pattern): slot arrays grow to a multiple of `shards` and
+    partition into per-shard segments, cursors become [shards]
+    vectors so each shard appends into its own segment."""
+    fc = max(flow_capacity if flows else 0, shards)
+    lc = max(link_capacity if links else 0, shards)
+    fc = -(-fc // shards) * shards
+    lc = -(-lc // shards) * shards
+
+    def _cursor():
+        return jnp.asarray(0, I64) if shards == 1 else _zeros((shards,), I64)
+
+    return FlowScope(
+        interval=jnp.asarray(max(int(interval_ns), 1), I64),
+        next_due=jnp.asarray(0, I64),
+        samples=jnp.asarray(0, I64),
+        f_time=_zeros((fc,), I64),
+        f_host=_zeros((fc,), I32),
+        f_slot=_zeros((fc,), I32),
+        f_peer=_zeros((fc,), I32),
+        f_cwnd=_zeros((fc,), I32),
+        f_ssthresh=_zeros((fc,), I32),
+        f_srtt=_zeros((fc,), I64),
+        f_inflight=_zeros((fc,), I32),
+        f_retx=_zeros((fc,), I32),
+        f_acked=_zeros((fc,), I64),
+        f_sent=_zeros((fc,), I64),
+        f_recv=_zeros((fc,), I64),
+        f_total=_cursor(),
+        f_lost=_cursor(),
+        l_time=_zeros((lc,), I64),
+        l_host=_zeros((lc,), I32),
+        l_tx=_zeros((lc,), I64),
+        l_rx=_zeros((lc,), I64),
+        l_qdepth=_zeros((lc,), I32),
+        l_cap=_zeros((lc,), I64),
+        l_drops=_zeros((lc,), I64),
+        l_total=_cursor(),
+        l_lost=_cursor(),
+        sample_flows=bool(flows),
+        sample_links=bool(links),
+    )
+
+
+# ---------------------------------------------------------------------------
 # Trace counter block (runtime profiling; trace.py)
 # ---------------------------------------------------------------------------
 
@@ -900,6 +1040,11 @@ class SimState:
     # graphs.  Replicated (never sharded) under a mesh -- every shard
     # computes identical rows from psum/all_gather-reduced inputs.
     fr: any = struct.field(pytree_node=True, default=None)  # FlightRecorder | None
+    # Per-flow TCP + per-link NIC sampler (trace.ensure_flowscope):
+    # present only when installed, so scope-less runs trace
+    # byte-identical graphs.  Sharded under a mesh (per-shard ring
+    # segments + cursor slices, the cap/log layout).
+    scope: any = struct.field(pytree_node=True, default=None)  # FlowScope | None
     # Network dynamics / fault injection (netem/state.py): present only
     # when a fault schedule is installed, so static worlds compile the
     # whole overlay away.

@@ -147,7 +147,11 @@ def alive(nm: NetemBlock):
 
 def rate(nm, bw_Bps):
     """Scale an [H] i64 token-bucket rate by the per-host bandwidth
-    overlay; identity (exact) when nm is None or the scale is 1000."""
+    overlay; identity (exact) when nm is None or the scale is 1000.
+
+    The scaled uplink rate is what the flowscope link ring samples as
+    `cap_Bps` (`--scope links`), so a bandwidth fault landing mid-run is
+    visible as a capacity step in links.jsonl -- see docs/netem.md."""
     if nm is None:
         return bw_Bps
     return jnp.maximum((bw_Bps * nm.bw_x1000.astype(I64)) // SCALE_ONE,

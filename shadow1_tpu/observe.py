@@ -11,6 +11,14 @@ counter block per interval, diffed host-side and appended to
 The run summary includes an object census (live sockets and packet-pool
 occupancy by lifecycle stage) -- the analog of the reference's
 ObjectCounter leak check printed at slave teardown (slave.c:480-498).
+
+Heartbeats are host-side samples of whatever counters happen to be on
+the device when the chunk boundary lands; for *sim-time-accurate*
+per-flow and per-link series use the device-resident flowscope instead
+(`--scope`, trace.ensure_flowscope/ScopeDrain, docs/observability.md),
+which samples inside the jitted window loop at an exact sim-time
+cadence.  LogDrain's sharded segment-merge protocol below is the
+pattern ScopeDrain follows for its rings.
 """
 
 from __future__ import annotations

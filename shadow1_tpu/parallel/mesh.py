@@ -72,12 +72,15 @@ def _state_specs(state):
             # recorder computes identical rows on every shard from
             # psum/all_gather-reduced inputs (engine._fr_record).
             return P()
-        if name in ("log", "cap"):
+        if name in ("log", "cap", "scope"):
             # Sharded observability rings (make_log_ring/make_capture_ring
-            # with shards=D): slot arrays partition into per-shard
-            # segments and the [D] cursors into per-shard scalars, so
-            # each shard appends independently; observe.LogDrain /
-            # write_pcap merge the segments in sim-time order.
+            # /make_flowscope with shards=D): slot arrays partition into
+            # per-shard segments and the [D] cursors into per-shard
+            # scalars, so each shard appends independently;
+            # observe.LogDrain / write_pcap / trace.ScopeDrain merge the
+            # segments in sim-time order.  The flowscope cadence scalars
+            # (interval/next_due/samples) are 0-d and replicate, keeping
+            # the sample cond collective-safe.
             if hasattr(leaf, "ndim") and leaf.ndim >= 1:
                 return P(HOST_AXIS)
             return P()
@@ -181,6 +184,12 @@ def mesh_run_until(state, params, app, t_target, mesh=None):
             f"{state.fr.n_shards} shard(s) but the mesh has {d} devices; "
             f"install it with trace.ensure_flight_recorder(state, "
             f"shards={d})")
+    if state.scope is not None and state.scope.n_shards != d:
+        raise ValueError(
+            f"mesh_run_until: flowscope built for "
+            f"{state.scope.n_shards} shard(s) but the mesh has {d} "
+            f"devices; install it with trace.ensure_flowscope(state, "
+            f"shards={d}) so every shard gets its own ring segment")
     h = state.hosts.num_hosts
     hp = params.host_vertex.shape[0]
     if hp != h:

@@ -13,12 +13,13 @@ into the persistent XLA cache (`shadow1-tpu warm`).
 from .key import (HOST_LADDER, VERTEX_LADDER, ShapeKey, bucket_for,
                   shape_key)
 from .bucket import pad_world_to_bucket
-from .warm import STANDARD_HOST_BUCKETS, warm_buckets
+from .warm import STANDARD_HOST_BUCKETS, WARM_APPS, warm_buckets
 
 __all__ = [
     "HOST_LADDER",
     "VERTEX_LADDER",
     "STANDARD_HOST_BUCKETS",
+    "WARM_APPS",
     "ShapeKey",
     "bucket_for",
     "pad_world_to_bucket",

@@ -52,7 +52,10 @@ VERTEX_LADDER = (16, 64, 256, 1024, 4096)
 
 # The present-or-None SimState blocks whose presence (and shape) changes
 # the traced graph.  `app` is keyed separately by type + leaf shapes.
-_STATE_BLOCKS = ("nm", "cap", "log", "log_level", "tr", "fr", "hoff")
+# `scope` (the flowscope sampling block) includes its static
+# sample_flows/sample_links flags via leaf shapes + jit statics.
+_STATE_BLOCKS = ("nm", "cap", "log", "log_level", "tr", "fr", "scope",
+                 "hoff")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -35,9 +35,20 @@ from ..core import engine, simtime
 STANDARD_HOST_BUCKETS = (64, 256, 1024, 4096)
 
 # Canonical per-host slabs (see module docstring): phold is the
-# UDP-only/narrow-block flavor, bulk the TCP/wide-block flavor.
+# UDP-only/narrow-block flavor, bulk the TCP/wide-block flavor.  tgen/
+# onion/gossip match their sim.py builder defaults, which the example
+# ladder and scenario sweeps use.
 PHOLD_SLAB = 8
 BULK_SLAB = 32
+
+# Flowscope config of the scope-present flavor ("bulk-scope"): the
+# --scope default interval and both rings, so `--scope flows,links`
+# sweeps hit the warm cache.  Non-default intervals reuse the same
+# graph (the cadence is traced data, not a jit static); non-default
+# ring CAPACITIES do not.
+SCOPE_INTERVAL_NS = 100_000_000
+
+WARM_APPS = ("phold", "bulk", "tgen", "onion", "gossip", "bulk-scope")
 
 
 def _canonical_world(app_name: str, bucket_hosts: int):
@@ -50,14 +61,31 @@ def _canonical_world(app_name: str, bucket_hosts: int):
         s, p, a = sim.build_phold(num_hosts=h,
                                   pool_capacity=h * PHOLD_SLAB,
                                   stop_time=simtime.SIMTIME_ONE_SECOND)
-    elif app_name == "bulk":
+    elif app_name in ("bulk", "bulk-scope"):
         s, p, a = sim.build_bulk(num_hosts=h,
                                  bytes_per_client=1 << 16,
                                  pool_capacity=h * BULK_SLAB,
                                  stop_time=simtime.SIMTIME_ONE_SECOND)
+        if app_name == "bulk-scope":
+            from .. import trace
+            s = trace.ensure_flowscope(s, interval_ns=SCOPE_INTERVAL_NS)
+    elif app_name == "tgen":
+        s, p, a = sim.build_tgen(num_hosts=h,
+                                 stop_time=simtime.SIMTIME_ONE_SECOND)
+    elif app_name == "onion":
+        # build_onion sizes by circuits (client + hops relays + server
+        # per circuit, 5 hosts each at the default 3 hops); the biggest
+        # circuit count still strictly below the bucket.
+        s, p, a = sim.build_onion(
+            num_circuits=max(1, (bucket_hosts - 1) // 5),
+            bytes_per_circuit=1 << 16,
+            stop_time=simtime.SIMTIME_ONE_SECOND)
+    elif app_name == "gossip":
+        s, p, a = sim.build_gossip(num_hosts=h,
+                                   stop_time=simtime.SIMTIME_ONE_SECOND)
     else:
         raise ValueError(f"warm: unknown app flavor {app_name!r} "
-                         f"(known: phold, bulk)")
+                         f"(known: {', '.join(WARM_APPS)})")
     return s, p, a
 
 

@@ -133,6 +133,94 @@ def build_bulk(num_hosts: int,
     return state, params, app
 
 
+_TGEN_SERVER_XML = """
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="serverport" attr.type="string" for="node" id="k0"/>
+  <graph edgedefault="directed">
+    <node id="start"><data key="k0">{port}</data></node>
+  </graph>
+</graphml>"""
+
+_TGEN_CLIENT_XML = """
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="peers" attr.type="string" for="node" id="k0"/>
+  <key attr.name="sendsize" attr.type="string" for="node" id="k1"/>
+  <key attr.name="recvsize" attr.type="string" for="node" id="k2"/>
+  <key attr.name="count" attr.type="string" for="node" id="k3"/>
+  <key attr.name="time" attr.type="string" for="node" id="k4"/>
+  <graph edgedefault="directed">
+    <node id="start"><data key="k0">server:{port}</data></node>
+    <node id="stream"><data key="k1">{sendsize}</data>
+      <data key="k2">{recvsize}</data></node>
+    <node id="end"><data key="k3">{streams}</data></node>
+    <node id="pause"><data key="k4">1,2</data></node>
+    <edge source="start" target="stream"/>
+    <edge source="stream" target="end"/>
+    <edge source="end" target="pause"/>
+    <edge source="pause" target="start"/>
+  </graph>
+</graphml>"""
+
+
+def build_tgen(num_hosts: int,
+               server: int = 0,
+               sendsize: int = 50 * 1024,
+               recvsize: int = 200 * 1024,
+               streams: int = 3,
+               latency_ns: int = 20 * simtime.SIMTIME_ONE_MILLISECOND,
+               reliability: float = 1.0,
+               stop_time: int = 120 * simtime.SIMTIME_ONE_SECOND,
+               seed: int = 1,
+               sock_slots: int = 16,
+               pool_slab: int = 32,
+               bw_Bps: int = 1 << 27):
+    """Programmatic tgen world: one file server + (num_hosts-1) clients
+    driving the modeled action-graph interpreter (apps/tgen.py) with the
+    examples/tgen-100host graph shape -- each client streams `sendsize`
+    up / `recvsize` down `streams` times with 1-2s pauses.  The same
+    worlds the XML front end assembles, without the config files: this
+    is the canonical flavor `shadow1-tpu warm` compiles for the tgen
+    buckets."""
+    from .apps import tgen as tgen_app
+    from .transport import tcp as tcp_mod
+    import numpy as np
+
+    if num_hosts < 2:
+        raise ValueError("tgen needs at least 2 hosts (one server plus "
+                         "clients)")
+    v = min(num_hosts, 256)
+    port = 8888
+    srv = tgen_app.parse_tgen(_TGEN_SERVER_XML.format(port=port))
+    cli = tgen_app.parse_tgen(_TGEN_CLIENT_XML.format(
+        port=port, sendsize=int(sendsize), recvsize=int(recvsize),
+        streams=int(streams)))
+    host_graph = np.full(num_hosts, 1, np.int64)
+    host_graph[server] = 0
+    start_t = np.full(num_hosts, 5 * simtime.SIMTIME_ONE_SECOND, np.int64)
+    start_t[server] = simtime.SIMTIME_ONE_SECOND
+
+    def _build():
+        lat, rel = uniform_full_mesh(v, latency_ns, reliability)
+        params = make_net_params(
+            latency_ns=lat, reliability=rel,
+            host_vertex=jnp.arange(num_hosts) % v,
+            bw_up_Bps=jnp.full(num_hosts, bw_Bps),
+            bw_down_Bps=jnp.full(num_hosts, bw_Bps),
+            seed=seed, stop_time=stop_time)
+        state = make_sim_state(num_hosts, sock_slots=sock_slots,
+                               pool_capacity=num_hosts * pool_slab)
+        mask = jnp.arange(num_hosts) == server
+        state = state.replace(socks=tcp_mod.listen_v(
+            state.socks, mask, 0, port, backlog=num_hosts))
+        state = state.replace(app=tgen_app.build_state(
+            num_hosts, [srv, cli], host_graph, start_t,
+            resolve_peer=lambda s: (server, int(s.rsplit(":", 1)[1]))))
+        return state, params
+
+    state, params = _pkg.build_on_host(_build)
+    return state, params, tgen_app.Tgen()
+
+
 def build_gossip(num_hosts: int = 500,
                  degree: int = 12,
                  num_items: int = 32,
@@ -191,7 +279,7 @@ def add_churn(state, params, rate_per_s: float,
 
 
 def run(state, params, app, until=None, profiler=None, devices=None,
-        bucket=False):
+        bucket=False, scope=None):
     """Run to `until` (default: params.stop_time).
 
     With `profiler` (a trace.Profiler), the run is profiled: the
@@ -214,11 +302,26 @@ def run(state, params, app, until=None, profiler=None, devices=None,
     `device_step` spans, and the counter deltas finalize across shards
     (docs/observability.md), so telemetry rows match the single-device
     run bitwise.
+
+    With `scope` (a ``flows[,links][:interval]`` spec string, same
+    syntax as the CLI --scope flag) a FlowScope sampling block rides the
+    state: cwnd/srtt/retransmit rows per TCP socket and per-host link
+    rows at the given sim-time cadence (docs/observability.md).  The
+    sampled trajectory is bitwise-identical to an unsampled one; read
+    the rings back with trace.ScopeDrain.  Installed after all padding,
+    sharded to match `devices`.
     """
     if bucket:
         from . import shapes
         state, params = shapes.pad_world_to_bucket(state, params)
     t = params.stop_time if until is None else until
+
+    def _install_scope(st, shards):
+        if scope is None or st.scope is not None:
+            return st
+        from . import trace
+        return trace.ensure_flowscope(st, shards=shards,
+                                      **trace.parse_scope_spec(scope))
     if devices is not None and int(devices) > 1:
         import jax as _jax
 
@@ -230,6 +333,7 @@ def run(state, params, app, until=None, profiler=None, devices=None,
                              f"{_jax.default_backend()} device(s) visible")
         mesh = parallel.make_mesh(devs[:n])
         state, params = parallel.pad_world_to_mesh(state, params, n)
+        state = _install_scope(state, n)
         if profiler is None:
             return parallel.mesh_run_chunked(state, params, app, int(t),
                                              mesh=mesh)
@@ -243,6 +347,7 @@ def run(state, params, app, until=None, profiler=None, devices=None,
             return state
         finally:
             trace.install(None)
+    state = _install_scope(state, 1)
     if profiler is None:
         return engine.run_until(state, params, app, t)
     from . import trace

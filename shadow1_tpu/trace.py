@@ -166,6 +166,9 @@ class Profiler:
         self.extra_metrics = {}     # {name: number} via set_metric
         self.flight_rows = []       # drained FlightRecorder rows
         self.flight_summary = None  # aggregate `mesh` section|None
+        self.scope_flow_rows = []   # drained FlowScope flow rows
+        self.scope_link_rows = []   # drained FlowScope link rows
+        self.scope_summary = None   # aggregate `net` section|None
 
     # -- recording hooks ----------------------------------------------------
 
@@ -203,6 +206,16 @@ class Profiler:
         side by side."""
         self.flight_rows = list(rows)
         self.flight_summary = summary
+
+    def set_scope(self, flow_rows: list, link_rows: list,
+                  summary: dict | None):
+        """Attach drained flowscope rows (ScopeDrain.flow_rows /
+        .link_rows) + their aggregate.  The aggregate becomes the `net`
+        section of metrics(); the rows become per-sample counter tracks
+        on the simulated-time process (pid 2) in trace_events()."""
+        self.scope_flow_rows = list(flow_rows)
+        self.scope_link_rows = list(link_rows)
+        self.scope_summary = summary
 
     def set_metric(self, name: str, value):
         """Attach one named scalar metric (e.g. a measured phase cost
@@ -245,12 +258,27 @@ class Profiler:
             "compile_ms": round(
                 sum(d for _t, d in self.compiles) * 1e3, 1),
         }
+        dev = [(t, t + d) for n, t, d, _a in self.events
+               if n == "device_step"]
+        if dev:
+            # The async-window-pipeline judgment metric: how much of the
+            # device-launch wall is overlapped by host drains.  Sync-mode
+            # runs sit near 0% by construction (drains happen after
+            # block_until_ready); the pipeline work drives it up.
+            drains = [(t, t + d) for n, t, d, _a in self.events
+                      if n in _HOST_DRAIN_PHASES]
+            dev_total = sum(b - a for a, b in _union(dev))
+            if dev_total > 0:
+                out["host_drain_overlap_pct"] = round(
+                    100.0 * _overlap(dev, drains) / dev_total, 2)
         if self.counter_samples:
             out["device_counters"] = self.counter_samples[-1][1]
         if self.kernelcount is not None:
             out["kernelcount"] = self.kernelcount
         if self.flight_summary is not None:
             out["mesh"] = self.flight_summary
+        if self.scope_summary is not None:
+            out["net"] = self.scope_summary
         out.update(self.extra_metrics)
         return out
 
@@ -283,7 +311,7 @@ class Profiler:
                             "args": {k: v}})
         meta = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": i,
                  "args": {"name": n}} for n, i in tids.items()]
-        if self.flight_rows:
+        if self.flight_rows or self.scope_flow_rows or self.scope_link_rows:
             # Simulated-time track: pid 2's clock is SIM nanoseconds
             # (rendered as trace microseconds), one span per window plus
             # events/routed counter tracks -- wall time (pid 1) and sim
@@ -292,6 +320,7 @@ class Profiler:
                          "args": {"name": "simulated time (windows)"}})
             meta.append({"name": "thread_name", "ph": "M", "pid": 2,
                          "tid": 1, "args": {"name": "window"}})
+        if self.flight_rows:
             for r in self.flight_rows:
                 ts = round(r["t_start"] / 1e3, 3)
                 dur = round(max(r["t_end"] - r["t_start"], 1) / 1e3, 3)
@@ -304,6 +333,37 @@ class Profiler:
                 for k in ("events", "routed"):
                     evs.append({"name": k, "cat": "sim", "ph": "C",
                                 "pid": 2, "ts": ts, "args": {k: r[k]}})
+        if self.scope_flow_rows:
+            # Flowscope counter tracks on the sim-time clock: per-sample
+            # aggregate congestion window + worst smoothed RTT.
+            agg = {}
+            for r in self.scope_flow_rows:
+                a = agg.setdefault(r["t"], [0, 0])
+                a[0] += r["cwnd"]
+                a[1] = max(a[1], r["srtt_ns"])
+            for t in sorted(agg):
+                ts = round(t / 1e3, 3)
+                evs.append({"name": "cwnd_total", "cat": "net", "ph": "C",
+                            "pid": 2, "ts": ts,
+                            "args": {"cwnd_total": agg[t][0]}})
+                evs.append({"name": "srtt_max_us", "cat": "net", "ph": "C",
+                            "pid": 2, "ts": ts,
+                            "args": {"srtt_max_us":
+                                     round(agg[t][1] / 1e3, 1)}})
+        if self.scope_link_rows:
+            agg = {}
+            for r in self.scope_link_rows:
+                a = agg.setdefault(r["t"], [0, 0])
+                a[0] += r["qdepth"]
+                a[1] += r["drops"]
+            for t in sorted(agg):
+                ts = round(t / 1e3, 3)
+                evs.append({"name": "link_qdepth", "cat": "net", "ph": "C",
+                            "pid": 2, "ts": ts,
+                            "args": {"link_qdepth": agg[t][0]}})
+                evs.append({"name": "link_drops", "cat": "net", "ph": "C",
+                            "pid": 2, "ts": ts,
+                            "args": {"link_drops": agg[t][1]}})
         return meta + evs
 
     def write_trace(self, path: str):
@@ -347,6 +407,40 @@ def _pct(sorted_vals, q):
     i = max(0, min(len(sorted_vals) - 1,
                    int(round(q / 100 * len(sorted_vals) + 0.5)) - 1))
     return sorted_vals[i]
+
+
+# Span names that are host work competing with device launches.  Their
+# wall overlap with `device_step` spans is the host_drain_overlap_pct
+# metric (the async-window-pipeline yardstick in ROADMAP.md).
+_HOST_DRAIN_PHASES = frozenset(
+    ("heartbeat", "log_drain", "flight_drain", "scope_drain", "progress"))
+
+
+def _union(intervals):
+    """Merge (start, end) intervals into a disjoint ascending list."""
+    out = []
+    for a, b in sorted(intervals):
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def _overlap(ivals_a, ivals_b) -> float:
+    """Total length of the intersection of two interval sets."""
+    ua, ub = _union(ivals_a), _union(ivals_b)
+    tot, i, j = 0.0, 0, 0
+    while i < len(ua) and j < len(ub):
+        lo = max(ua[i][0], ub[j][0])
+        hi = min(ua[i][1], ub[j][1])
+        if hi > lo:
+            tot += hi - lo
+        if ua[i][1] <= ub[j][1]:
+            i += 1
+        else:
+            j += 1
+    return tot
 
 
 # ---------------------------------------------------------------------------
@@ -524,4 +618,253 @@ class FlightDrain:
                      - self.rows[0]["t_start"]) / 1e9
             if sim_s > 0:
                 out["windows_per_sim_s"] = round(len(self.rows) / sim_s, 3)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Flowscope (the FlowScope sampling block on SimState; core/state.py)
+# ---------------------------------------------------------------------------
+
+
+_SCOPE_UNITS = {"ns": 1, "us": 1_000, "ms": 1_000_000, "s": 1_000_000_000}
+
+
+def parse_scope_spec(spec: str) -> dict:
+    """Parse a ``--scope`` spec: ``flows[,links][:interval]``.
+
+    The ring list picks what to sample (`flows`, `links`, or both,
+    comma-separated, any order); the optional `:interval` suffix sets
+    the sim-time cadence (`50ms`, `2s`, `500us`, or a bare nanosecond
+    count; default 100ms).  Returns ensure_flowscope kwargs."""
+    rings, _, ivl = spec.partition(":")
+    names = [r.strip() for r in rings.split(",") if r.strip()]
+    bad = [n for n in names if n not in ("flows", "links")]
+    if bad or not names:
+        raise ValueError(
+            f"--scope: unknown ring(s) {bad or ['<empty>']} in {spec!r} "
+            f"(expected flows[,links][:interval])")
+    out = {"flows": "flows" in names, "links": "links" in names}
+    if ivl:
+        ivl = ivl.strip()
+        unit = 1
+        for suffix, mult in sorted(_SCOPE_UNITS.items(),
+                                   key=lambda kv: -len(kv[0])):
+            if ivl.endswith(suffix):
+                unit, ivl = mult, ivl[:-len(suffix)]
+                break
+        try:
+            ns = int(float(ivl) * unit)
+        except ValueError:
+            raise ValueError(
+                f"--scope: bad interval {spec.partition(':')[2]!r} "
+                f"(expected e.g. 100ms, 2s, 500us, or nanoseconds)")
+        if ns < 1:
+            raise ValueError(f"--scope: interval must be positive, got "
+                             f"{spec.partition(':')[2]!r}")
+        out["interval_ns"] = ns
+    return out
+
+
+def ensure_flowscope(state, flow_capacity: int = 1 << 16,
+                     link_capacity: int = 1 << 14,
+                     interval_ns: int = 100_000_000, shards: int = 1,
+                     flows: bool = True, links: bool = True):
+    """Return `state` with a FlowScope sampling block installed
+    (idempotent).  `shards` must match the device count of a mesh run
+    (1 for single-device) and divide the host count; install AFTER mesh
+    padding, like the flight recorder."""
+    if state.scope is not None:
+        return state
+    from .core.state import make_flowscope
+    h = int(state.hosts.num_hosts)
+    if shards < 1 or h % shards:
+        raise ValueError(
+            f"ensure_flowscope: shards={shards} must divide the host "
+            f"count ({h}); pad the world to the mesh first "
+            f"(parallel.pad_world_to_mesh)")
+    return state.replace(scope=make_flowscope(
+        flow_capacity=flow_capacity, link_capacity=link_capacity,
+        interval_ns=interval_ns, shards=shards, flows=flows, links=links))
+
+
+_FLOW_FIELDS = ("time", "host", "slot", "peer", "cwnd", "ssthresh",
+                "srtt", "inflight", "retx", "acked", "sent", "recv")
+_LINK_FIELDS = ("time", "host", "tx", "rx", "qdepth", "cap", "drops")
+
+
+class ScopeDrain:
+    """Host-side drain of the flowscope rings: fetches new rows at chunk
+    boundaries (one cursor probe, bulk fetch only when rows are new --
+    the FlightDrain pattern), merges per-shard ring segments into global
+    sim-time order (the LogDrain pattern), and appends them to
+    ``flows.jsonl``/``links.jsonl`` when paths are given.
+
+    Row counters (acked/sent/recv/retx, tx/rx/drops) are CUMULATIVE
+    lifetime values sampled from the socket/host tables, so a ring wrap
+    between drains loses time resolution, never totals: the newest
+    surviving row per flow/host still carries the exact lifetime sums.
+    The drain derives per-row delivered-rate (`rate_Bps`) host-side from
+    consecutive samples of the same flow.
+
+    `real_hosts` drops link rows of padded hosts (global id >= the
+    count; padding appends hosts at the end) so a mesh/bucket-padded
+    run reports the same links as the exact-size world -- the same
+    contract Tracker heartbeats keep by only writing named hosts.
+    Padded hosts never open sockets, so flow rows need no filter."""
+
+    def __init__(self, flows_path: str | None = None,
+                 links_path: str | None = None,
+                 real_hosts: int | None = None):
+        self.real_hosts = real_hosts
+        self.flow_rows = []
+        self.link_rows = []
+        self.flow_rows_lost = 0
+        self.link_rows_lost = 0
+        self.interval_ns = None     # learned from the block at first drain
+        self.samples = 0
+        self.shards = None
+        self._last = {}             # ring prefix -> [shards] cursors
+        self._wrap_lost = {}        # ring prefix -> rows lost to wrap
+        self._prev = {}             # flow key -> (t, acked) for rate_Bps
+        self._ff = open(flows_path, "w") if flows_path else None
+        self._lf = open(links_path, "w") if links_path else None
+
+    def drain(self, state, profiler=None) -> int:
+        """Fetch rows appended since the last drain; returns how many."""
+        scope = getattr(state, "scope", None)
+        if scope is None:
+            return 0
+        import jax
+        import numpy as np
+        p = profiler if profiler is not None else _active
+        with p.span("scope_drain"):
+            probe = jax.device_get((scope.interval, scope.samples,
+                                    scope.f_total, scope.f_lost,
+                                    scope.l_total, scope.l_lost))
+            p.transfer(sum(getattr(a, "nbytes", 8) for a in probe),
+                       count=1)
+            self.interval_ns = int(probe[0])
+            self.samples = int(probe[1])
+            ft, fl, lt, ll = (np.atleast_1d(np.asarray(a, np.int64))
+                              for a in probe[2:])
+            self.shards = ft.shape[0]
+            n = 0
+            if scope.sample_flows:
+                n += self._drain_ring(scope, "f", _FLOW_FIELDS, ft, p,
+                                      self._flow_row, self.flow_rows,
+                                      self._ff)
+                self.flow_rows_lost = int(fl.sum()) \
+                    + self._wrap_lost.get("f", 0)
+            if scope.sample_links:
+                n += self._drain_ring(scope, "l", _LINK_FIELDS, lt, p,
+                                      self._link_row, self.link_rows,
+                                      self._lf)
+                self.link_rows_lost = int(ll.sum()) \
+                    + self._wrap_lost.get("l", 0)
+            return n
+
+    def _drain_ring(self, scope, prefix, fields, tot_a, p, mk_row,
+                    rows, f) -> int:
+        import jax
+        import numpy as np
+        shards = tot_a.shape[0]
+        last = self._last.setdefault(prefix, np.zeros(shards, np.int64))
+        total = int(tot_a.sum())
+        if total == int(last.sum()):
+            return 0
+        arrs = jax.device_get(tuple(
+            getattr(scope, f"{prefix}_{name}") for name in fields))
+        p.transfer(sum(a.nbytes for a in arrs), count=1)
+        per = arrs[0].shape[0] // shards
+        parts = []
+        for s in range(shards):
+            total_s = int(tot_a[s])
+            ns = total_s - int(last[s])
+            if ns <= 0:
+                continue
+            if ns > per:
+                self._wrap_lost[prefix] = \
+                    self._wrap_lost.get(prefix, 0) + ns - per
+                start = total_s - per
+            else:
+                start = int(last[s])
+            parts.append(s * per + (np.arange(start, total_s) % per))
+            last[s] = total_s
+        if not parts:
+            return 0
+        idx = np.concatenate(parts)
+        order = np.argsort(arrs[0][idx], kind="stable")
+        n = 0
+        for k in idx[order]:
+            row = mk_row(fields, [a[k] for a in arrs])
+            if prefix == "l" and self.real_hosts is not None \
+                    and row["host"] >= self.real_hosts:
+                continue
+            rows.append(row)
+            if f is not None:
+                f.write(json.dumps(row) + "\n")
+            n += 1
+        if f is not None:
+            f.flush()
+        return n
+
+    def _flow_row(self, fields, vals) -> dict:
+        v = dict(zip(fields, (int(x) for x in vals)))
+        row = {"t": v["time"], "host": v["host"], "slot": v["slot"],
+               "peer": v["peer"], "cwnd": v["cwnd"],
+               "ssthresh": v["ssthresh"], "srtt_ns": v["srtt"],
+               "inflight": v["inflight"], "retx": v["retx"],
+               "acked": v["acked"], "sent": v["sent"], "recv": v["recv"]}
+        key = (v["host"], v["slot"], v["peer"])
+        prev = self._prev.get(key)
+        rate = 0.0
+        if prev is not None:
+            dt, da = row["t"] - prev[0], row["acked"] - prev[1]
+            if dt > 0 and da > 0:
+                rate = da / dt * 1e9
+        self._prev[key] = (row["t"], row["acked"])
+        row["rate_Bps"] = round(rate, 1)
+        return row
+
+    def _link_row(self, fields, vals) -> dict:
+        v = dict(zip(fields, (int(x) for x in vals)))
+        return {"t": v["time"], "host": v["host"], "tx": v["tx"],
+                "rx": v["rx"], "qdepth": v["qdepth"],
+                "cap_Bps": v["cap"], "drops": v["drops"]}
+
+    def close(self):
+        for f in (self._ff, self._lf):
+            if f is not None:
+                f.close()
+        self._ff = self._lf = None
+
+    def summary(self) -> dict:
+        """Aggregate the drained rows into the `net` metrics section.
+        Totals come from the newest row per flow/host (the counters are
+        cumulative), so they survive ring wraps between drains."""
+        out = {"interval_ns": self.interval_ns, "samples": self.samples,
+               "shards": self.shards or 1}
+        fin_f = {}
+        for r in self.flow_rows:
+            fin_f[(r["host"], r["slot"], r["peer"])] = r
+        if self.flow_rows or self._ff is not None:
+            out["flows"] = {
+                "rows": len(self.flow_rows),
+                "rows_lost": self.flow_rows_lost,
+                "flows_seen": len(fin_f),
+                "bytes_acked": sum(r["acked"] for r in fin_f.values()),
+                "bytes_sent": sum(r["sent"] for r in fin_f.values()),
+                "retransmit_segs": sum(r["retx"] for r in fin_f.values()),
+            }
+        fin_l = {}
+        for r in self.link_rows:
+            fin_l[r["host"]] = r
+        if self.link_rows or self._lf is not None:
+            out["links"] = {
+                "rows": len(self.link_rows),
+                "rows_lost": self.link_rows_lost,
+                "hosts_seen": len(fin_l),
+                "bytes_forwarded": sum(r["tx"] for r in fin_l.values()),
+                "drops": sum(r["drops"] for r in fin_l.values()),
+            }
         return out

@@ -18,6 +18,11 @@ Hook contract: every hook takes the socket view `sv` (transport.tcp._Sock)
 plus masks/registers and mutates `sv` under those masks.  All hooks are
 branchless; per-socket algorithm state lives in dedicated SocketTable
 fields (cub_epoch/cub_wmax) that non-CUBIC runs simply never touch.
+
+The cwnd/ssthresh trajectories these hooks produce are directly
+observable per flow with `--scope flows` (docs/observability.md) --
+tools/plot.py's cwnd panel is the quickest way to eyeball reno-vs-cubic
+window dynamics on the same world.
 """
 
 from __future__ import annotations

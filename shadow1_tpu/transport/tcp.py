@@ -36,6 +36,14 @@ Fidelity/divergence notes vs the reference:
   (pool.ts / ts_echo), i.e. RFC 7323 TS rather than the reference's
   per-segment timers; constants follow RFC 6298 and the reference's
   definitions.h:107-131 (RTO init 1s, min 200ms, max 120s, delack 40ms).
+
+Observability: the registers this machine maintains are exactly what the
+flowscope samples (engine._scope_sample, `--scope flows`): cwnd /
+ssthresh / srtt / retx_segs / bytes_sent / bytes_recv are read verbatim,
+inflight is the u32 wrap-safe `snd_nxt - snd_una`, and bytes acked is
+derived as `bytes_sent - inflight` (bytes_sent counts NEW stream data
+only -- retransmits bump retx_segs, not bytes_sent, so the difference is
+exact).  Keep those invariants if you touch the send path.
 """
 
 from __future__ import annotations

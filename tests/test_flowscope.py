@@ -1,0 +1,337 @@
+"""Flowscope: the device-resident per-flow / per-link sampling contract.
+
+docs/observability.md promises four properties for the `--scope` block:
+
+* Structural zero cost when absent: a world that never had a scope and
+  one that had it attached then detached lower to byte-identical HLO
+  (scope=None is a trace-time static), so scope-absent runs pay zero
+  compiled ops and a zero kernelcount delta.
+* Bitwise trajectory neutrality when present: sampling reads counters
+  the sim already maintains and writes only into its own rings; every
+  non-scope leaf of the final state is bitwise identical.
+* Mesh parity: the same world sampled on one device and sharded across
+  a mesh drains the SAME row multisets (the host-derived rate_Bps
+  column depends on drain cadence and is excluded).
+* Wrap-proof lifetime totals: rows carry cumulative counters, so a
+  ring too small for the run loses time RESOLUTION, never totals --
+  every surviving final row still carries exact lifetime sums.
+
+Plus the protocol checks: the spec parser, the off-mesh sharded
+refusal, the ShapeKey discriminant, and cwnd/retransmit sanity on the
+lossy bulk-TCP world the acceptance criteria name.
+"""
+
+import importlib.util
+import json
+import os
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from shadow1_tpu import shapes, sim, trace
+from shadow1_tpu.core import engine, simtime
+from shadow1_tpu.parallel import make_mesh, mesh_run_chunked
+
+MS = simtime.SIMTIME_ONE_MILLISECOND
+SEC = simtime.SIMTIME_ONE_SECOND
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _lossy_bulk(**over):
+    """The acceptance world: bulk TCP with injected loss, so flows
+    show retransmits and real cwnd dynamics."""
+    kw = dict(num_hosts=6, bytes_per_client=1 << 14, reliability=0.9,
+              stop_time=8 * SEC)
+    kw.update(over)
+    return sim.build_bulk(**kw)
+
+
+def _drain_chunked(state, params, app, stop_ns, step_ns, runner,
+                   flows_path=None, links_path=None):
+    """The CLI's scope loop in miniature: chunked launches with a
+    ScopeDrain at every boundary."""
+    sd = trace.ScopeDrain(flows_path=flows_path, links_path=links_path)
+    t = 0
+    while t < stop_ns:
+        t = min(t + step_ns, stop_ns)
+        state = runner(state, t)
+        sd.drain(state)
+    sd.close()
+    return state, sd
+
+
+class TestScopeSpec:
+    def test_rings_and_interval(self):
+        assert trace.parse_scope_spec("flows") == \
+            {"flows": True, "links": False}
+        assert trace.parse_scope_spec("links,flows") == \
+            {"flows": True, "links": True}
+        assert trace.parse_scope_spec("flows,links:10ms") == \
+            {"flows": True, "links": True, "interval_ns": 10 * MS}
+        assert trace.parse_scope_spec("links:2s")["interval_ns"] == 2 * SEC
+        assert trace.parse_scope_spec("flows:500")["interval_ns"] == 500
+
+    def test_bad_specs_raise(self):
+        for bad in ("", "packets", "flows:abc", "flows:0", "flows:-5ms"):
+            with pytest.raises(ValueError):
+                trace.parse_scope_spec(bad)
+
+    def test_ensure_is_idempotent_and_validates_shards(self):
+        state, params, app = _lossy_bulk()
+        s1 = trace.ensure_flowscope(state)
+        assert trace.ensure_flowscope(s1) is s1
+        with pytest.raises(ValueError, match="pad_world_to_mesh"):
+            trace.ensure_flowscope(state, shards=4)  # 6 % 4 != 0
+
+
+class TestStructuralCost:
+    def test_scope_absent_graph_identical_and_zero_kernel_delta(self):
+        # scope=None is a trace-time static: attach-then-detach lowers
+        # to byte-identical HLO, so the kernelcount delta is exactly 0.
+        state, params, app = _lossy_bulk()
+        txt = engine.run_until.lower(state, params, app, SEC).as_text()
+        rt = trace.ensure_flowscope(state).replace(scope=None)
+        txt_rt = engine.run_until.lower(rt, params, app, SEC).as_text()
+        assert txt == txt_rt
+        kc = _load_tool("kernelcount")
+        assert kc.hlo_counts(txt) == kc.hlo_counts(txt_rt)
+        scoped = trace.ensure_flowscope(state)
+        txt_sc = engine.run_until.lower(scoped, params, app, SEC).as_text()
+        assert txt_sc != txt  # the sampler really traces in when present
+
+    def test_shape_key_discriminates_scope(self):
+        state, params, app = _lossy_bulk()
+        k0 = shapes.shape_key(state, params)
+        k1 = shapes.shape_key(trace.ensure_flowscope(state), params)
+        assert k0 != k1
+        # ...but the key does NOT fragment on the sampling cadence
+        # (interval is traced data, not a shape).
+        k2 = shapes.shape_key(
+            trace.ensure_flowscope(state, interval_ns=7 * MS), params)
+        assert k1 == k2
+
+
+class TestTrajectoryNeutrality:
+    def test_sampling_is_bitwise_neutral(self):
+        state, params, app = _lossy_bulk()
+        bare = engine.run_chunked(state, params, app, 4 * SEC)
+        scoped = engine.run_chunked(
+            trace.ensure_flowscope(state, interval_ns=100 * MS),
+            params, app, 4 * SEC)
+        assert scoped.scope is not None and bare.scope is None
+        la, ta = jax.tree_util.tree_flatten(bare)
+        lb, tb = jax.tree_util.tree_flatten(scoped.replace(scope=None))
+        assert ta == tb
+        for x, y in zip(la, lb):
+            assert np.array_equal(np.asarray(x), np.asarray(y))
+
+    def test_off_mesh_sharded_scope_raises(self):
+        state, params, app = _lossy_bulk(num_hosts=8)
+        bad = trace.ensure_flowscope(state, shards=4)
+        with pytest.raises(ValueError, match="outside a mesh"):
+            engine.run_until(bad, params, app, SEC)
+
+
+class TestLossyBulkSanity:
+    def test_cwnd_retransmits_and_summary(self, tmp_path):
+        state, params, app = _lossy_bulk()
+        scoped = trace.ensure_flowscope(state, interval_ns=100 * MS)
+        out, sd = _drain_chunked(
+            scoped, params, app, 8 * SEC, 2 * SEC,
+            lambda s, t: engine.run_chunked(s, params, app, t),
+            flows_path=str(tmp_path / "flows.jsonl"),
+            links_path=str(tmp_path / "links.jsonl"))
+        rows = sd.flow_rows
+        assert rows, "lossy bulk produced no flow samples"
+        # Loss at reliability=0.9 must show up as retransmits, and the
+        # sampled registers must look like a real TCP machine: positive
+        # cwnd everywhere, an srtt estimate once data flowed.
+        assert any(r["retx"] > 0 for r in rows)
+        assert all(r["cwnd"] > 0 for r in rows)
+        assert any(r["srtt_ns"] > 0 for r in rows)
+        s = sd.summary()
+        # 5 clients x 16 KiB, acked in full by stop time.
+        assert s["flows"]["bytes_acked"] == 5 * (1 << 14)
+        assert s["flows"]["retransmit_segs"] > 0
+        assert s["links"]["bytes_forwarded"] > 0
+        assert s["links"]["drops"] > 0
+        # Timestamps in each jsonl file are the drain-merged sim-time
+        # order the plots rely on.
+        for fn in ("flows.jsonl", "links.jsonl"):
+            ts = [json.loads(ln)["t"] for ln in
+                  (tmp_path / fn).read_text().splitlines()]
+            assert ts == sorted(ts) and ts
+
+    def test_parse_and_plot_render(self, tmp_path):
+        # tools/parse.py digests the jsonl; tools/plot.py renders the
+        # cwnd/srtt + rate + link panels without error (the acceptance
+        # criterion for --scope flows on the lossy world).
+        state, params, app = _lossy_bulk()
+        scoped = trace.ensure_flowscope(state, interval_ns=100 * MS)
+        _out, _sd = _drain_chunked(
+            scoped, params, app, 8 * SEC, 2 * SEC,
+            lambda s, t: engine.run_chunked(s, params, app, t),
+            flows_path=str(tmp_path / "flows.jsonl"),
+            links_path=str(tmp_path / "links.jsonl"))
+        pa = _load_tool("parse")
+        digest = pa.parse_dir(str(tmp_path))
+        # 5 client flows, plus whichever server-side accepted sockets
+        # were still open at a sample instant.
+        assert digest["flows"]["flows_seen"] >= 5
+        assert digest["flows"]["retransmit_leaderboard"]
+        assert digest["links"]["hosts_seen"] == 6
+        assert digest["links"]["busiest_by_bytes"][0]["bytes_tx"] > 0
+        pytest.importorskip("matplotlib")
+        pl = _load_tool("plot")
+        written = pl.main(str(tmp_path))
+        for png in ("cwnd.png", "flow_rates.png", "links.png"):
+            p = tmp_path / png
+            assert str(p) in written
+            assert p.exists() and p.stat().st_size > 0, png
+
+
+class TestPaddedHostFilter:
+    def test_real_hosts_drops_padded_link_rows(self):
+        # A padded world samples its inert extra hosts too (all-zero
+        # link rows); ScopeDrain(real_hosts=N) keeps the CLI's jsonl
+        # identical to the exact-size run, like heartbeats do.
+        state, params, app = _lossy_bulk()
+        scoped = trace.ensure_flowscope(state, interval_ns=100 * MS)
+        out = engine.run_chunked(scoped, params, app, 2 * SEC)
+        sd = trace.ScopeDrain(real_hosts=3)
+        sd.drain(out)
+        assert sd.link_rows and all(r["host"] < 3 for r in sd.link_rows)
+        # Flow rows are unfiltered (padded hosts never open sockets).
+        assert any(r["host"] >= 3 for r in sd.flow_rows)
+
+
+class TestRingWrap:
+    def test_wrap_keeps_exact_lifetime_sums(self, tmp_path):
+        # A ring far too small for the run loses rows (time resolution)
+        # but never totals: cumulative counters mean every flow/host
+        # final that survives matches the unwrapped run exactly, and
+        # the link summary (capacity >= hosts) stays exact.
+        state, params, app = _lossy_bulk()
+        full_sd = _drain_chunked(
+            trace.ensure_flowscope(state, interval_ns=20 * MS),
+            params, app, 8 * SEC, 2 * SEC,
+            lambda s, t: engine.run_chunked(s, params, app, t))[1]
+        wrap_sd = _drain_chunked(
+            trace.ensure_flowscope(state, interval_ns=20 * MS,
+                                   flow_capacity=8, link_capacity=8),
+            params, app, 8 * SEC, 8 * SEC,  # one launch: no mid-drains
+            lambda s, t: engine.run_chunked(s, params, app, t))[1]
+        assert wrap_sd.flow_rows_lost > 0 and wrap_sd.link_rows_lost > 0
+
+        def finals(rows):
+            return {(r["host"], r["slot"], r["peer"]): r for r in rows}
+
+        ff, wf = finals(full_sd.flow_rows), finals(wrap_sd.flow_rows)
+        assert wf, "wrap left no surviving flow rows"
+        for key, wrow in wf.items():
+            frow = ff[key]
+            # Same sample instant => identical cumulative counters
+            # (rate_Bps is drain-cadence-derived, excluded).
+            assert frow["t"] >= wrow["t"]
+            if frow["t"] == wrow["t"]:
+                a, b = dict(wrow), dict(frow)
+                a.pop("rate_Bps"), b.pop("rate_Bps")
+                assert a == b
+        # Link ring: 8 slots >= 6 hosts, so every host's newest row
+        # survives the wrap and the lifetime totals stay exact.
+        assert wrap_sd.summary()["links"]["bytes_forwarded"] == \
+            full_sd.summary()["links"]["bytes_forwarded"]
+        assert wrap_sd.summary()["links"]["drops"] == \
+            full_sd.summary()["links"]["drops"]
+
+
+class TestMeshParity:
+    """Single device vs 4-shard mesh on the conftest's 8 virtual CPU
+    devices: same trajectory, same drained row multisets."""
+
+    def _world(self, shards):
+        state, params, app = _lossy_bulk(num_hosts=8)
+        state = trace.ensure_flowscope(state, interval_ns=100 * MS,
+                                       shards=shards)
+        return state, params, app
+
+    def test_rows_match_single_vs_mesh(self):
+        t_end, step = 6 * SEC, 2 * SEC
+        st1, pr, app = self._world(shards=1)
+        out1, sd1 = _drain_chunked(
+            st1, pr, app, t_end, step,
+            lambda s, t: engine.run_chunked(s, pr, app, t))
+
+        st4, pr4, app4 = self._world(shards=4)
+        mesh = make_mesh(jax.devices()[:4])
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out4, sd4 = _drain_chunked(
+                st4, pr4, app4, t_end, step,
+                lambda s, t: mesh_run_chunked(s, pr4, app4, t, mesh=mesh))
+
+        def multiset(rows):
+            return sorted(
+                tuple(sorted((k, v) for k, v in r.items()
+                             if k != "rate_Bps")) for r in rows)
+
+        assert sd1.flow_rows and sd1.link_rows
+        assert multiset(sd1.flow_rows) == multiset(sd4.flow_rows)
+        assert multiset(sd1.link_rows) == multiset(sd4.link_rows)
+        s1, s4 = sd1.summary(), sd4.summary()
+        assert s1["flows"] == s4["flows"]
+        assert s1["links"] == s4["links"]
+        assert s4["shards"] == 4
+
+    def test_mesh_shard_mismatch_raises(self):
+        st, pr, app = self._world(shards=2)
+        mesh = make_mesh(jax.devices()[:4])
+        with pytest.raises(ValueError, match="ensure_flowscope"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                mesh_run_chunked(st, pr, app, SEC, mesh=mesh)
+
+
+class TestBenchdiffScopeGate:
+    """benchdiff refuses to diff a sampled run against an unsampled one
+    (or different cadences) -- like the flight-recorder config gate."""
+
+    BASE = {"metric": "phold_events_per_sec", "value": 1000.0,
+            "wall_sec": 10.0,
+            "config": {"scope": None}}
+
+    def _write(self, tmp_path, name, data):
+        p = tmp_path / name
+        p.write_text(json.dumps(data))
+        return str(p)
+
+    def test_scope_config_mismatch_refused(self, tmp_path):
+        new = json.loads(json.dumps(self.BASE))
+        new["config"]["scope"] = {"flows": True, "links": False,
+                                  "interval_ns": 100 * MS}
+        bd = _load_tool("benchdiff")
+        rc = bd.main([self._write(tmp_path, "old.json", self.BASE),
+                      self._write(tmp_path, "new.json", new)])
+        assert rc == 2
+
+    def test_same_scope_config_compares(self, tmp_path):
+        old = json.loads(json.dumps(self.BASE))
+        sc = {"flows": True, "links": True, "interval_ns": 50 * MS}
+        old["config"]["scope"] = sc
+        new = json.loads(json.dumps(old))
+        new["value"] = 1010.0
+        bd = _load_tool("benchdiff")
+        rc = bd.main([self._write(tmp_path, "old.json", old),
+                      self._write(tmp_path, "new.json", new)])
+        assert rc == 0

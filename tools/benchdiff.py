@@ -51,8 +51,9 @@ _MACHINE_BOUND = ("events_per_sec", "value", "vs_baseline", "wall_sec",
 # Whole machine-bound subtrees: everything the flight recorder / mesh
 # telemetry times (exchange probe ms, window rates) depends on the
 # backend, so the dotted prefix downgrades the entire block -- a probe
-# time never flags across environments.
-_MACHINE_BOUND_PREFIXES = ("profile.flight.", "mesh.")
+# time never flags across environments.  The flowscope drain costs
+# (profile.scope.*) are host-side fetch/merge wall times, same class.
+_MACHINE_BOUND_PREFIXES = ("profile.flight.", "profile.scope.", "mesh.")
 
 
 def _machine_bound(name: str) -> bool:
@@ -130,6 +131,21 @@ def _flight_config(d: dict):
     mesh = d.get("mesh")
     if isinstance(mesh, dict) and isinstance(mesh.get("recorder"), dict):
         return mesh["recorder"]
+    return None
+
+
+def _scope_config(d: dict):
+    """Normalized flowscope config of a run: None when sampling was off
+    (including files recorded before the block existed), else its
+    config stamp.  Read from a bench JSON's config.scope stamp or a
+    metrics.json's net section (interval + which rings sampled)."""
+    cfg = d.get("config")
+    if isinstance(cfg, dict) and cfg.get("scope"):
+        return cfg["scope"]
+    net = d.get("net")
+    if isinstance(net, dict):
+        return {"interval_ns": net.get("interval_ns"),
+                "flows": "flows" in net, "links": "links" in net}
     return None
 
 
@@ -255,6 +271,17 @@ def main(argv=None) -> int:
         print(f"benchdiff: refusing to compare runs with different "
               f"flight-recorder configs (old flight={fl_old!r}, "
               f"new flight={fl_new!r}); rerun with matching recorder "
+              f"settings", file=sys.stderr)
+        return 2
+    sc_old, sc_new = _scope_config(old), _scope_config(new)
+    if sc_old != sc_new:
+        # Flowscope sampling adds ring writes to the traced graph, so a
+        # sampled run measures a different program than an unsampled one
+        # (or one sampling at a different cadence/ring mix) -- the same
+        # cross-config rule as the flight recorder.
+        print(f"benchdiff: refusing to compare runs with different "
+              f"flowscope configs (old scope={sc_old!r}, "
+              f"new scope={sc_new!r}); rerun with matching --scope "
               f"settings", file=sys.stderr)
         return 2
     if args.kernels:

@@ -4,9 +4,12 @@
 The analog of the reference's src/tools/parse-shadow.py (which digests
 shadow-heartbeat log lines into json): reads `heartbeat.csv` +
 `summary.json` written by --data-directory runs and prints per-host and
-whole-run aggregates as one JSON document.
+whole-run aggregates as one JSON document.  Runs sampled with `--scope`
+also get `flows`/`links` sections from flows.jsonl/links.jsonl
+(trace.ScopeDrain format): top flows by bytes, the retransmit
+leaderboard, and the busiest links.
 
-Usage: tools/parse.py <data-directory> [--json out.json]
+Usage: tools/parse.py <data-directory> [--json out.json] [--top N]
 """
 
 from __future__ import annotations
@@ -18,7 +21,7 @@ import os
 import sys
 
 
-def parse_dir(data_dir: str) -> dict:
+def parse_dir(data_dir: str, top: int = 10) -> dict:
     hb_path = os.path.join(data_dir, "heartbeat.csv")
     out: dict = {"hosts": {}, "run": None}
     if os.path.exists(hb_path):
@@ -42,15 +45,107 @@ def parse_dir(data_dir: str) -> dict:
     if os.path.exists(sm_path):
         with open(sm_path) as f:
             out["run"] = json.load(f)
+    flows = parse_flows(data_dir, top=top)
+    if flows is not None:
+        out["flows"] = flows
+    links = parse_links(data_dir, top=top)
+    if links is not None:
+        out["links"] = links
     return out
+
+
+def _load_jsonl(path: str):
+    if not os.path.exists(path):
+        return None
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+def parse_flows(data_dir: str, top: int = 10) -> dict | None:
+    """Digest flows.jsonl: per-flow finals (the row counters are
+    cumulative, so each flow's newest row carries its lifetime totals),
+    top flows by bytes acked, and the retransmit leaderboard."""
+    rows = _load_jsonl(os.path.join(data_dir, "flows.jsonl"))
+    if rows is None:
+        return None
+    fin: dict = {}
+    peak_rate: dict = {}
+    for r in rows:
+        key = (r["host"], r["slot"], r["peer"])
+        fin[key] = r
+        peak_rate[key] = max(peak_rate.get(key, 0.0), r["rate_Bps"])
+
+    def _flow(key):
+        r = fin[key]
+        return {"host": key[0], "slot": key[1], "peer": key[2],
+                "bytes_acked": r["acked"], "bytes_sent": r["sent"],
+                "bytes_recv": r["recv"], "retransmit_segs": r["retx"],
+                "last_cwnd": r["cwnd"], "last_srtt_ns": r["srtt_ns"],
+                "peak_rate_Bps": peak_rate[key]}
+
+    by_bytes = sorted(fin, key=lambda k: fin[k]["acked"], reverse=True)
+    by_retx = sorted((k for k in fin if fin[k]["retx"] > 0),
+                     key=lambda k: fin[k]["retx"], reverse=True)
+    return {
+        "samples": len(rows),
+        "flows_seen": len(fin),
+        "bytes_acked": sum(r["acked"] for r in fin.values()),
+        "retransmit_segs": sum(r["retx"] for r in fin.values()),
+        "top_by_bytes": [_flow(k) for k in by_bytes[:top]],
+        "retransmit_leaderboard": [_flow(k) for k in by_retx[:top]],
+    }
+
+
+def parse_links(data_dir: str, top: int = 10) -> dict | None:
+    """Digest links.jsonl: per-host-NIC finals + busiest links by bytes
+    forwarded and by peak utilization of the netem-scaled capacity."""
+    rows = _load_jsonl(os.path.join(data_dir, "links.jsonl"))
+    if rows is None:
+        return None
+    per_host: dict = {}
+    for r in rows:
+        per_host.setdefault(r["host"], []).append(r)
+    stats = {}
+    for h, rs in per_host.items():
+        peak_util = 0.0
+        for i in range(1, len(rs)):
+            dt = (rs[i]["t"] - rs[i - 1]["t"]) / 1e9
+            cap = rs[i]["cap_Bps"]
+            if dt > 0 and cap > 0:
+                peak_util = max(peak_util,
+                                (rs[i]["tx"] - rs[i - 1]["tx"]) / dt / cap)
+        last = rs[-1]
+        stats[h] = {"host": h, "bytes_tx": last["tx"],
+                    "bytes_rx": last["rx"], "drops": last["drops"],
+                    "peak_qdepth": max(r["qdepth"] for r in rs),
+                    "peak_utilization": round(peak_util, 4)}
+    busiest = sorted(stats, key=lambda h: stats[h]["bytes_tx"],
+                     reverse=True)
+    hottest = sorted(stats, key=lambda h: stats[h]["peak_utilization"],
+                     reverse=True)
+    return {
+        "samples": len(rows),
+        "hosts_seen": len(stats),
+        "bytes_forwarded": sum(s["bytes_tx"] for s in stats.values()),
+        "drops": sum(s["drops"] for s in stats.values()),
+        "busiest_by_bytes": [stats[h] for h in busiest[:top]],
+        "busiest_by_utilization": [stats[h] for h in hottest[:top]],
+    }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("data_dir")
     ap.add_argument("--json", default=None, help="also write to this file")
+    ap.add_argument("--top", type=int, default=10,
+                    help="leaderboard length for flow/link sections")
     args = ap.parse_args(argv)
-    result = parse_dir(args.data_dir)
+    result = parse_dir(args.data_dir, top=args.top)
     text = json.dumps(result, indent=2, sort_keys=True)
     if args.json:
         with open(args.json, "w") as f:

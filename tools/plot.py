@@ -15,6 +15,16 @@ both are skipped silently when the file is absent:
   exchange.png     -- src-shard x dst-shard heatmap of exchanged packets
   windows.png      -- engine windows closed per simulated second
 
+When the run sampled the flowscope (`--scope flows[,links]`,
+trace.ScopeDrain format) up to three more panels appear, each skipped
+silently when its file is absent:
+  cwnd.png         -- per-flow congestion window + srtt over time
+                      (flows.jsonl; retransmit epochs marked)
+  flow_rates.png   -- per-flow delivered rate over time (flows.jsonl)
+  links.png        -- link-utilization heatmap: host x time cells of
+                      forwarded bytes / netem-scaled capacity
+                      (links.jsonl)
+
 Rate columns are step-held per host between its rows, so hosts on
 different per-host heartbeat cadences aggregate without sawtooth
 artifacts; delta columns (packets, drops) are summed at the timestamps
@@ -37,7 +47,10 @@ import matplotlib.pyplot as plt  # noqa: E402
 
 def load(data_dir: str):
     rows = []
-    with open(os.path.join(data_dir, "heartbeat.csv")) as f:
+    path = os.path.join(data_dir, "heartbeat.csv")
+    if not os.path.exists(path):
+        return rows
+    with open(path) as f:
         for rec in csv.DictReader(f):
             rows.append(rec)
     return rows
@@ -46,7 +59,22 @@ def load(data_dir: str):
 def load_windows(data_dir: str):
     """Flight-recorder rows from windows.jsonl, or None when the run
     had no recorder (no --profile, or a build predating it)."""
-    path = os.path.join(data_dir, "windows.jsonl")
+    return _load_jsonl(os.path.join(data_dir, "windows.jsonl"))
+
+
+def load_flows(data_dir: str):
+    """Flowscope flow rows from flows.jsonl (trace.ScopeDrain format),
+    or None when the run sampled no flows."""
+    return _load_jsonl(os.path.join(data_dir, "flows.jsonl"))
+
+
+def load_links(data_dir: str):
+    """Flowscope link rows from links.jsonl, or None when the run
+    sampled no links."""
+    return _load_jsonl(os.path.join(data_dir, "links.jsonl"))
+
+
+def _load_jsonl(path: str):
     if not os.path.exists(path):
         return None
     rows = []
@@ -158,6 +186,95 @@ def main(data_dir: str, out_dir: str | None = None) -> list:
         ax.set_xlabel("simulated time (s)")
         ax.set_ylabel("windows/s")
         p = os.path.join(out_dir, "windows.png")
+        f.savefig(p, dpi=110, bbox_inches="tight")
+        plt.close(f)
+        written.append(p)
+
+    frows = load_flows(data_dir)
+    if frows:
+        # Group samples per flow; keep the top flows by final cumulative
+        # bytes acked so the legend stays readable on big worlds.
+        flows = defaultdict(list)
+        for r in frows:
+            flows[(r["host"], r["slot"], r["peer"])].append(r)
+        top = sorted(flows, key=lambda k: flows[k][-1]["acked"],
+                     reverse=True)[:8]
+
+        # cwnd + srtt over time, retransmit epochs marked: the classic
+        # TCP sawtooth view -- under netem loss the marks line up with
+        # the cwnd collapses.
+        f, (ax, ax2) = plt.subplots(2, 1, figsize=(8, 6), sharex=True)
+        for key in top:
+            rs = flows[key]
+            t = [r["t"] / 1e9 for r in rs]
+            label = f"h{key[0]}->h{key[2]}"
+            line, = ax.plot(t, [r["cwnd"] for r in rs], label=label)
+            rt = [(r["t"] / 1e9, r["cwnd"]) for i, r in enumerate(rs)
+                  if i and r["retx"] > rs[i - 1]["retx"]]
+            if rt:
+                ax.plot([x for x, _ in rt], [y for _, y in rt], "x",
+                        color=line.get_color())
+            ax2.plot(t, [r["srtt_ns"] / 1e6 for r in rs], label=label)
+        ax.set_title("Congestion window per flow (x = retransmit epoch)")
+        ax.set_ylabel("cwnd (bytes)")
+        ax.legend(fontsize=8)
+        ax2.set_title("Smoothed RTT per flow")
+        ax2.set_xlabel("simulated time (s)")
+        ax2.set_ylabel("srtt (ms)")
+        p = os.path.join(out_dir, "cwnd.png")
+        f.savefig(p, dpi=110, bbox_inches="tight")
+        plt.close(f)
+        written.append(p)
+
+        # Per-flow delivered rate (the drain derives rate_Bps from
+        # consecutive cumulative-acked samples of the same flow).
+        f, ax = plt.subplots(figsize=(8, 4.5))
+        for key in top:
+            rs = flows[key]
+            ax.plot([r["t"] / 1e9 for r in rs],
+                    [r["rate_Bps"] for r in rs],
+                    label=f"h{key[0]}->h{key[2]}")
+        ax.set_title("Per-flow delivered rate")
+        ax.set_xlabel("simulated time (s)")
+        ax.set_ylabel("bytes/s")
+        ax.legend(fontsize=8)
+        p = os.path.join(out_dir, "flow_rates.png")
+        f.savefig(p, dpi=110, bbox_inches="tight")
+        plt.close(f)
+        written.append(p)
+
+    lrows = load_links(data_dir)
+    if lrows:
+        # Link-utilization heatmap: host x sample-time cells of bytes
+        # forwarded in the interval over what the (netem-scaled)
+        # capacity allowed -- a fault landing shows up as a dark band
+        # (capacity cut => utilization spikes) or a dead one (host down
+        # => tx flatlines).
+        per_host = defaultdict(list)
+        for r in lrows:
+            per_host[r["host"]].append(r)
+        hosts = sorted(per_host)
+        times = sorted({r["t"] for r in lrows})
+        t_i = {t: i for i, t in enumerate(times)}
+        grid = [[0.0] * len(times) for _ in hosts]
+        for hi, h in enumerate(hosts):
+            rs = per_host[h]
+            for i in range(1, len(rs)):
+                dt = (rs[i]["t"] - rs[i - 1]["t"]) / 1e9
+                cap = rs[i]["cap_Bps"]
+                if dt > 0 and cap > 0:
+                    util = (rs[i]["tx"] - rs[i - 1]["tx"]) / dt / cap
+                    grid[hi][t_i[rs[i]["t"]]] = min(util, 1.0)
+        f, ax = plt.subplots(figsize=(8, 4.5))
+        im = ax.imshow(grid, cmap="inferno", aspect="auto",
+                       vmin=0.0, vmax=1.0,
+                       extent=(times[0] / 1e9, times[-1] / 1e9,
+                               len(hosts) - 0.5, -0.5))
+        ax.set_title("Link utilization (tx bytes / capacity)")
+        ax.set_xlabel("simulated time (s)")
+        ax.set_ylabel("host")
+        f.colorbar(im, ax=ax, label="utilization")
+        p = os.path.join(out_dir, "links.png")
         f.savefig(p, dpi=110, bbox_inches="tight")
         plt.close(f)
         written.append(p)

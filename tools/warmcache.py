@@ -38,8 +38,9 @@ def main(argv=None) -> int:
                     help="host bucket sizes (default: "
                          f"{shapes.STANDARD_HOST_BUCKETS})")
     ap.add_argument("--apps", nargs="+", default=("phold", "bulk"),
-                    choices=("phold", "bulk"),
-                    help="world flavors (default: both)")
+                    choices=shapes.WARM_APPS,
+                    help="world flavors (default: phold + bulk; "
+                         "bulk-scope warms the --scope default config)")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
