@@ -213,6 +213,11 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
             "sim_seconds": SIM_SECONDS,
             "rx_batch": app.rx_batch,
             "app_tx_lanes": int(getattr(app, "app_tx_lanes", 1)),
+            # Megakernel stamp: the flag is a ShapeKey static (fused vs
+            # reference compile different graphs), so benchdiff refuses
+            # a both-stamped mismatch; legacy unstamped rounds compare
+            # against anything.
+            "megakernel": bool(params.megakernel),
             "netem": netem_cfg,
             # Flowscope stamp: benchdiff refuses a sampled-vs-unsampled
             # compare (the ring writes change the traced graph), like
@@ -381,6 +386,7 @@ def main_multichip(n_devices: int, gate_against: str | None = None) -> int:
             "sim_seconds": MESH_SIM_SECONDS,
             "rx_batch": 2,
             "engine": "mesh_run_until",
+            "megakernel": True,
             "netem": None,
             # Recorder shape: benchdiff refuses to compare a run whose
             # flight config differs (recorder on/off changes the traced

@@ -70,6 +70,16 @@ class Phold:
     # send's).
     app_tx_lanes = 4
     wants_window_end = True
+    # NOTE: on_tick is row-local over hosts (every read/write is row-
+    # wise, global identity only through host_ids(state)), but it must
+    # NOT run inside a megakernel block: the exponential-delay draw is
+    # f32 log1p, and XLA CPU compiles f32 transcendentals to ulp-
+    # DIFFERENT results depending on the surrounding fusion context
+    # (measured: jit vs eager of the identical reference window loop
+    # disagree by 1-2ns per draw).  Bitwise megakernel-vs-reference
+    # equality therefore requires the tick to stay in the main XLA
+    # graph, where both paths compile it identically -- see the
+    # "f32 stability" section of docs/megakernel.md.
 
     def __init__(self, mean_delay_ns: int, sock_slot: int = 0,
                  rx_batch: int = 1):

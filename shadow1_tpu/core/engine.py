@@ -361,9 +361,18 @@ def _rank_by_dst(mask, dstp, h, m):
     return off.reshape(-1)[blkid * h + dstp] + rank_in, total
 
 
-def _exchange_body(state: SimState, params) -> SimState:
-    pool, ib, hosts = state.pool, state.inbox, state.hosts
-    h = hosts.num_hosts
+def _exchange_core(pool, ib, h, params):
+    """Slab machinery of the boundary exchange, free of SimState
+    packaging: rank movers by destination, splice them into inbox free
+    slots, clear the outbox stage.  Returns (pool, inbox, total,
+    total_prot, n_free) -- the three [H] per-destination tallies are
+    what the accounting tail (_exchange_body) derives drops, trace
+    counters and recorder rows from.
+
+    Split out so the megakernel path can run it as ONE single-block
+    pallas call (megakernel.exchange_call): every op here is integer
+    slab shuffling, so it is fusion-context stable (see the "f32
+    stability" section of docs/megakernel.md)."""
     p0 = pool.capacity
     p1 = ib.capacity
     ki = p1 // h
@@ -442,6 +451,29 @@ def _exchange_body(state: SimState, params) -> SimState:
         if params.pds_trail else ib.status,
     )
 
+    # Movers leave the outbox whether they fit or overflowed; who
+    # overflowed (and whether it was a shed ACK or a counted drop) is
+    # the accounting tail's business, derived from the tallies below.
+    pool = pool.replace(stage=jnp.where(moving, STAGE_FREE, pool.stage))
+    return pool, ib, total, total_prot, n_free
+
+
+def _exchange_body(state: SimState, params, fused: bool = False) -> SimState:
+    hosts = state.hosts
+    h = hosts.num_hosts
+    p0 = state.pool.capacity
+    ki = state.inbox.capacity // h
+    moving = state.pool.stage == STAGE_IN_FLIGHT        # pre-clear copy
+    dst = jnp.clip(state.pool.dst, 0, h - 1)
+
+    if fused:
+        from . import megakernel as mk
+        pool, ib, total, total_prot, n_free = mk.exchange_call(
+            state.pool, state.inbox, h, params)
+    else:
+        pool, ib, total, total_prot, n_free = _exchange_core(
+            state.pool, state.inbox, h, params)
+
     # Profiler counter block (trace.py), present only when a run opted
     # in: packets moved this exchange + peak destination-slab occupancy.
     if state.tr is not None:
@@ -465,17 +497,15 @@ def _exchange_body(state: SimState, params) -> SimState:
         src_sh = jnp.arange(p0, dtype=I32) // (p0 // dm)
         dst_sh = (dst // (h // dm)).astype(I32)
         ones_m = jnp.where(moving, 1, 0).astype(I32)
-        byt_m = jnp.where(moving, pool.blk[:, ICOL_LEN], 0).astype(I64)
+        byt_m = jnp.where(moving, state.pool.blk[:, ICOL_LEN], 0).astype(I64)
         state = state.replace(fr=state.fr.replace(
             cur_ex_cnt=jnp.zeros((dm, dm), I32).at[src_sh, dst_sh]
             .add(ones_m),
             cur_ex_bytes=jnp.zeros((dm, dm), I64).at[src_sh, dst_sh]
             .add(byt_m)))
 
-    # Movers leave the outbox whether they fit or overflowed.  Shed pure
-    # ACKs are accounted as thinning; DATA/control overflow is a counted
-    # drop and raises the capacity escape-hatch flag.
-    pool = pool.replace(stage=jnp.where(moving, STAGE_FREE, pool.stage))
+    # Shed pure ACKs are accounted as thinning; DATA/control overflow is
+    # a counted drop and raises the capacity escape-hatch flag.
     drops_all = jnp.maximum(total - n_free, 0).astype(I64)
     data_drops = jnp.minimum(
         drops_all, jnp.maximum(total_prot - n_free, 0).astype(I64))
@@ -672,8 +702,11 @@ def _exchange_body_mesh(state: SimState, params) -> SimState:
     return state
 
 
-def _exchange(state: SimState, params) -> SimState:
-    """Run the boundary exchange iff anything moved this window."""
+def _exchange(state: SimState, params, fused: bool = False) -> SimState:
+    """Run the boundary exchange iff anything moved this window.
+    `fused` routes the slab core through the single-block pallas call
+    (megakernel.exchange_call); the mesh body keeps the reference core
+    regardless -- its collectives cannot live inside a kernel."""
     moving = jnp.any(state.pool.stage == STAGE_IN_FLIGHT)
     if _on_mesh(state):
         # The mesh body contains collectives, so every shard must take
@@ -682,7 +715,8 @@ def _exchange(state: SimState, params) -> SimState:
         return jax.lax.cond(moving,
                             lambda s: _exchange_body_mesh(s, params),
                             lambda s: s, state)
-    return jax.lax.cond(moving, lambda s: _exchange_body(s, params),
+    return jax.lax.cond(moving,
+                        lambda s: _exchange_body(s, params, fused=fused),
                         lambda s: s, state)
 
 
@@ -886,7 +920,7 @@ def _wire_bytes(proto, length):
 
 
 def _rx_phase(state: SimState, params, em, tick_t, active, app,
-              window_end, bw_dn=None, alive=None):
+              window_end, bw_dn=None, alive=None, aux_bound=None):
     """Arrivals: router enqueue (stage flip), NIC token/CoDel drain of one
     packet per host, transport delivery, inbox slot free.
 
@@ -1019,7 +1053,13 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
             f"every armable TCP timer delay (min {_min_timer} ns); a "
             f"timer armed mid-batch could otherwise fire inside the "
             f"batch and be outrun")
-        bound = jnp.minimum(_aux_times(state, params, app), tick_t + span)
+        # The megakernel path pre-computes _aux_times OUTSIDE the Pallas
+        # kernel (it reads app state the kernel does not carry) and
+        # passes the per-host slice in as `aux_bound`; both expressions
+        # are evaluated at batch start, so they are bitwise identical.
+        aux0 = (_aux_times(state, params, app)
+                if aux_bound is None else aux_bound)
+        bound = jnp.minimum(aux0, tick_t + span)
         bound = jnp.minimum(bound, window_end - 1)
     else:
         bound = tick_t
@@ -1597,7 +1637,8 @@ def _tx_drain(state: SimState, params, tick_t, active, bw_up=None):
         _refill_only, state)
 
 
-def _tx_drain_body(state: SimState, params, tick_t, active, bw_up):
+def _tx_drain_body(state: SimState, params, tick_t, active, bw_up,
+                   skip_refill=False):
     pool, hosts = state.pool, state.hosts
     h = hosts.num_hosts
 
@@ -1605,8 +1646,14 @@ def _tx_drain_body(state: SimState, params, tick_t, active, bw_up):
     have = slot_of_host >= 0
     slot = jnp.clip(slot_of_host, 0, pool.capacity - 1)
 
-    tokens, last = nic.refill(hosts.tokens_tx, hosts.last_refill_tx,
-                              bw_up, tick_t, active)
+    if skip_refill:
+        # Megakernel path: _stage_emissions already refilled the tx
+        # bucket at this same instant, so a second refill accrues
+        # exactly 0 tokens (dt=0; tokens never exceed capacity).
+        tokens, last = hosts.tokens_tx, hosts.last_refill_tx
+    else:
+        tokens, last = nic.refill(hosts.tokens_tx, hosts.last_refill_tx,
+                                  bw_up, tick_t, active)
     # One packed row gather for every field of the chosen packet.
     row = pool.blk[slot]                                 # [H, C]
     size = _wire_bytes(row[:, ICOL_PROTO], row[:, ICOL_LEN]).astype(I64) \
@@ -1769,7 +1816,15 @@ def _microstep_core(state: SimState, params, app, t_h, window_end,
 
 
 def microstep(state: SimState, params, app, t_h, window_end):
-    """One micro-step (public wrapper)."""
+    """One micro-step (public wrapper).  Dispatches to the fused Pallas
+    path when params.megakernel applies (trace-time static), so tooling
+    that lowers this wrapper (tools/kernelcount.py) sees the graph the
+    window loop actually runs."""
+    from . import megakernel as mk
+    if mk.enabled(state, params, app):
+        st, _t_h, _gmin = mk.microstep_fused(state, params, app, t_h,
+                                             window_end)
+        return st
     return _microstep_core(state, params, app, t_h, window_end)
 
 
@@ -1798,8 +1853,10 @@ def run_until_impl(state: SimState, params, app, t_target):
     on every shard, which is what lets collectives live inside the
     while_loops at all -- and makes n_steps/n_windows/now replicated for
     free."""
+    from . import megakernel as mk
     t_target = jnp.asarray(t_target, I64)
     mesh = _on_mesh(state)
+    fused = mk.enabled(state, params, app)
 
     def scan(s):
         t_h, gmin = _scan_all(s, params, app)
@@ -1824,7 +1881,7 @@ def run_until_impl(state: SimState, params, app, t_target):
             st, fr_snap = _fr_snapshot(st)
         # Boundary exchange first: everything in flight becomes visible
         # in the destination slabs before the window's scan.
-        st = _exchange(st, params)
+        st = _exchange(st, params, fused=fused and not mesh)
         t_h, gmin = scan(st)
         ws = jnp.maximum(st.now, gmin)
         we = jnp.minimum(ws + params.min_latency_ns, t_target)
@@ -1846,8 +1903,17 @@ def run_until_impl(state: SimState, params, app, t_target):
 
         def ibody(icarry):
             s, th, _ = icarry
-            s = _microstep_core(s, params, app, th, we, ctx=ctx)
-            th2, g2 = scan(s)
+            if fused:
+                # The fused transport kernel already emits the post-step
+                # per-host scan (bitwise _scan_all), so the re-scan
+                # collapses to the cross-shard reduction.
+                s, th2, g2 = mk.microstep_fused(s, params, app, th, we,
+                                                ctx=ctx)
+                if mesh:
+                    g2 = jax.lax.pmin(g2, MESH_AXIS)
+            else:
+                s = _microstep_core(s, params, app, th, we, ctx=ctx)
+                th2, g2 = scan(s)
             return s, th2, g2
 
         st, t_h, gmin = jax.lax.while_loop(icond, ibody, (st, t_h, gmin))

@@ -117,6 +117,14 @@ class NetParams:
     # so tests can run both variants and assert exactly that
     # (tests/test_kernel_diet.py).
     kernel_diet: bool = struct.field(pytree_node=False, default=True)
+    # STATIC: compile the micro-step phase graph (drain -> route ->
+    # deliver -> transport) into the hand-fused Pallas kernels in
+    # core/megakernel.py instead of the reference XLA op-graph.  Default
+    # on; on non-TPU backends the kernels run in Pallas interpret mode so
+    # CPU tests exercise the same code path (docs/megakernel.md).  The
+    # reference path (megakernel=False) stays intact as the correctness
+    # oracle and lowers byte-identical HLO to pre-megakernel builds.
+    megakernel: bool = struct.field(pytree_node=False, default=True)
 
     def global_hosts(self):
         """Global host count for app-level draws ("pick a random host"):
@@ -214,6 +222,7 @@ def make_net_params(
     iface_buf_pkts=None,
     pcap_mask=None,
     cong: str = "reno",
+    megakernel: bool = True,
 ) -> NetParams:
     from . import rng
 
@@ -285,4 +294,5 @@ def make_net_params(
         has_iface_buf=bool(jnp.any(jnp.asarray(iface_buf_pkts, I32) > 0)),
         has_loss=bool(jnp.any(rel_m < 1.0)),
         has_jitter=bool(jnp.any(jitter_ns > 0)),
+        megakernel=bool(megakernel),
     )

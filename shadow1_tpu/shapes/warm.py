@@ -109,17 +109,23 @@ def warm_buckets(buckets=None, apps=("phold", "bulk"), log=None):
             state, params, app = _canonical_world(app_name, int(hb))
             real = int(state.hosts.num_hosts)
             state, params = pad_world_to_bucket(state, params)
-            t0 = time.perf_counter()
-            lowered = engine.run_until.lower(
-                state, params, app, simtime.SIMTIME_ONE_SECOND)
-            t1 = time.perf_counter()
-            lowered.compile()
-            t2 = time.perf_counter()
-            rec = {"app": app_name, "bucket_hosts": int(hb),
-                   "real_hosts": real,
-                   "lower_s": round(t1 - t0, 3),
-                   "compile_s": round(t2 - t1, 3)}
-            records.append(rec)
-            if log is not None:
-                log(rec)
+            # Warm BOTH megakernel paths: the flag is a ShapeKey static
+            # (a fused world and its reference oracle trace different
+            # graphs), and benchdiff --kernels compares expect both to
+            # be hot.
+            for mk in (True, False):
+                pmk = params.replace(megakernel=mk)
+                t0 = time.perf_counter()
+                lowered = engine.run_until.lower(
+                    state, pmk, app, simtime.SIMTIME_ONE_SECOND)
+                t1 = time.perf_counter()
+                lowered.compile()
+                t2 = time.perf_counter()
+                rec = {"app": app_name, "bucket_hosts": int(hb),
+                       "real_hosts": real, "megakernel": bool(mk),
+                       "lower_s": round(t1 - t0, 3),
+                       "compile_s": round(t2 - t1, 3)}
+                records.append(rec)
+                if log is not None:
+                    log(rec)
     return records

@@ -1,0 +1,166 @@
+"""Megakernel bitwise-neutrality and op-count tests.
+
+The fused micro-step path (core/megakernel.py, params.megakernel) is
+only admissible because it is VALUE-IDENTICAL to the reference phase
+graph: the kernel bodies call the same `_rx_phase` / `_stage_emissions`
+/ `_tx_drain_body` / `_exchange_core` implementations on blocked rows,
+and every f32 transcendental stays in the main XLA graph where both
+paths compile it identically (docs/megakernel.md, "f32 stability").
+These tests enforce that at the strongest level available: every leaf
+of the final state pytree must be bitwise equal with the megakernel on
+and off, across rx_batch modes, both run entry points (one jitted
+run_until vs the host-side chunked loop), a lossy bulk-TCP world with
+real retransmissions, a netem link-flap world that exercises the fused
+exchange's drop path, and an 8-device mesh world (sim.run(devices=8)).
+
+The lowering-level tests pin the flag's graph discipline: megakernel
+OFF must lower with no trace of the kernels (the reference oracle is
+the pre-megakernel graph, byte-for-byte reproducible), ON must actually
+change the graph, and the compiled fused run_until must hold the op
+diet the round was measured at (kernel-unit n_ops <= 0.6x reference,
+tools/kernelcount.py semantics).
+"""
+
+import importlib.util
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from shadow1_tpu import netem, sim
+from shadow1_tpu.core import engine, simtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SEC = simtime.SIMTIME_ONE_SECOND
+MS = simtime.SIMTIME_ONE_MILLISECOND
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _assert_bitwise(fused, ref, label):
+    la, ta = jax.tree_util.tree_flatten_with_path(fused)
+    lb, tb = jax.tree_util.tree_flatten(ref)
+    assert ta == jax.tree_util.tree_flatten(fused)[1]  # sanity
+    assert len(la) == len(lb), f"{label}: leaf count diverged"
+    for (path, x), y in zip(la, lb):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), (
+            f"{label}: leaf {jax.tree_util.keystr(path)} diverged")
+
+
+def _phold(**kw):
+    kw.setdefault("num_hosts", 16)
+    kw.setdefault("msgs_per_host", 2)
+    kw.setdefault("mean_delay_ns", 10 * MS)
+    kw.setdefault("stop_time", 2 * SEC)
+    kw.setdefault("pool_capacity", 16 * 8)
+    kw.setdefault("seed", 7)
+    return sim.build_phold(**kw)
+
+
+class TestPholdNeutrality:
+    @pytest.mark.parametrize("rx_batch", [1, 2])
+    def test_run_until_bitwise_identical(self, rx_batch):
+        state, params, app = _phold(rx_batch=rx_batch)
+        assert params.megakernel, "megakernel should default on"
+        fused = engine.run_until(state, params, app, SEC)
+        ref = engine.run_until(state, params.replace(megakernel=False),
+                               app, SEC)
+        assert int(fused.app.recv.sum()) > 0, "no traffic simulated"
+        _assert_bitwise(fused, ref, f"phold rx_batch={rx_batch}")
+
+    @pytest.mark.parametrize("chunk_ms", [200, 500])
+    def test_chunked_bitwise_identical(self, chunk_ms):
+        # Hold the chunking fixed; fused vs reference must then be
+        # bitwise on every leaf including window/rng bookkeeping.
+        state, params, app = _phold()
+        fused = engine.run_chunked(state, params, app, SEC,
+                                   chunk_ns=chunk_ms * MS)
+        ref = engine.run_chunked(state, params.replace(megakernel=False),
+                                 app, SEC, chunk_ns=chunk_ms * MS)
+        _assert_bitwise(fused, ref, f"phold chunked {chunk_ms}ms")
+
+    def test_netem_link_flap_bitwise_identical(self):
+        # A link flap exercises the fused exchange's overflow/drop path
+        # and the netem overlay advancing between windows.
+        state, params, app = _phold(msgs_per_host=4)
+        tl = netem.timeline()
+        tl.link_down(2, 5, at=100 * MS).link_up(2, 5, at=600 * MS)
+        tl.link_down(1, 9, at=200 * MS).link_up(1, 9, at=SEC)
+        state, params = netem.install(state, params, tl)
+        fused = engine.run_until(state, params, app, SEC)
+        ref = engine.run_until(state, params.replace(megakernel=False),
+                               app, SEC)
+        _assert_bitwise(fused, ref, "phold netem link-flap")
+
+    def test_mesh_8dev_bitwise_identical(self):
+        # The mesh path keeps the reference exchange (collectives can't
+        # live inside a kernel) but runs the fused micro-step per shard;
+        # fused-on-mesh must match reference-on-mesh leaf for leaf.
+        state, params, app = _phold(stop_time=300 * MS)
+        fused = sim.run(state, params, app, until=200 * MS, devices=8)
+        ref = sim.run(state, params.replace(megakernel=False), app,
+                      until=200 * MS, devices=8)
+        assert int(fused.n_steps) > 0
+        _assert_bitwise(fused, ref, "phold mesh devices=8")
+
+
+class TestTcpNeutrality:
+    """A lossy bulk-transfer world drives every gated phase body inside
+    the kernels: drops arm RTO timers, retransmissions queue segments
+    (_tx_drain parks and drains), and arrivals thread the TCP state
+    machine through K_DELIVER/K_TRANSPORT."""
+
+    @pytest.mark.parametrize("reliability", [1.0, 0.97])
+    def test_bulk_bitwise_identical(self, reliability):
+        state, params, app = sim.build_bulk(
+            num_hosts=4, bytes_per_client=30_000,
+            reliability=reliability, stop_time=4 * SEC, seed=11)
+        fused = engine.run_until(state, params, app, 3 * SEC)
+        ref = engine.run_until(state, params.replace(megakernel=False),
+                               app, 3 * SEC)
+        assert int(fused.err) == 0
+        assert int(fused.socks.bytes_recv.sum()) > 0, "no bytes moved"
+        _assert_bitwise(fused, ref, f"bulk rel={reliability}")
+
+
+class TestGraphIdentity:
+    def test_megakernel_off_lowers_clean_and_reproducibly(self):
+        # The reference oracle really is the pre-megakernel graph: no
+        # kernel machinery in the lowering, and two independent builds
+        # of the same world lower byte-identical.
+        s1, p1, a1 = _phold()
+        s2, p2, a2 = _phold()
+        off = p1.replace(megakernel=False)
+        t1 = engine.run_until.lower(s1, off, a1, SEC).as_text()
+        t2 = engine.run_until.lower(
+            s2, p2.replace(megakernel=False), a2, SEC).as_text()
+        assert t1 == t2, "megakernel-off lowering is not reproducible"
+        assert "megakernel" not in t1
+
+    def test_megakernel_flag_changes_the_graph(self):
+        state, params, app = _phold()
+        on = engine.run_until.lower(state, params, app, SEC).as_text()
+        off = engine.run_until.lower(
+            state, params.replace(megakernel=False), app, SEC).as_text()
+        assert on != off, "megakernel flag traced no kernels"
+
+    @pytest.mark.slow
+    def test_fused_op_count_pin(self):
+        # The round's judgment metric, pinned: the compiled fused
+        # run_until must keep kernel-unit n_ops at <= 0.6x the
+        # reference graph on the kernelcount fixed world (measured
+        # 4,211 vs 7,365 when recorded; see PERF.md round 9).
+        kc = _load_tool("kernelcount")
+        fused = kc.phase_counts(megakernel=True)["run_until"]
+        ref = kc.phase_counts(megakernel=False)["run_until"]
+        assert fused["n_pallas"] >= 3, fused
+        assert ref["n_pallas"] == 0, ref
+        assert ref["n_ops"] == ref["n_ops_flat"], ref
+        assert fused["n_ops"] <= 0.6 * ref["n_ops"], (fused, ref)

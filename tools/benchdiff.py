@@ -149,6 +149,17 @@ def _scope_config(d: dict):
     return None
 
 
+def _megakernel_config(d: dict):
+    """The megakernel flag a run was recorded with: True/False from the
+    config stamp, None for files written before bench.py stamped it.
+    Legacy (unstamped) files stay comparable against anything -- only a
+    both-stamped mismatch is a cross-graph compare."""
+    cfg = d.get("config")
+    if not isinstance(cfg, dict) or "megakernel" not in cfg:
+        return None
+    return bool(cfg["megakernel"])
+
+
 def _kernel_world(d: dict):
     """The fixed-world config a kernelcount report was measured on:
     (backend, world dict) for a standalone tools/kernelcount.py JSON or
@@ -161,6 +172,20 @@ def _kernel_world(d: dict):
     if not isinstance(kc.get("world"), dict):
         return None
     return (kc.get("backend"), tuple(sorted(kc["world"].items())))
+
+
+def _worlds_match(wo, wn) -> bool:
+    """Kernelcount world stamps match: equal, modulo the `megakernel`
+    key when only ONE side carries it (reports recorded before the flag
+    was stamped stay gateable against today's default-path reports; a
+    both-stamped mismatch is the config-level refusal's business)."""
+    if wo[0] != wn[0]:
+        return False
+    a, b = dict(wo[1]), dict(wn[1])
+    if ("megakernel" in a) != ("megakernel" in b):
+        a.pop("megakernel", None)
+        b.pop("megakernel", None)
+    return a == b
 
 
 def _n_devices(d: dict) -> int:
@@ -284,9 +309,21 @@ def main(argv=None) -> int:
               f"new scope={sc_new!r}); rerun with matching --scope "
               f"settings", file=sys.stderr)
         return 2
+    mk_old, mk_new = _megakernel_config(old), _megakernel_config(new)
+    if mk_old is not None and mk_new is not None and mk_old != mk_new:
+        # The megakernel flag is a ShapeKey static: fused and reference
+        # worlds compile different graphs, so their numbers (op counts
+        # especially) measure different programs.  Unstamped legacy
+        # files pass -- they predate the flag and ran the one graph
+        # that existed.
+        print(f"benchdiff: refusing to compare runs with different "
+              f"megakernel configs (old megakernel={mk_old!r}, "
+              f"new megakernel={mk_new!r}); re-record with matching "
+              f"paths", file=sys.stderr)
+        return 2
     if args.kernels:
         wo, wn = _kernel_world(old), _kernel_world(new)
-        if wo is not None and wn is not None and wo != wn:
+        if wo is not None and wn is not None and not _worlds_match(wo, wn):
             # Counts from different fixed worlds measure different
             # graphs -- comparing them is noise, not a gate.
             print(f"benchdiff: refusing to compare kernel counts from "

@@ -8,6 +8,20 @@ makes that number a first-class, diffable metric: lower each hot phase
 for a FIXED tiny world, compile it, and count instructions by opcode in
 the optimized HLO (`jax.stages.Lowered` -> `compiled.as_text()`).
 
+Pallas megakernels (core/megakernel.py) are counted as SINGLE KERNEL
+UNITS, reported in their own `n_pallas` column.  On TPU each kernel
+lowers to one Mosaic custom-call -- one dispatch -- so its interior ops
+never launch individually and must not inflate `n_ops` (which proxies
+per-step dispatch count, the quantity the slope measurements showed we
+are bound on).  On CPU the kernels run in interpret mode as a grid
+`while` whose body XLA re-fuses internally; that loop is the
+custom-call's surrogate, identified structurally (a `while` with a
+static `known_trip_count` whose called subtree carries
+core/megakernel.py source metadata) and likewise collapsed to one unit.
+`n_ops_flat` keeps the raw everything-counts total for transparency;
+for reference-path (megakernel=False) graphs the two columns are equal,
+so counts recorded before the megakernel existed stay diffable.
+
 Counts are deterministic for a fixed (world, backend, jax version), so
 they diff exactly across rounds:
 
@@ -43,12 +57,34 @@ def _force_cpu():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-# One HLO instruction per line: `  %name = <shape> opcode(...)` (the
-# leading ROOT marker is optional).  The opcode is the first
-# word-then-paren after the `=`; tuple shapes like `(f32[2], s32[])`
-# cannot match because their paren follows a non-word character.
+# HLO computations open at column 0: `%name (params) -> shape {` with an
+# optional ENTRY marker.  Instructions are indented one per line:
+# `  %name = <shape> opcode(...)` (the leading ROOT marker is optional).
+# The opcode is the first word-then-paren after the `=`; tuple shapes
+# like `(f32[2], s32[])` cannot match because their paren follows a
+# non-word character.
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\{\s*$")
 _INSTR_RE = re.compile(r"^\s+(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*)$")
 _OPCODE_RE = re.compile(r"\b([a-z][a-z0-9_\-]*)\(")
+# Called-computation attributes: how an instruction references another
+# computation (fusion calls=, call to_apply=, while body=/condition=,
+# conditional branch_computations={...}, custom-call
+# called_computations={...}).
+_CALL_RE = re.compile(
+    r"(?:calls|to_apply|body|condition|true_computation|"
+    r"false_computation|branch_computations|called_computations)="
+    r"(?:\{([^}]*)\}|%?([\w.\-]+))")
+# Structural signature of an interpret-mode Pallas grid loop: the trip
+# count is the static grid, stamped into backend_config.  Dynamic engine
+# loops (window / micro-step / netem cursor) never carry it.
+_TRIP = "known_trip_count"
+# Source marker distinguishing megakernel grid loops from other
+# fixed-trip loops (e.g. threefry fold_in): the kernel body is traced
+# from core/megakernel.py, so its fusions carry that source_file.
+_MARKER = "megakernel.py"
+# Real TPU lowering: one Mosaic custom-call per pallas_call.
+_CC_PALLAS = re.compile(r'custom_call_target="(?:tpu_custom_call|'
+                        r'[Mm]osaic[\w.]*)"')
 
 # Opcodes with real per-launch / per-index cost inside a compiled loop
 # (tools/opbench*.py economics) -- broken out so diffs show WHERE a
@@ -58,37 +94,126 @@ _TRACKED = ("fusion", "gather", "scatter", "while", "conditional",
             "dynamic-slice", "dynamic-update-slice", "reduce")
 
 
-def hlo_counts(text: str) -> dict:
-    """Instruction counts of an HLO module dump: total ops across every
-    computation, plus per-opcode counts for the tracked kinds."""
-    n_ops = 0
-    by_op = {k: 0 for k in _TRACKED}
+def _parse(text: str) -> dict:
+    """{computation name: [instruction dict]} for an HLO module dump.
+    Each instruction carries its opcode, the computations it calls, and
+    the two pallas-detection bits (trip-count config, source marker)."""
+    comps = {}
+    cur = None
     for line in text.splitlines():
-        m = _INSTR_RE.match(line)
-        if not m:
+        im = _INSTR_RE.match(line)
+        if im is not None:
+            if cur is None:
+                # Instruction fragment with no computation header (unit
+                # tests feed bare lines): parse under an implicit
+                # anonymous computation instead of dropping it.
+                cur = ""
+                comps[cur] = []
+            op = _OPCODE_RE.search(im.group(1))
+            if op is None:
+                continue
+            refs = []
+            for cm in _CALL_RE.finditer(line):
+                val = cm.group(1) if cm.group(1) is not None \
+                    else cm.group(2)
+                refs += [t.strip().lstrip("%")
+                         for t in val.split(",") if t.strip()]
+            comps[cur].append({
+                "op": op.group(1),
+                "refs": refs,
+                "trip": _TRIP in line,
+                "marker": _MARKER in line,
+                "cc_pallas": (op.group(1) == "custom-call"
+                              and _CC_PALLAS.search(line) is not None),
+            })
             continue
-        op = _OPCODE_RE.search(m.group(1))
-        if op is None:
+        cm = _COMP_RE.match(line)
+        if cm is not None:
+            cur = cm.group(1)
+            comps[cur] = []
+    return comps
+
+
+def _subtree(comps: dict, roots) -> set:
+    """Transitive closure of called computations from `roots`."""
+    seen, stack = set(), list(roots)
+    while stack:
+        c = stack.pop()
+        if c in seen or c not in comps:
             continue
-        n_ops += 1
-        name = op.group(1)
-        if name in by_op:
-            by_op[name] += 1
-    out = {"n_ops": n_ops, "n_fusions": by_op.pop("fusion")}
+        seen.add(c)
+        for ins in comps[c]:
+            stack.extend(ins["refs"])
+    return seen
+
+
+def _pallas_regions(comps: dict):
+    """(regions, interior): the outermost pallas-kernel launch sites and
+    the union of their called-computation subtrees.
+
+    A region is either a Mosaic custom-call (real TPU lowering) or an
+    interpret-mode grid `while` -- static known_trip_count AND a called
+    subtree carrying core/megakernel.py source metadata.  Nested
+    candidates (a fixed-trip loop inside another kernel's body) collapse
+    into their enclosing region."""
+    cand = []
+    for cname, instrs in comps.items():
+        for ins in instrs:
+            if ins["cc_pallas"]:
+                cand.append((cname, _subtree(comps, ins["refs"])))
+                continue
+            if ins["op"] == "while" and ins["trip"]:
+                sub = _subtree(comps, ins["refs"])
+                if any(i2["marker"] for c in sub for i2 in comps[c]):
+                    cand.append((cname, sub))
+    outer = [(cname, sub) for cname, sub in cand
+             if not any(cname in sub2 for cn2, sub2 in cand
+                        if (cn2, sub2) is not (cname, sub))]
+    interior = set()
+    for _cname, sub in outer:
+        interior |= sub
+    return outer, interior
+
+
+def hlo_counts(text: str) -> dict:
+    """Instruction counts of an HLO module dump.
+
+    `n_ops` counts kernel units: every instruction outside pallas-kernel
+    interiors, with each pallas kernel contributing exactly one unit
+    (its launch instruction).  `n_pallas` is the number of such kernels;
+    `n_ops_flat` is the raw total including kernel interiors.  The
+    per-opcode breakdown follows `n_ops` semantics.  Graphs without
+    pallas kernels have n_pallas=0 and n_ops == n_ops_flat, so
+    reference-path counts are unchanged from the pre-megakernel tool."""
+    comps = _parse(text)
+    regions, interior = _pallas_regions(comps)
+    n_flat = sum(len(instrs) for instrs in comps.values())
+    n_ops = n_flat - sum(len(comps[c]) for c in interior)
+    by_op = {k: 0 for k in _TRACKED}
+    for cname, instrs in comps.items():
+        if cname in interior:
+            continue
+        for ins in instrs:
+            if ins["op"] in by_op:
+                by_op[ins["op"]] += 1
+    out = {"n_ops": n_ops, "n_ops_flat": n_flat,
+           "n_pallas": len(regions), "n_fusions": by_op.pop("fusion")}
     out.update({f"n_{k.replace('-', '_')}": v for k, v in by_op.items()})
     return out
 
 
-def _tiny_world(num_hosts: int, rx_batch: int, seed: int):
+def _tiny_world(num_hosts: int, rx_batch: int, seed: int,
+                megakernel: bool = True):
     from shadow1_tpu import sim
 
-    return sim.build_phold(num_hosts=num_hosts, msgs_per_host=2,
-                           pool_capacity=num_hosts * 16, seed=seed,
-                           rx_batch=rx_batch)
+    state, params, app = sim.build_phold(
+        num_hosts=num_hosts, msgs_per_host=2,
+        pool_capacity=num_hosts * 16, seed=seed, rx_batch=rx_batch)
+    return state, params.replace(megakernel=bool(megakernel)), app
 
 
 def phase_counts(num_hosts: int = 64, rx_batch: int = 1,
-                 seed: int = 1) -> dict:
+                 seed: int = 1, megakernel: bool = True) -> dict:
     """Compile the hot phases for a fixed tiny phold world and count
     their HLO ops.  Returns {phase: hlo_counts(...)}; values depend only
     on (shapes, statics, backend), never on runtime data."""
@@ -98,7 +223,8 @@ def phase_counts(num_hosts: int = 64, rx_batch: int = 1,
     from shadow1_tpu.core import emit, engine
     from shadow1_tpu.core.state import I64
 
-    state, params, app = _tiny_world(num_hosts, rx_batch, seed)
+    state, params, app = _tiny_world(num_hosts, rx_batch, seed,
+                                     megakernel=megakernel)
     h = int(state.hosts.num_hosts)
     t_h = jnp.zeros((h,), I64)
     we = jnp.asarray(0, I64)
@@ -135,16 +261,18 @@ def phase_counts(num_hosts: int = 64, rx_batch: int = 1,
     return out
 
 
-def report(num_hosts: int = 64, rx_batch: int = 1, seed: int = 1) -> dict:
+def report(num_hosts: int = 64, rx_batch: int = 1, seed: int = 1,
+           megakernel: bool = True) -> dict:
     """The full diffable report: per-phase counts + config echo."""
     import jax
 
     phases = phase_counts(num_hosts=num_hosts, rx_batch=rx_batch,
-                          seed=seed)
+                          seed=seed, megakernel=megakernel)
     return {
         "backend": jax.default_backend(),
         "world": {"app": "phold", "num_hosts": num_hosts,
-                  "rx_batch": rx_batch, "seed": seed},
+                  "rx_batch": rx_batch, "seed": seed,
+                  "megakernel": bool(megakernel)},
         "phases": phases,
         # The headline number regressions gate on: the per-step graph.
         "microstep_ops": phases["microstep"]["n_ops"],
@@ -159,20 +287,24 @@ def main(argv=None) -> int:
     ap.add_argument("--hosts", type=int, default=64)
     ap.add_argument("--rx-batch", type=int, default=1)
     ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--no-megakernel", action="store_true",
+                    help="count the reference (megakernel=False) graph "
+                         "for fused-vs-reference comparison")
     ap.add_argument("--json", action="store_true",
                     help="emit one machine-readable JSON object")
     args = ap.parse_args(argv)
 
     rep = report(num_hosts=args.hosts, rx_batch=args.rx_batch,
-                 seed=args.seed)
+                 seed=args.seed, megakernel=not args.no_megakernel)
     if args.json:
         print(json.dumps(rep))
         return 0
     print(f"backend: {rep['backend']}  world: phold "
-          f"H={args.hosts} rx_batch={args.rx_batch}")
+          f"H={args.hosts} rx_batch={args.rx_batch} "
+          f"megakernel={rep['world']['megakernel']}")
     cols = sorted({k for p in rep["phases"].values() for k in p})
-    cols = ["n_ops", "n_fusions"] + [c for c in cols
-                                     if c not in ("n_ops", "n_fusions")]
+    first = ["n_ops", "n_ops_flat", "n_pallas", "n_fusions"]
+    cols = first + [c for c in cols if c not in first]
     w = max(len(n) for n in rep["phases"])
     print(f"{'phase':<{w}s} " + " ".join(f"{c:>12s}" for c in cols))
     for name, p in rep["phases"].items():

@@ -11,6 +11,9 @@ Two attribution methods over the same busy-state worlds:
 * ablate -- time the FULL micro-step with single phases no-op'd
   (monkeypatched before trace), so each phase's cost is a delta from the
   same full-step baseline.  Slower, more faithful.
+* fused -- the megakernel path (core/megakernel.py): fused step vs
+  reference step, per-kernel compute deltas (bodies no-op'd inside the
+  launch structure), and the boundary exchange both ways.
 
 Also times the window-boundary exchange as its own forced loop.
 
@@ -220,6 +223,86 @@ def run_ablate(state, params, app, we):
     print(f"{'=> rx_phase':44s} {base - no_rx:8.3f} ms")
 
 
+def run_fused(state, params, app, we):
+    """Fused-phase attribution (--method fused): slope-time the fused
+    micro-step (megakernel.microstep_fused) against the reference step,
+    then re-time it with single kernel BODIES no-op'd -- the launch
+    structure stays, the block compute goes -- so each kernel's compute
+    cost is a delta from the same fused graph.  The all-bodies-no-op
+    loop is what's left: kernel launch overhead + the between-kernel
+    islands (timers/app tick) + scan glue.  Finishes with the boundary
+    exchange both ways (reference graph vs single-block kernel)."""
+    from shadow1_tpu.core import megakernel as mk
+    pf = params.replace(megakernel=True)
+    pr = params.replace(megakernel=False)
+    if not mk.enabled(state, pf, app):
+        print("fused: megakernel path disabled for this world "
+              "(log/cap ring installed?); nothing to time")
+        return
+
+    def v_ref(s, th):
+        s = engine._microstep_core(s, pr, app, th, we)
+        th2, _ = engine._scan_all(s, pr, app)
+        return s, th2
+
+    def v_fused(s, th):
+        s2, th2, _g = mk.microstep_fused(s, pf, app, th, we)
+        return s2, th2
+
+    ref = timeloop("reference microstep + scan", state, params, app,
+                   v_ref)
+    base = timeloop("fused microstep (all kernels)", state, params, app,
+                    v_fused)
+    print(f"{'=> fused vs reference':44s} {base - ref:+8.3f} ms/iter")
+
+    def with_patches(label, patches):
+        saved = {name: getattr(engine, name) for name in patches}
+        for name, fn in patches.items():
+            setattr(engine, name, fn)
+        try:
+            return timeloop(label, state, params, app, v_fused)
+        finally:
+            for name, fn in saved.items():
+                setattr(engine, name, fn)
+
+    def _id_rx(s, params2, em, tick_t, active, app2, we2, **kw):
+        return s, em, jnp.zeros((s.hosts.num_hosts,), I32), tick_t
+
+    def _id_stage(s, params2, em, tick_t, active, app2, **kw):
+        return s, jnp.zeros_like(em.valid)
+
+    def _id_drain(s, *a, **kw):
+        return s
+
+    no_rx = with_patches("fused - deliver body", {"_rx_phase": _id_rx})
+    no_tx = with_patches("fused - transport body",
+                         {"_stage_emissions": _id_stage,
+                          "_tx_drain_body": _id_drain})
+    hollow = with_patches("fused - all kernel bodies",
+                          {"_rx_phase": _id_rx,
+                           "_stage_emissions": _id_stage,
+                           "_tx_drain_body": _id_drain})
+    print(f"{'=> K_DELIVER compute':44s} {base - no_rx:8.3f} ms")
+    print(f"{'=> K_TRANSPORT compute':44s} {base - no_tx:8.3f} ms")
+    print(f"{'=> islands + launches + scan (residual)':44s} "
+          f"{hollow:8.3f} ms")
+
+    def v_exch_ref(s, th):
+        s = engine._exchange_body(s, pr)
+        return s.replace(now=s.now + 1), th
+
+    def v_exch_fused(s, th):
+        s = engine._exchange_body(s, pf, fused=True)
+        return s.replace(now=s.now + 1), th
+
+    er = timeloop("exchange reference (forced)", state, params, app,
+                  v_exch_ref)
+    ef = timeloop("exchange single-block kernel (forced)", state, params,
+                  app, v_exch_fused)
+    print(f"{'=> exchange kernel vs reference':44s} {ef - er:+8.3f} "
+          f"ms/iter")
+
+
 def measure_staging_ms(state, params, app, iters_pair=(20, 60)) -> float:
     """ms per staging merge on the live backend: a forced loop of
     `_stage_emissions` over a fully-valid synthetic emissions buffer,
@@ -262,7 +345,8 @@ def main(argv=None):
                     help="onion world size (hosts = 5 x circuits)")
     ap.add_argument("--warm-ms", type=int, default=500,
                     help="sim-ms to advance before timing (busy state)")
-    ap.add_argument("--method", choices=("subsets", "ablate", "both"),
+    ap.add_argument("--method",
+                    choices=("subsets", "ablate", "fused", "both"),
                     default="subsets")
     args = ap.parse_args(argv)
 
@@ -271,7 +355,10 @@ def main(argv=None):
         run_subsets(state, params, app, we)
     if args.method in ("ablate", "both"):
         run_ablate(state, params, app, we)
-    run_exchange(state, params, app)
+    if args.method in ("fused", "both"):
+        run_fused(state, params, app, we)
+    if args.method != "fused":
+        run_exchange(state, params, app)
 
 
 if __name__ == "__main__":
