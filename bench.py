@@ -223,6 +223,10 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
             # compare (the ring writes change the traced graph), like
             # the netem/flight refusals.  bench.py never samples.
             "scope": None,
+            # Checkpoint stamp: cadenced saves add launch boundaries and
+            # host-side npz wall time, so benchdiff refuses a cadence
+            # mismatch; bench.py never checkpoints.
+            "checkpoint_every": None,
         },
         # Wall-clock numbers are only comparable between runs on the
         # same backend and core count; benchdiff downgrades machine-
@@ -393,6 +397,7 @@ def main_multichip(n_devices: int, gate_against: str | None = None) -> int:
             # graph), mirroring the netem refusal.
             "flight": top.get("flight"),
             "scope": None,
+            "checkpoint_every": None,
         },
         "env": {
             "backend": top["backend"],

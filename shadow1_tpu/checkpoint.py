@@ -7,49 +7,138 @@ counters, application state, and the run's NetParams -- is one pytree of
 dense arrays, so a checkpoint is a flat .npz of its leaves and resume is
 bitwise-exact: run(save -> load -> continue) equals run-straight.
 
-Format: numpy .npz with keys "s<N>" / "p<N>" for the N-th leaf of the
-state / params pytree (in tree order), plus tree-structure fingerprints
-to catch template mismatches at load time.  Loading requires a *template*
-(state, params) pair built the same way as the saved run (same config,
-shapes, apps); the template supplies the pytree structure, the file
-supplies every value.
+Format (version 1): numpy .npz with keys "s<N>" / "p<N>" for the N-th
+leaf of the state / params pytree (in tree order), tree-structure
+fingerprints to catch template mismatches at load time, and a
+"_manifest" JSON blob stamping the world's ShapeKey fingerprint
+(shapes.key_manifest: every compile-shape static plus which
+present-or-None blocks ride the state and their leaf shapes), the
+global window index and sim time of the snapshot, and -- for mesh /
+bucketed runs -- the shard layout and padding (devices, hosts_padded,
+hosts_real) so replay can restore onto the same mesh or gather down to
+a single device (replay.py, docs/observability.md "Time-travel
+replay").
+
+Loading requires a *template* (state, params) pair built the same way
+as the saved run (same config, shapes, apps); the template supplies the
+pytree structure, the file supplies every value.  On a mismatch the
+error names the differing block or static from the manifest (a missing
+flight recorder, a different cong/megakernel/pool_slab, the uses_tcp
+packed-block width) rather than a bare structure error.  Files written
+before the manifest existed (version 0) still load with the structural
+check only.
 """
 
 from __future__ import annotations
 
+import json
+
 import numpy as np
 
 import jax
+
+FORMAT_VERSION = 1
 
 
 def _fingerprint(tree) -> str:
     return str(jax.tree_util.tree_structure(tree))
 
 
-def save(path: str, state, params) -> None:
-    """Write state + params to `path` (.npz)."""
+def world_manifest(state, params, **extra) -> dict:
+    """The manifest dict save() stamps: format version, ShapeKey
+    fingerprint (statics + block presence/shapes), snapshot position
+    (global window index + sim time), and any caller extras (shard
+    layout, padding, run identity)."""
+    from . import shapes
+    m = {
+        "format": FORMAT_VERSION,
+        "shape": shapes.key_manifest(shapes.shape_key(state, params)),
+        "window": int(state.n_windows),
+        "t_ns": int(state.now),
+    }
+    m.update(extra)
+    return m
+
+
+def save(path: str, state, params, manifest: dict | None = None) -> None:
+    """Write state + params to `path` (.npz).
+
+    `manifest` extras (devices, hosts_real, ...) merge into the stamped
+    world_manifest.  Sharded mesh states save transparently: the single
+    device_get below gathers every shard's rows into the full host-side
+    array (parallel/sharding.py unshard), so the file layout is
+    identical to a single-device save of the same world.
+    """
+    from .parallel.sharding import unshard
+    m = world_manifest(state, params, **(manifest or {}))
+    state, params = unshard((state, params))
     s_leaves = jax.tree_util.tree_leaves(state)
     p_leaves = jax.tree_util.tree_leaves(params)
     out = {f"s{i}": np.asarray(x) for i, x in enumerate(s_leaves)}
     out.update({f"p{i}": np.asarray(x) for i, x in enumerate(p_leaves)})
     out["_s_struct"] = np.array(_fingerprint(state))
     out["_p_struct"] = np.array(_fingerprint(params))
+    out["_manifest"] = np.array(json.dumps(m, sort_keys=True))
     with open(path, "wb") as f:
         np.savez(f, **out)
+
+
+def read_manifest(path: str) -> dict | None:
+    """The manifest stamped into a checkpoint, or None for files written
+    before the manifest existed."""
+    with np.load(path, allow_pickle=False) as z:
+        if "_manifest" not in z.files:
+            return None
+        return json.loads(str(z["_manifest"]))
+
+
+def _mismatch_detail(z, template_state, template_params) -> str:
+    """Name what differs between a checkpoint and a template: the
+    manifest comparison names the first differing block/static; legacy
+    files fall back to the bare structure message."""
+    if "_manifest" not in z.files:
+        return "different config, app, or version"
+    from . import shapes
+    saved = json.loads(str(z["_manifest"]))
+    cur = shapes.key_manifest(
+        shapes.shape_key(template_state, template_params))
+    detail = shapes.describe_key_mismatch(saved.get("shape", {}), cur)
+    if detail is None:
+        # Identical ShapeKeys but different tree structure: app type or
+        # params version changed in a way the key doesn't capture.
+        return ("same shape fingerprint but different pytree structure "
+                "(app or params version mismatch)")
+    return detail
 
 
 def load(path: str, template_state, template_params):
     """Rebuild (state, params) from `path` using the templates' structure.
 
     Every leaf value comes from the file; shapes and dtypes must match the
-    template (same config/apps), which is also verified structurally.
+    template (same config/apps), which is also verified structurally and
+    -- for manifest-stamped files -- against the template's ShapeKey, so
+    the error names the differing block or static.
     """
     with np.load(path, allow_pickle=False) as z:
+        # Manifest check first: a same-structure world with different
+        # shapes (more hosts, a wider slab) would otherwise surface as a
+        # bare "leaf s8" error; the ShapeKey comparison names the block
+        # or static instead.
+        if "_manifest" in z.files:
+            from . import shapes
+            saved = json.loads(str(z["_manifest"]))
+            cur = shapes.key_manifest(
+                shapes.shape_key(template_state, template_params))
+            detail = shapes.describe_key_mismatch(
+                saved.get("shape", {}), cur)
+            if detail is not None:
+                raise ValueError(
+                    "checkpoint does not match the template: " + detail)
         if str(z["_s_struct"]) != _fingerprint(template_state) or \
                 str(z["_p_struct"]) != _fingerprint(template_params):
             raise ValueError(
-                "checkpoint structure does not match the template "
-                "(different config, app, or version)")
+                "checkpoint structure does not match the template: "
+                + _mismatch_detail(z, template_state, template_params))
 
         def rebuild(template, prefix):
             leaves, treedef = jax.tree_util.tree_flatten(template)

@@ -7,6 +7,13 @@ is the same surface for the rebuilt engine:
 
 Runs the simulation in bounded device launches, then prints a run summary
 (per-host transfer completions, traffic counters) to stdout.
+
+World assembly lives in `build_world` so `run` and `replay` construct
+bitwise-identical templates from the same flags: a checkpointed run
+records its world flags in ckpt/run.json (replay.write_run_json), and
+`shadow1-tpu replay` feeds them back through build_world to rebuild the
+exact pytree the checkpoints restore into (docs/observability.md
+"Time-travel replay").
 """
 
 from __future__ import annotations
@@ -15,6 +22,7 @@ import argparse
 import json
 import sys
 import time
+import types
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +31,40 @@ from .core import engine, simtime
 
 SEC = simtime.SIMTIME_ONE_SECOND
 MS = simtime.SIMTIME_ONE_MILLISECOND
+
+
+class CliError(Exception):
+    """A user-facing CLI failure: message for stderr plus an exit code."""
+
+    def __init__(self, msg: str, rc: int = 2):
+        super().__init__(msg)
+        self.rc = rc
+
+
+# The flags that determine the WORLD -- pytree structure, shapes, and
+# initial values.  A checkpointed run stamps exactly these into
+# ckpt/run.json; replay rebuilds its load template from them.  Flags
+# outside this list (--data-directory, --heartbeat-frequency,
+# --progress, --quiet) affect only host-side I/O, never the world.
+_WORLD_ARGS = (
+    "config", "seed", "stop_time", "sock_slots", "pool_slab",
+    "tcp_congestion_control", "interface_qdisc", "cpu_threshold",
+    "cpu_precision", "pcap", "pcap_ring", "netem", "churn",
+    "churn_downtime", "log_level", "log_ring", "profile", "bucket",
+    "devices", "scope", "checkpoint_every")
+
+
+def world_args(args) -> dict:
+    """The world-determining flags as a JSON-able dict (paths made
+    absolute so a replay launched from another cwd still resolves
+    them)."""
+    import os
+    d = {k: getattr(args, k, None) for k in _WORLD_ARGS}
+    d["config"] = os.path.abspath(d["config"])
+    if d.get("netem"):
+        d["netem"] = os.path.abspath(d["netem"])
+    return d
+
 
 def _parser():
     p = argparse.ArgumentParser(
@@ -134,6 +176,68 @@ def _parser():
                         "'flows', 'flows,links:50ms' (default interval "
                         "100ms).  Sampling never perturbs the "
                         "trajectory; see docs/observability.md")
+    r.add_argument("--checkpoint-every", type=float, metavar="SECONDS",
+                   default=None,
+                   help="make the run replayable (docs/observability.md "
+                        "'Time-travel replay'): snapshot the full "
+                        "simulation to DATA_DIR/ckpt/win_<K>.npz every "
+                        "SECONDS of sim time (at existing launch-"
+                        "boundary syncs -- compiled graphs and the "
+                        "trajectory are bitwise unchanged), record every "
+                        "window to windows.jsonl, and stamp the replay "
+                        "recipe into ckpt/run.json for `shadow1-tpu "
+                        "replay`.  Requires --data-directory")
+
+    rp = sub.add_parser(
+        "replay",
+        help="time-travel replay: restore the nearest checkpoint before "
+             "a target window of a --checkpoint-every run, re-run the "
+             "span (optionally with instrumentation the original run "
+             "lacked), and verify it bitwise against the recorded "
+             "windows.jsonl (docs/observability.md)")
+    rp.add_argument("--data-directory", required=True,
+                    help="the checkpointed run's data directory "
+                         "(ckpt/ + windows.jsonl)")
+    tgt = rp.add_mutually_exclusive_group()
+    tgt.add_argument("--window", type=int, default=None, metavar="K",
+                     help="target global window index (default: the "
+                          "last recorded window)")
+    tgt.add_argument("--time", type=float, default=None, metavar="T",
+                     help="target sim time in seconds: replays through "
+                          "the window containing T")
+    rp.add_argument("--out", default=None,
+                    help="where replay outputs land (default: "
+                         "DATA_DIR/replay)")
+    rp.add_argument("--devices", type=int, default=None, metavar="N",
+                    help="execution override: the original mesh size "
+                         "(default) or 1 to gather a mesh checkpoint "
+                         "onto one device (refused when per-shard "
+                         "cap/log/scope rings are present)")
+    rp.add_argument("--scope", metavar="SPEC", default=None,
+                    help="install flowscope sampling on the replayed "
+                         "span (same SPEC as run --scope) even if the "
+                         "original run had none -- trajectory-neutral, "
+                         "so the replay still verifies bitwise")
+    rp.add_argument("--log-level", choices=("off", "warning", "debug"),
+                    default="off",
+                    help="event-log the replayed span to "
+                         "OUT/shadow.log even if the original run "
+                         "logged nothing")
+    rp.add_argument("--log-ring", type=int, default=0,
+                    help="replay log ring capacity (0 = auto)")
+    rp.add_argument("--pcap", action="store_true",
+                    help="capture the replayed span to OUT/capture.pcap")
+    rp.add_argument("--pcap-ring", type=int, default=1 << 17,
+                    help="replay capture ring capacity")
+    rp.add_argument("--profile", action="store_true",
+                    help="profile the replayed span (trace.json + "
+                         "metrics.json in OUT)")
+    rp.add_argument("--progress", action="store_true",
+                    help="live status line for the replayed span")
+    rp.add_argument("--no-verify", action="store_true",
+                    help="skip the bitwise cross-check against the "
+                         "recorded windows.jsonl")
+    rp.add_argument("--quiet", action="store_true")
 
     w = sub.add_parser(
         "warm",
@@ -154,32 +258,27 @@ def _parser():
     return p
 
 
-def run_config(args) -> int:
+def build_world(args, *, quiet: bool = False, want_mesh: bool = True,
+                allow_substrate: bool = True) -> types.SimpleNamespace:
+    """Assemble and instrument a world from the run flags.
+
+    The single world-construction path `run` and `replay` share: config
+    assembly, netem merge, observability ring installs (in mesh layout
+    when the run shards), bucket and mesh padding, and the flight
+    recorder -- everything that shapes the state/params pytrees, in a
+    fixed order, so a replay template is structurally identical to the
+    original run's world.  Host-side actors (trackers, drains,
+    profiler files) stay with the caller.  Raises CliError on
+    user-facing failures.
+
+    `want_mesh=False` skips Mesh construction and the visible-device
+    check but still applies mesh PADDING -- a single-device gather
+    replay of a mesh checkpoint needs the padded shapes without the
+    mesh.  `allow_substrate=False` refuses configs with real-process
+    plugins (replay cannot restore external process state).
+    """
     from .config import assemble
 
-    profiler = None
-    if args.profile:
-        if not args.data_directory:
-            print("error: --profile requires --data-directory",
-                  file=sys.stderr)
-            return 2
-        from . import trace
-        profiler = trace.install(trace.Profiler(sync=True))
-
-    scope_kw = None
-    if args.scope:
-        if not args.data_directory:
-            print("error: --scope requires --data-directory",
-                  file=sys.stderr)
-            return 2
-        from . import trace as _trace_mod
-        try:
-            scope_kw = _trace_mod.parse_scope_spec(args.scope)
-        except ValueError as e:
-            print(f"error: {e}", file=sys.stderr)
-            return 2
-
-    t_wall = time.perf_counter()
     asm = assemble.load(args.config, seed=args.seed,
                         sock_slots=args.sock_slots,
                         pool_slab=args.pool_slab,
@@ -188,18 +287,11 @@ def run_config(args) -> int:
                         cpu_precision_us=args.cpu_precision,
                         cong=args.tcp_congestion_control)
     stop = (args.stop_time * SEC) if args.stop_time else asm.stop_time
-    if not args.quiet:
+    if not quiet:
         print(f"[shadow1-tpu] {len(asm.hostnames)} hosts, "
               f"{asm.topology.num_vertices} vertices, "
               f"stop={stop / SEC:.0f}s, backend={jax.default_backend()}",
               file=sys.stderr)
-
-    tracker = None
-    if args.data_directory and args.heartbeat_frequency > 0:
-        from .observe import Tracker
-        tracker = Tracker(args.data_directory, asm.hostnames,
-                          interval_s=args.heartbeat_frequency,
-                          per_host_interval_s=asm.heartbeat_freq_s)
 
     state, params, app = asm.state, asm.params, asm.app
 
@@ -221,7 +313,7 @@ def run_config(args) -> int:
                      mean_down_s=args.churn_downtime, t_end=int(stop))
         state, params = netem_mod.install(
             state.replace(nm=None), params, tl)
-        if not args.quiet:
+        if not quiet:
             print(f"[shadow1-tpu] netem: {tl.describe()}", file=sys.stderr)
 
     # Observability rings are built in the mesh layout when the run will
@@ -232,18 +324,15 @@ def run_config(args) -> int:
                               and asm.pcap_mask.any())
     if want_pcap:
         if not args.data_directory:
-            print("error: packet capture requires --data-directory",
-                  file=sys.stderr)
-            return 2
+            raise CliError("packet capture requires --data-directory")
         from .core.state import make_capture_ring
         state = state.replace(cap=make_capture_ring(args.pcap_ring,
                                                     shards=n_dev))
         if args.pcap:
             # An explicit global capture must not be filtered down by
             # per-host logpcap masks.
-            import jax.numpy as jnp_m
             params = params.replace(
-                pcap_mask=jnp_m.ones_like(params.pcap_mask))
+                pcap_mask=jnp.ones_like(params.pcap_mask))
 
     # Leveled sim-time event log (reference ShadowLogger): enabled by
     # --log-level or any per-host <host loglevel>.
@@ -258,15 +347,10 @@ def run_config(args) -> int:
                   f"(known: {sorted(k for k in _LVL if k)}); treating as "
                   f"'off'", file=sys.stderr)
         host_lvls.append(max(_LVL.get(key, 0), global_lvl))
-    drain = None
     if any(host_lvls):
         if not args.data_directory:
-            print("error: --log-level requires --data-directory",
-                  file=sys.stderr)
-            return 2
-        import jax.numpy as jnp_
+            raise CliError("--log-level requires --data-directory")
         from .core.state import make_log_ring
-        from .observe import LogDrain
         ring = args.log_ring
         if ring <= 0:
             # Debug level (global OR per-host) logs ~2 records per
@@ -275,16 +359,21 @@ def run_config(args) -> int:
             ring = (1 << 20) if max(host_lvls) >= 2 else (1 << 16)
         state = state.replace(
             log=make_log_ring(ring, shards=n_dev),
-            log_level=jnp_.asarray(host_lvls, jnp_.int32))
-        drain = LogDrain(
-            __import__("os").path.join(args.data_directory, "shadow.log"),
-            asm.hostnames)
+            log_level=jnp.asarray(host_lvls, jnp.int32))
+
     # Real-process plugins (config <plugin path> pointing at an actual
     # executable): spawn them under the substrate at their start times
     # and drive the run through the window-protocol bridge.
     substrate = None
     if asm.real_procs:
-        from .substrate import Substrate, bridge as _bridge
+        if not allow_substrate:
+            raise CliError(
+                "this run drives real-process plugins under the "
+                "substrate; replay cannot restore external process "
+                "state")
+        import os as _os
+
+        from .substrate import Substrate
         dns = asm.dns
 
         def _res_ip(ip):
@@ -302,7 +391,7 @@ def run_config(args) -> int:
         workdir = args.data_directory or "shadow1-procs"
         substrate = Substrate(
             resolve_ip=_res_ip,
-            workdir=__import__("os").path.join(workdir, "procs"),
+            workdir=_os.path.join(workdir, "procs"),
             # Low slots belong to the modeled side (tgen listener=0,
             # client=1); real processes allocate above them.
             sock_slot_base=2,
@@ -310,11 +399,11 @@ def run_config(args) -> int:
             host_ip=lambda i: dns.address_of(i).ip)
         for host_i, argv, start_ns, stop_ns in asm.real_procs:
             substrate.spawn_at(host_i, argv, start_ns, stop_ns)
-        if not args.quiet:
+        if not quiet:
             print(f"[shadow1-tpu] {len(asm.real_procs)} real process(es) "
                   f"under the substrate", file=sys.stderr)
 
-    if profiler is not None:
+    if args.profile:
         from . import trace
         # Device-side per-window counters, fetched once per drain point.
         state = trace.ensure_counters(state)
@@ -326,79 +415,189 @@ def run_config(args) -> int:
         from . import shapes
         h0 = int(state.hosts.num_hosts)
         state, params = shapes.pad_world_to_bucket(state, params)
-        if not args.quiet and int(state.hosts.num_hosts) != h0:
+        if not quiet and int(state.hosts.num_hosts) != h0:
             print(f"[shadow1-tpu] bucket: {h0} -> "
                   f"{int(state.hosts.num_hosts)} hosts", file=sys.stderr)
 
     mesh = None
-    parallel_mod = None
     if args.devices > 1:
         # The observability stack runs sharded (rings built with
         # shards=N above, counters finalized across shards); only the
         # substrate bridge remains single-device (per-host syscall RPC
         # serialized through one device).
         if substrate is not None:
-            print("error: --devices is incompatible with real-process "
-                  "plugins (<plugin> with a real executable): the "
-                  "substrate bridge drives one device.  That is the only "
-                  "remaining refusal -- --pcap, --log-level, --profile, "
-                  "--progress and heartbeats all run sharded (see "
-                  "docs/parallel.md)", file=sys.stderr)
-            return 2
+            raise CliError(
+                "--devices is incompatible with real-process "
+                "plugins (<plugin> with a real executable): the "
+                "substrate bridge drives one device.  That is the only "
+                "remaining refusal -- --pcap, --log-level, --profile, "
+                "--progress and heartbeats all run sharded (see "
+                "docs/parallel.md)")
         from . import parallel as parallel_mod
-        devs = jax.devices()
-        if len(devs) < args.devices:
-            print(f"error: --devices {args.devices} but only {len(devs)} "
-                  f"{jax.default_backend()} device(s) visible",
-                  file=sys.stderr)
-            return 2
-        mesh = parallel_mod.make_mesh(devs[:args.devices])
+        if want_mesh:
+            devs = jax.devices()
+            if len(devs) < args.devices:
+                raise CliError(
+                    f"--devices {args.devices} but only {len(devs)} "
+                    f"{jax.default_backend()} device(s) visible")
+            mesh = parallel_mod.make_mesh(devs[:args.devices])
         state, params = parallel_mod.pad_world_to_mesh(
             state, params, args.devices)
-        if not args.quiet:
+        if not quiet:
             print(f"[shadow1-tpu] mesh: {args.devices} devices, "
                   f"{int(state.hosts.num_hosts) // args.devices} hosts "
                   f"per shard", file=sys.stderr)
 
-    flight = None
-    if profiler is not None:
+    if args.profile or getattr(args, "checkpoint_every", None):
         # Per-window flight recorder (installed AFTER mesh padding so the
         # shard matrices match the padded host count); drained at the
         # same chunk boundaries as the counters -- no extra syncs.
+        # Checkpointed runs always carry it: windows.jsonl is the record
+        # replay verifies against.
+        from . import trace
         state = trace.ensure_flight_recorder(state, shards=n_dev)
-        flight = trace.FlightDrain(
-            __import__("os").path.join(args.data_directory,
-                                       "windows.jsonl"))
 
-    scope = None
-    if scope_kw is not None:
+    if args.scope:
         # Flowscope sampling block (same AFTER-mesh-padding rule: each
         # shard owns a ring segment sized off the padded host count).
         from . import trace as _trace_mod
-        _os_s = __import__("os")
+        try:
+            scope_kw = _trace_mod.parse_scope_spec(args.scope)
+        except ValueError as e:
+            raise CliError(str(e))
         state = _trace_mod.ensure_flowscope(state, shards=n_dev,
                                             **scope_kw)
-        scope = _trace_mod.ScopeDrain(
-            flows_path=_os_s.path.join(args.data_directory, "flows.jsonl")
+        if not quiet:
+            print(f"[shadow1-tpu] scope: {args.scope}", file=sys.stderr)
+
+    return types.SimpleNamespace(
+        asm=asm, state=state, params=params, app=app, stop=int(stop),
+        n_dev=n_dev, mesh=mesh, substrate=substrate,
+        want_pcap=want_pcap, host_lvls=host_lvls)
+
+
+def run_config(args) -> int:
+    import os
+
+    from . import trace
+
+    profiler = None
+    if args.profile:
+        if not args.data_directory:
+            print("error: --profile requires --data-directory",
+                  file=sys.stderr)
+            return 2
+        profiler = trace.install(trace.Profiler(sync=True))
+
+    scope_kw = None
+    if args.scope:
+        if not args.data_directory:
+            print("error: --scope requires --data-directory",
+                  file=sys.stderr)
+            return 2
+        try:
+            scope_kw = trace.parse_scope_spec(args.scope)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    ck_every_ns = None
+    if getattr(args, "checkpoint_every", None):
+        if args.checkpoint_every <= 0:
+            print("error: --checkpoint-every must be positive",
+                  file=sys.stderr)
+            return 2
+        if not args.data_directory:
+            print("error: --checkpoint-every requires --data-directory",
+                  file=sys.stderr)
+            return 2
+        ck_every_ns = int(args.checkpoint_every * SEC)
+
+    t_wall = time.perf_counter()
+    try:
+        w = build_world(args, quiet=args.quiet)
+    except CliError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return e.rc
+    asm = w.asm
+    state, params, app = w.state, w.params, w.app
+    stop, n_dev, mesh, substrate = w.stop, w.n_dev, w.mesh, w.substrate
+
+    if substrate is not None and ck_every_ns:
+        print("error: --checkpoint-every is incompatible with "
+              "real-process plugins: external process state cannot be "
+              "snapshotted or replayed", file=sys.stderr)
+        return 2
+    if substrate is not None:
+        from .substrate import bridge as _bridge
+
+    tracker = None
+    if args.data_directory and args.heartbeat_frequency > 0:
+        from .observe import Tracker
+        tracker = Tracker(args.data_directory, asm.hostnames,
+                          interval_s=args.heartbeat_frequency,
+                          per_host_interval_s=asm.heartbeat_freq_s)
+
+    drain = None
+    if state.log is not None and args.data_directory:
+        from .observe import LogDrain
+        drain = LogDrain(os.path.join(args.data_directory, "shadow.log"),
+                         asm.hostnames)
+
+    flight = None
+    if state.fr is not None and args.data_directory:
+        flight = trace.FlightDrain(
+            os.path.join(args.data_directory, "windows.jsonl"))
+
+    scope = None
+    if scope_kw is not None and state.scope is not None:
+        scope = trace.ScopeDrain(
+            flows_path=os.path.join(args.data_directory, "flows.jsonl")
             if scope_kw["flows"] else None,
-            links_path=_os_s.path.join(args.data_directory, "links.jsonl")
+            links_path=os.path.join(args.data_directory, "links.jsonl")
             if scope_kw["links"] else None,
             real_hosts=len(asm.hostnames))
+
+    ck = None
+    if ck_every_ns:
+        from . import replay as replay_mod
+        ck = replay_mod.Checkpointer(
+            args.data_directory, ck_every_ns, devices=n_dev,
+            bucket=args.bucket, hosts_real=len(asm.hostnames))
+        replay_mod.write_run_json(args.data_directory, {
+            "world": {"kind": "config", "args": world_args(args)},
+            "hb_ns": tracker.sample_interval_ns if tracker else None,
+            "every_ns": ck_every_ns, "stop_ns": int(stop),
+            "chunk_ns": engine.CHUNK_NS, "devices": n_dev,
+            "bucket": bool(args.bucket),
+            "hosts_real": len(asm.hostnames),
+            "scope": args.scope, "profile": bool(args.profile)})
+        ck.save(state, params)   # win_0: a replay anchor always exists
         if not args.quiet:
-            print(f"[shadow1-tpu] scope: {args.scope}", file=sys.stderr)
+            print(f"[shadow1-tpu] checkpoints: every "
+                  f"{args.checkpoint_every}s -> {ck.dir}",
+                  file=sys.stderr)
 
     progress = None
     if args.progress:
         from .observe import Progress
         progress = Progress(int(stop))
 
+    from .replay import next_sync
+    if mesh is not None:
+        from . import parallel as parallel_mod
+    hb_ns = tracker.sample_interval_ns if tracker else None
     t = int(state.now)
     hb_next = 0
     while t < stop:
-        # Advance one heartbeat interval (or to the end) per outer step so
-        # the tracker samples between bounded device launches.
-        t_next = min(t + (tracker.sample_interval_ns if tracker else stop),
-                     stop)
+        # Advance to the next launch boundary on the memoryless union
+        # grid of heartbeat and checkpoint multiples (replay.next_sync):
+        # the tracker samples between bounded device launches, the
+        # checkpointer saves on cadence multiples, and a replay can
+        # re-derive the identical boundary sequence from any mid-run
+        # checkpoint (window ends clip at launch targets, so the
+        # flight-recorder record depends on this schedule).
+        t_next = next_sync(t, int(stop), hb_ns, ck_every_ns)
         if substrate is not None:
             state = _bridge.run(substrate, state, params, app, t_next)
         elif mesh is not None:
@@ -418,6 +617,8 @@ def run_config(args) -> int:
             flight.drain(state, profiler)
         if scope is not None:
             scope.drain(state, profiler)
+        if ck is not None:
+            ck.maybe(state, params, t)
         if progress is not None:
             progress.update(state, t)
     if progress is not None:
@@ -450,7 +651,7 @@ def run_config(args) -> int:
             "packets_killed": int(state.nm.killed),
             "hosts_down_at_stop": int(jnp.sum(state.nm.host_up == 0)),
         }
-    if want_pcap and args.data_directory:
+    if w.want_pcap and args.data_directory:
         import os as _os
         from .observe import write_pcap
         ip_of = lambda i: asm.dns.address_of(i).ip  # noqa: E731
@@ -495,11 +696,19 @@ def run_config(args) -> int:
         summary["processes_running_at_stop"] = sum(
             1 for p in procs if not p.exited)
     if profiler is not None:
-        import os as _os2
         trace.fetch_counters(state, profiler)
+    if flight is not None:
+        flight.drain(state, profiler)
+        flight.close()
+    if ck is not None:
+        summary["checkpoints"] = {
+            "dir": ck.dir, "count": len(ck.saved),
+            "every_seconds": ck_every_ns / SEC,
+            "last_window": ck.saved[-1]["window"] if ck.saved else None,
+        }
+    if profiler is not None:
+        import os as _os2
         if flight is not None:
-            flight.drain(state, profiler)
-            flight.close()
             profiler.set_flight(
                 flight.rows, flight.summary(state, n_devices=n_dev))
         trace_path = _os2.path.join(args.data_directory, "trace.json")
@@ -518,6 +727,36 @@ def run_config(args) -> int:
     if substrate is not None and summary["processes_failed"]:
         return 3
     return 0 if int(state.err) == 0 else 2
+
+
+def replay_cmd(args) -> int:
+    """`shadow1-tpu replay`: restore, re-run, verify.  Exit codes:
+    0 verified OK, 1 replay DIVERGED (first differing window printed),
+    2 usage/environment errors."""
+    from . import replay as replay_mod
+    from .trace import ReplayDivergence
+    try:
+        summary = replay_mod.replay(
+            args.data_directory, window=args.window, time_s=args.time,
+            out_dir=args.out, devices=args.devices, scope=args.scope,
+            log_level=args.log_level, pcap=args.pcap,
+            pcap_ring=args.pcap_ring, log_ring=args.log_ring,
+            profile=args.profile, progress=args.progress,
+            verify=not args.no_verify, quiet=args.quiet)
+    except ReplayDivergence as e:
+        print(f"error: {e}", file=sys.stderr)
+        print(json.dumps({"replay_diverged": {
+            "window": e.window, "fields": e.fields,
+            "got": e.got, "want": e.want}}))
+        return 1
+    except CliError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return e.rc
+    except (FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(summary))
+    return 0
 
 
 def warm_cmd(args) -> int:
@@ -539,6 +778,8 @@ def main(argv=None) -> int:
     args = _parser().parse_args(argv)
     if args.cmd == "run":
         return run_config(args)
+    if args.cmd == "replay":
+        return replay_cmd(args)
     if args.cmd == "warm":
         return warm_cmd(args)
     return 1

@@ -367,17 +367,22 @@ class Progress:
     the logger; our heartbeats go to CSV, so silence needed a channel).
     """
 
-    def __init__(self, stop_ns: int, out=None, min_interval_s: float = 2.0):
+    def __init__(self, stop_ns: int, out=None, min_interval_s: float = 2.0,
+                 start_ns: int = 0):
         import sys
         import time as _time
         self.stop_ns = int(stop_ns)
+        # start_ns anchors the percentage/ETA for spans that begin
+        # mid-run (a checkpoint replay): progress covers
+        # [start_ns, stop_ns], not [0, stop_ns].
+        self.start_ns = int(start_ns)
         self.out = out if out is not None else sys.stderr
         self.min_interval = min_interval_s
         self._clock = _time.perf_counter
         self._wall_last = self._clock()
         self._ev_last = 0
         self._win_last = 0
-        self._t_last = 0
+        self._t_last = self.start_ns
 
     def update(self, state, t_ns: int, force: bool = False):
         now = self._clock()
@@ -399,7 +404,8 @@ class Progress:
             eta = f"{e // 3600}:{(e // 60) % 60:02d}:{e % 60:02d}"
         else:
             eta = "-:--:--"
-        pct = 100.0 * int(t_ns) / max(self.stop_ns, 1)
+        pct = 100.0 * (int(t_ns) - self.start_ns) \
+            / max(self.stop_ns - self.start_ns, 1)
         self.out.write(
             f"[progress] sim {int(t_ns) / SEC:.1f}s/"
             f"{self.stop_ns / SEC:.1f}s ({pct:.0f}%) | "

@@ -24,7 +24,7 @@ from .mesh import exchange_probe_ms, mesh_run_chunked, mesh_run_until
 from .sharding import (HOST_AXIS, assert_packed_pool_sharding, make_mesh,
                        pad_params_to_mesh, pad_state_to_mesh,
                        pad_world_to_mesh, shard_params, shard_state,
-                       sharded_run_until)
+                       sharded_run_until, unshard)
 
 __all__ = [
     "HOST_AXIS",
@@ -39,4 +39,5 @@ __all__ = [
     "shard_params",
     "shard_state",
     "sharded_run_until",
+    "unshard",
 ]

@@ -324,6 +324,19 @@ def shard_params(params, mesh: Mesh):
     return jax.tree_util.tree_map_with_path(place, params)
 
 
+def unshard(tree):
+    """Gather a (possibly mesh-sharded) pytree to host-side numpy arrays
+    in ONE transfer.
+
+    On a sharded state every `P(HOST_AXIS)` leaf lives as per-device
+    segments; `jax.device_get` reassembles the full global array, so the
+    result is layout-identical to a single-device fetch of the same
+    world.  checkpoint.save runs every snapshot through this, which is
+    what makes mesh-run checkpoints restorable onto a different device
+    count (the shard layout is a manifest stamp, not a file layout)."""
+    return jax.device_get(tree)
+
+
 def assert_packed_pool_sharding(state, mesh: Mesh) -> None:
     """Layout contract of the packed packet pool on a mesh: the outbox
     is exactly ONE 2-D [P, C] block leaf, and that leaf shards its pool

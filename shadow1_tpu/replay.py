@@ -1,0 +1,537 @@
+"""Time-travel debugging: checkpoint-anchored deterministic replay.
+
+The reference cannot revisit a past moment of a simulation: reproducing
+a mid-run anomaly means re-running from t=0 with more logging compiled
+in and hoping the bug is deterministic across the rebuild.  Here the
+whole simulation is one pytree of dense arrays and the trajectory is
+bitwise-deterministic, so a periodic snapshot (checkpoint.save at an
+existing chunk-boundary sync) is a *resume point for the debugger*:
+
+  1. `Checkpointer` -- rides the run loop, writing `ckpt/win_<K>.npz`
+     snapshots at a sim-time cadence plus `ckpt/run.json` (the recipe
+     to rebuild the world template and the exact launch-boundary grid).
+     Saves are host-side only (device_get + npz): the compiled graphs
+     are byte-identical with checkpointing on or off, and the saved
+     trajectory is bitwise the trajectory of an uncheckpointed run over
+     the same launch grid.
+  2. `replay` -- restores the nearest checkpoint at-or-before a target
+     window, re-runs the original launch schedule to the target, and
+     cross-checks every flight-recorder row bitwise against the
+     original run's windows.jsonl (trace.FlightDrain verify_against).
+     Divergence is a loud trace.ReplayDivergence naming the first
+     differing window -- never silent garbage.
+  3. On-demand instrumentation -- the replayed span can carry blocks
+     the original run did not pay for (--scope, --log, --pcap,
+     --profile): installed AFTER the checkpoint loads, they are
+     trajectory-neutral (observability never feeds back into the
+     simulation), so the replay still verifies bitwise while producing
+     the flow samples / event log / capture the original never wrote.
+
+Determinism fine print: window boundaries clip at launch targets
+(core/engine.py run_until_impl ends each launch at exactly t_target),
+so flight-recorder ROWS depend on the launch schedule.  The run loop
+therefore advances on a *memoryless union grid* -- multiples of the
+heartbeat interval, multiples of the checkpoint cadence, and the stop
+time (`next_sync`) -- which a replay can re-derive from any mid-run
+time.  run.json records the grid (hb_ns/every_ns/stop_ns/chunk_ns);
+replay walks the identical boundaries from the checkpoint's t.
+
+Mesh / bucket safety: checkpoints of `--devices N` / `--bucket` runs
+record the shard layout and padding in the manifest (checkpoint.py).
+The template is ALWAYS rebuilt at the original device count (padding
+and per-shard ring segmentation are baked into the saved arrays);
+`replay --devices` only picks the *execution* -- the original mesh, or
+a single-device gather, which refuses when per-shard cap/log/scope
+ring segments are present (those only run under their mesh) but is
+always legal for the flight recorder (its shard matrices are computed
+from host ids off-mesh, bitwise identical; core/state.py).
+
+See docs/observability.md "Time-travel replay".
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+from . import checkpoint
+from .core import engine, simtime
+
+SEC = simtime.SIMTIME_ONE_SECOND
+
+RUN_JSON_VERSION = 1
+
+
+def next_sync(t, stop, hb_ns=None, every_ns=None) -> int:
+    """The next launch boundary after sim-time `t`: the smallest of the
+    next heartbeat multiple, the next checkpoint-cadence multiple, and
+    `stop`.
+
+    Memoryless in `t` (a pure function of the grid, not of how the loop
+    got to `t`), which is the property replay depends on: restarting
+    the walk from a checkpoint's sim time reproduces the original
+    run's launch boundaries exactly, and with them the window sequence
+    (window ends clip at launch targets, core/engine.py).  With both
+    steps None this is one launch to `stop` -- the uncheckpointed,
+    heartbeat-less CLI behavior, unchanged."""
+    t, stop = int(t), int(stop)
+    nxt = stop
+    for step in (hb_ns, every_ns):
+        if step:
+            step = int(step)
+            nxt = min(nxt, (t // step + 1) * step)
+    return min(nxt, stop)
+
+
+class Checkpointer:
+    """Writes `ckpt/win_<K>.npz` snapshots at the checkpoint cadence.
+
+    Rides the existing chunk-boundary syncs of the run loop: `maybe`
+    saves exactly when the loop crosses a multiple of `every_ns`, and
+    `save` is pure host work (checkpoint.save device_gets the pytree
+    and writes an npz), so checkpointing changes nothing the device
+    sees -- compiled graphs and the trajectory are bitwise identical
+    to a run without it.  Each snapshot is stamped (via the checkpoint
+    manifest) with its ShapeKey fingerprint, global window index, sim
+    time, and the mesh/bucket layout; `ckpt/index.json` lists them."""
+
+    def __init__(self, data_dir: str, every_ns: int, *, devices: int = 1,
+                 bucket: bool = False, hosts_real: int | None = None):
+        self.data_dir = data_dir
+        self.dir = os.path.join(data_dir, "ckpt")
+        os.makedirs(self.dir, exist_ok=True)
+        self.every_ns = int(every_ns)
+        if self.every_ns <= 0:
+            raise ValueError("checkpoint cadence must be positive")
+        self.devices = int(devices)
+        self.bucket = bool(bucket)
+        self.hosts_real = hosts_real
+        self.saved = []
+        self._next = 0          # save at t=0 (win_0), then every multiple
+
+    def _extra(self, state, params) -> dict:
+        h = int(state.hosts.num_hosts)
+        real = self.hosts_real
+        if real is None:
+            real = int(params.hosts_real) \
+                if params.hosts_real is not None else h
+        return {"devices": self.devices, "bucket": self.bucket,
+                "hosts_padded": h, "hosts_real": int(real)}
+
+    def save(self, state, params) -> str:
+        w = int(state.n_windows)
+        t = int(state.now)
+        path = os.path.join(self.dir, f"win_{w}.npz")
+        checkpoint.save(path, state, params,
+                        manifest=self._extra(state, params))
+        self.saved.append({"window": w, "t_ns": t,
+                           "file": os.path.basename(path)})
+        self._next = (t // self.every_ns + 1) * self.every_ns
+        with open(os.path.join(self.dir, "index.json"), "w") as f:
+            json.dump({"checkpoints": self.saved}, f, indent=1)
+        return path
+
+    def maybe(self, state, params, t) -> bool:
+        """Save if the loop has reached the next cadence multiple.
+        Call at launch boundaries AFTER the drains, so windows.jsonl
+        holds every row below the snapshot's window when it lands."""
+        if int(t) >= self._next:
+            self.save(state, params)
+            return True
+        return False
+
+
+def write_run_json(data_dir: str, info: dict) -> str:
+    """Record the replay recipe: the world (a config-args dict or a
+    sim.build_* builder call), the launch grid (hb_ns / every_ns /
+    stop_ns / chunk_ns), and the layout (devices / bucket /
+    hosts_real)."""
+    d = {"version": RUN_JSON_VERSION}
+    d.update(info)
+    path = os.path.join(data_dir, "ckpt", "run.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(d, f, indent=1, sort_keys=True)
+    return path
+
+
+def load_run(data_dir: str) -> dict:
+    path = os.path.join(data_dir, "ckpt", "run.json")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path}: not a checkpointed run directory (re-run with "
+            f"--checkpoint-every / sim.run(checkpoint_every=...) to "
+            f"make a run replayable)")
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_windows(path_or_dir: str) -> list:
+    """The recorded flight-recorder rows, one dict per line.  Accepts
+    the run directory or the windows.jsonl path itself."""
+    path = path_or_dir
+    if os.path.isdir(path):
+        path = os.path.join(path, "windows.jsonl")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path}: no flight-recorder record (checkpointed runs "
+            f"always write one)")
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+def find_checkpoint(data_dir: str, window: int | None):
+    """(path, manifest) of the nearest checkpoint at-or-before the
+    global window index `window` (None: the newest checkpoint)."""
+    best = None
+    for p in glob.glob(os.path.join(data_dir, "ckpt", "win_*.npz")):
+        name = os.path.basename(p)
+        try:
+            w = int(name[4:-4])
+        except ValueError:
+            continue
+        if (window is None or w <= window) and \
+                (best is None or w > best[0]):
+            best = (w, p)
+    if best is None:
+        raise FileNotFoundError(
+            f"no checkpoint at or before window {window} under "
+            f"{os.path.join(data_dir, 'ckpt')}")
+    man = checkpoint.read_manifest(best[1])
+    if man is None:
+        raise ValueError(
+            f"{best[1]} predates the manifest format and cannot anchor "
+            f"a replay (re-run with --checkpoint-every)")
+    return best[1], man
+
+
+def rebuild_world(info: dict, data_dir: str, *, want_mesh: bool = True):
+    """Rebuild the run's world TEMPLATE from its run.json recipe: the
+    same blocks, shapes, and padding as the original run, ready for
+    checkpoint.load.  `want_mesh=False` skips Mesh construction (a
+    single-device gather replay) but still applies the original mesh
+    PADDING -- the checkpoint's array shapes include it."""
+    world = info.get("world") or {}
+    kind = world.get("kind")
+    if kind == "config":
+        import argparse
+
+        from . import cli
+        ns = argparse.Namespace(data_directory=data_dir, quiet=True,
+                                heartbeat_frequency=0, progress=False,
+                                **world["args"])
+        w = cli.build_world(ns, quiet=True, want_mesh=want_mesh,
+                            allow_substrate=False)
+        return {"state": w.state, "params": w.params, "app": w.app,
+                "n_dev": w.n_dev, "mesh": w.mesh, "asm": w.asm,
+                "hostnames": list(w.asm.hostnames)}
+    if kind == "builder":
+        return _rebuild_builder(info, want_mesh=want_mesh)
+    raise ValueError(
+        f"run.json world kind {kind!r} is not replayable (expected "
+        f"'config' or 'builder')")
+
+
+def _rebuild_builder(info: dict, want_mesh: bool = True):
+    """A programmatic world: re-call sim.build_<name>(**kwargs) and
+    re-apply the instrumentation the checkpointed run carried, in the
+    same order sim.run's checkpoint path installs it (bucket pad, mesh
+    pad, scope, counters, flight recorder)."""
+    from . import sim, trace
+    world = info["world"]
+    name = world.get("name")
+    builder = getattr(sim, f"build_{name}", None) if name else None
+    if builder is None:
+        raise ValueError(
+            f"run.json names unknown world builder {name!r} (known: "
+            f"the sim.build_* family)")
+    state, params, app = builder(**(world.get("kwargs") or {}))
+    if info.get("bucket"):
+        from . import shapes
+        state, params = shapes.pad_world_to_bucket(state, params)
+    n = int(info.get("devices") or 1)
+    mesh = None
+    if n > 1:
+        from . import parallel
+        if want_mesh:
+            import jax
+            devs = jax.devices()
+            if len(devs) < n:
+                raise ValueError(
+                    f"replay: the run used {n} devices but only "
+                    f"{len(devs)} visible -- pass --devices 1 to gather "
+                    f"onto one device")
+            mesh = parallel.make_mesh(devs[:n])
+        state, params = parallel.pad_world_to_mesh(state, params, n)
+    if info.get("scope"):
+        state = trace.ensure_flowscope(
+            state, shards=n, **trace.parse_scope_spec(info["scope"]))
+    if info.get("profile"):
+        state = trace.ensure_counters(state)
+    state = trace.ensure_flight_recorder(state, shards=n)
+    h_real = int(info.get("hosts_real") or int(state.hosts.num_hosts))
+    return {"state": state, "params": params, "app": app, "n_dev": n,
+            "mesh": mesh, "asm": None,
+            "hostnames": [f"host{i}" for i in range(h_real)]}
+
+
+def _ring_shards(total) -> int:
+    return 1 if total.ndim == 0 else int(total.shape[0])
+
+
+def _reset_instrumentation(state):
+    """Zero the cap/log/scope rings of a freshly loaded checkpoint so
+    replay drains emit only rows the replayed span itself produces, not
+    stale records the original run left in the saved rings.  Ring
+    contents never feed back into the simulation (observability is
+    trajectory-neutral by design), so this cannot perturb the replay;
+    the flowscope keeps its interval/next_due so sampling stays on the
+    original cadence phase.  The flight recorder is NOT reset -- its
+    cursor is the global window index FlightDrain(start=K0) needs."""
+    from .core.state import (make_capture_ring, make_flowscope,
+                             make_log_ring)
+    reps = {}
+    if state.cap is not None:
+        reps["cap"] = make_capture_ring(
+            state.cap.capacity, shards=_ring_shards(state.cap.total))
+    if state.log is not None:
+        reps["log"] = make_log_ring(
+            state.log.capacity, shards=_ring_shards(state.log.total))
+    if state.scope is not None:
+        sc = state.scope
+        fresh = make_flowscope(
+            flow_capacity=sc.flow_capacity,
+            link_capacity=sc.link_capacity,
+            interval_ns=int(sc.interval), shards=sc.n_shards,
+            flows=sc.sample_flows, links=sc.sample_links)
+        reps["scope"] = fresh.replace(next_due=sc.next_due,
+                                      samples=sc.samples)
+    return state.replace(**reps) if reps else state
+
+
+_LOG_LVL = {None: 0, "off": 0, "warning": 1, "debug": 2}
+
+
+def replay(data_dir: str, *, window: int | None = None,
+           time_s: float | None = None, out_dir: str | None = None,
+           devices: int | None = None, scope: str | None = None,
+           log_level: str = "off", pcap: bool = False,
+           pcap_ring: int = 1 << 17, log_ring: int = 0,
+           profile: bool = False, progress: bool = False,
+           verify: bool = True, quiet: bool = True) -> dict:
+    """Re-run a span of a checkpointed simulation, bitwise-verified.
+
+    Targets the global window index `window` (or the window containing
+    sim-second `time_s`; default: the last recorded window), restores
+    the nearest checkpoint at-or-before it, re-runs the original launch
+    grid to the target, and -- unless `verify=False` -- cross-checks
+    every replayed flight-recorder row against the original
+    windows.jsonl, raising trace.ReplayDivergence at the first bitwise
+    mismatch.  Instrumentation the original run lacked (`scope`,
+    `log_level`, `pcap`, `profile`) is installed AFTER the checkpoint
+    loads; outputs land in `out_dir` (default `<data_dir>/replay`).
+    Returns a summary dict."""
+    import jax
+
+    from . import trace as trace_mod
+
+    info = load_run(data_dir)
+    rows = load_windows(data_dir)
+    if not rows:
+        raise ValueError(
+            f"{data_dir}/windows.jsonl is empty: nothing to replay")
+    by_w = {r["window"]: r for r in rows}
+
+    if window is None and time_s is None:
+        window = max(by_w)
+    elif window is None:
+        t_ns = int(float(time_s) * SEC)
+        cands = [w for w, r in by_w.items() if r["t_start"] <= t_ns]
+        if not cands:
+            raise ValueError(
+                f"--time {time_s}: before the first recorded window "
+                f"(t_start {min(r['t_start'] for r in rows) / SEC}s)")
+        window = max(cands)
+    window = int(window)
+    if window not in by_w:
+        raise ValueError(
+            f"window {window} is not in the recorded windows.jsonl "
+            f"(recorded span: {min(by_w)}..{max(by_w)}; rows older than "
+            f"the ring capacity wrap away between drains -- checkpoint "
+            f"more often to keep the record gap-free)")
+
+    ckpt_path, man = find_checkpoint(data_dir, window)
+    k0, t0 = int(man["window"]), int(man["t_ns"])
+    n_dev_orig = int(man.get("devices") or info.get("devices") or 1)
+    exec_dev = n_dev_orig if devices is None else int(devices)
+    if exec_dev not in (n_dev_orig, 1):
+        raise ValueError(
+            f"replay --devices {exec_dev}: a checkpoint of a "
+            f"{n_dev_orig}-device run replays on the original mesh or "
+            f"gathers to 1 device, nothing in between (the shard layout "
+            f"is baked into the saved rings)")
+
+    built = rebuild_world(info, data_dir,
+                          want_mesh=exec_dev > 1)
+    state, params = checkpoint.load(ckpt_path, built["state"],
+                                    built["params"])
+    app, mesh = built["app"], built["mesh"]
+    if int(state.now) != t0:
+        raise ValueError(
+            f"{ckpt_path}: manifest t_ns {t0} does not match the saved "
+            f"state's clock {int(state.now)} (corrupt checkpoint?)")
+    if exec_dev == 1 and n_dev_orig > 1:
+        for blk_name in ("cap", "log", "scope"):
+            blk = getattr(state, blk_name)
+            if blk is not None and _ring_shards(
+                    blk.total if blk_name != "scope"
+                    else blk.f_total) > 1:
+                raise ValueError(
+                    f"replay --devices 1: the checkpoint carries a "
+                    f"{n_dev_orig}-way sharded {blk_name} ring, which "
+                    f"only runs under its mesh (core/engine.py refuses "
+                    f"sharded rings off-mesh) -- replay with --devices "
+                    f"{n_dev_orig}")
+        mesh = None
+    state = _reset_instrumentation(state)
+
+    # --- on-demand instrumentation: installed AFTER the load, so the
+    # replayed trajectory is the original one plus trajectory-neutral
+    # observers (each changes the pytree -> one recompile, the price of
+    # asking a question the original run did not pay for).
+    import jax.numpy as jnp
+    h = int(state.hosts.num_hosts)
+    h_real = int(man.get("hosts_real") or h)
+    if scope and state.scope is None:
+        state = trace_mod.ensure_flowscope(
+            state, shards=exec_dev, **trace_mod.parse_scope_spec(scope))
+    lvl = _LOG_LVL.get(log_level, 0) if isinstance(log_level, str) \
+        else int(log_level)
+    if lvl and state.log is None:
+        import numpy as np
+
+        from .core.state import make_log_ring
+        ring = log_ring or ((1 << 20) if lvl >= 2 else (1 << 16))
+        levels = np.zeros(h, np.int32)
+        levels[:h_real] = lvl
+        state = state.replace(log=make_log_ring(ring, shards=exec_dev),
+                              log_level=jnp.asarray(levels))
+    if pcap and state.cap is None:
+        from .core.state import make_capture_ring
+        state = state.replace(
+            cap=make_capture_ring(pcap_ring, shards=exec_dev))
+        params = params.replace(pcap_mask=jnp.ones_like(params.pcap_mask))
+    profiler = None
+    if profile:
+        profiler = trace_mod.install(trace_mod.Profiler(sync=True))
+        state = trace_mod.ensure_counters(state)
+
+    out = out_dir or os.path.join(data_dir, "replay")
+    os.makedirs(out, exist_ok=True)
+    flight = trace_mod.FlightDrain(
+        os.path.join(out, "windows.jsonl"), start=k0,
+        verify_against={w: r for w, r in by_w.items() if w >= k0}
+        if verify else None)
+    log_drain = None
+    if state.log is not None:
+        from .observe import LogDrain
+        log_drain = LogDrain(os.path.join(out, "shadow.log"),
+                             built["hostnames"])
+    scope_drain = None
+    if state.scope is not None:
+        sc = state.scope
+        scope_drain = trace_mod.ScopeDrain(
+            flows_path=os.path.join(out, "flows.jsonl")
+            if sc.sample_flows else None,
+            links_path=os.path.join(out, "links.jsonl")
+            if sc.sample_links else None,
+            real_hosts=h_real)
+
+    hb_ns = info.get("hb_ns")
+    every_ns = info.get("every_ns")
+    stop = int(info["stop_ns"])
+    chunk_ns = int(info.get("chunk_ns") or engine.CHUNK_NS)
+    t_goal = int(by_w[window]["t_end"])
+    prog = None
+    if progress:
+        from .observe import Progress
+        prog = Progress(t_goal, start_ns=t0)
+
+    try:
+        t = t0
+        while t < t_goal:
+            t = next_sync(t, stop, hb_ns, every_ns)
+            if mesh is not None:
+                from . import parallel
+                state = parallel.mesh_run_chunked(state, params, app, t,
+                                                  mesh=mesh,
+                                                  chunk_ns=chunk_ns)
+            else:
+                state = engine.run_chunked(state, params, app, t,
+                                           chunk_ns=chunk_ns)
+            if log_drain is not None:
+                log_drain.drain(state)
+            if profiler is not None:
+                trace_mod.fetch_counters(state, profiler)
+            flight.drain(state, profiler)
+            if scope_drain is not None:
+                scope_drain.drain(state, profiler)
+            if prog is not None:
+                prog.update(state, t)
+        if prog is not None:
+            prog.update(state, t, force=True)
+        jax.block_until_ready(state)
+    finally:
+        flight.close()
+        if log_drain is not None:
+            log_drain.close()
+
+    replayed = {r["window"] for r in flight.rows}
+    if window not in replayed:
+        raise RuntimeError(
+            f"replay ran to t={t} but produced no row for window "
+            f"{window} (rows: {sorted(replayed)[:8]}...) -- the launch "
+            f"grid in run.json does not reproduce the original schedule")
+
+    summary = {
+        "replay": {
+            "data_dir": data_dir,
+            "out": out,
+            "checkpoint": os.path.basename(ckpt_path),
+            "from_window": k0,
+            "from_seconds": t0 / SEC,
+            "target_window": window,
+            "to_seconds": t / SEC,
+            "windows_replayed": len(flight.rows),
+            "windows_verified": flight.verified if verify else None,
+            "devices": exec_dev,
+        },
+        "err_flags": int(state.err),
+    }
+    if pcap and state.cap is not None:
+        from .observe import write_pcap
+        asm = built.get("asm")
+        ip_of = (lambda i: asm.dns.address_of(i).ip) if asm else None
+        cap = jax.device_get(state.cap)
+        summary["replay"]["pcap_records"] = write_pcap(
+            os.path.join(out, "capture.pcap"), cap, ip_of_host=ip_of)
+    if scope_drain is not None:
+        scope_drain.drain(state, profiler)
+        scope_drain.close()
+        summary["net"] = scope_drain.summary()
+    if profiler is not None:
+        trace_mod.fetch_counters(state, profiler)
+        profiler.set_flight(flight.rows,
+                            flight.summary(state, n_devices=exec_dev))
+        profiler.write_trace(os.path.join(out, "trace.json"))
+        profiler.write_metrics(os.path.join(out, "metrics.json"),
+                               extra={"replayed_windows":
+                                      len(flight.rows)})
+        trace_mod.install(None)
+    return summary

@@ -11,7 +11,7 @@ into the persistent XLA cache (`shadow1-tpu warm`).
 """
 
 from .key import (HOST_LADDER, VERTEX_LADDER, ShapeKey, bucket_for,
-                  shape_key)
+                  describe_key_mismatch, key_manifest, shape_key)
 from .bucket import pad_world_to_bucket
 from .warm import STANDARD_HOST_BUCKETS, WARM_APPS, warm_buckets
 
@@ -22,6 +22,8 @@ __all__ = [
     "WARM_APPS",
     "ShapeKey",
     "bucket_for",
+    "describe_key_mismatch",
+    "key_manifest",
     "pad_world_to_bucket",
     "shape_key",
     "warm_buckets",

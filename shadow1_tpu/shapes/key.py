@@ -122,6 +122,48 @@ def shape_key(state, params) -> ShapeKey:
     )
 
 
+def key_manifest(key: ShapeKey) -> dict:
+    """JSON-serializable form of a ShapeKey for checkpoint manifests
+    (checkpoint.py): every static as a plain scalar, the present-or-None
+    block signatures as {name: [[shape...], ...]}.  Round-trips through
+    json.dumps/loads bitwise, so saved and freshly-computed manifests
+    compare with plain ==."""
+    d = dataclasses.asdict(key)
+    d["blocks"] = {name: [list(s) for s in sig]
+                   for name, sig in key.blocks}
+    return d
+
+
+def describe_key_mismatch(saved: dict, current: dict) -> str | None:
+    """Name the first difference between two key_manifest() dicts, or
+    None when they match.  Block differences name the BLOCK (a missing
+    flight recorder, a log ring sized differently); static differences
+    name the STATIC (cong, megakernel, pool_slab, ...) -- the load-time
+    diagnosis checkpoint.load prints instead of a bare structure error."""
+    sb = saved.get("blocks", {})
+    cb = current.get("blocks", {})
+    for name in _STATE_BLOCKS:
+        in_s, in_c = name in sb, name in cb
+        if in_s and not in_c:
+            return (f"block {name!r} is present in the checkpoint but "
+                    f"absent on the template (install it before loading)")
+        if in_c and not in_s:
+            return (f"block {name!r} is present on the template but "
+                    f"absent in the checkpoint (build the template "
+                    f"without it; add instrumentation AFTER loading)")
+        if in_s and sb[name] != cb[name]:
+            return (f"block {name!r} leaf shapes differ: checkpoint "
+                    f"{sb[name]} vs template {cb[name]}")
+    for field in sorted(set(saved) | set(current)):
+        if field == "blocks":
+            continue
+        if saved.get(field) != current.get(field):
+            return (f"static {field!r} differs: checkpoint "
+                    f"{saved.get(field)!r} vs template "
+                    f"{current.get(field)!r}")
+    return None
+
+
 def _round_up(n: int, ladder) -> int:
     for rung in ladder:
         if rung >= n:

@@ -279,7 +279,8 @@ def add_churn(state, params, rate_per_s: float,
 
 
 def run(state, params, app, until=None, profiler=None, devices=None,
-        bucket=False, scope=None):
+        bucket=False, scope=None, checkpoint_every=None,
+        checkpoint_dir=None, checkpoint_world=None):
     """Run to `until` (default: params.stop_time).
 
     With `profiler` (a trace.Profiler), the run is profiled: the
@@ -310,11 +311,37 @@ def run(state, params, app, until=None, profiler=None, devices=None,
     sampled trajectory is bitwise-identical to an unsampled one; read
     the rings back with trace.ScopeDrain.  Installed after all padding,
     sharded to match `devices`.
+
+    With `checkpoint_every` (a sim-time cadence in ns) the run becomes
+    replayable (replay.py, docs/observability.md "Time-travel replay"):
+    snapshots land in `checkpoint_dir`/ckpt/win_<K>.npz at existing
+    chunk-boundary syncs, a flight recorder rides the state and drains
+    to `checkpoint_dir`/windows.jsonl, and ckpt/run.json records the
+    launch grid.  Checkpointing is host-side only -- the compiled
+    graphs and the trajectory are bitwise identical to an
+    uncheckpointed run over the same launch grid (the grid itself adds
+    sync points; replay.next_sync).  `checkpoint_world` names the
+    recipe `shadow1-tpu replay` rebuilds the world template from:
+    ("phold", {"num_hosts": 64, ...}) re-calls sim.build_phold with
+    those kwargs at replay time.  Without it the checkpoints still
+    save/load programmatically, but the CLI cannot rebuild the
+    template on its own.
     """
+    h_real = int(state.hosts.num_hosts)
     if bucket:
         from . import shapes
         state, params = shapes.pad_world_to_bucket(state, params)
     t = params.stop_time if until is None else until
+    if checkpoint_every:
+        if not checkpoint_dir:
+            raise ValueError(
+                "sim.run: checkpoint_every requires checkpoint_dir "
+                "(where ckpt/ and windows.jsonl land)")
+        return _run_checkpointed(
+            state, params, app, int(t), profiler=profiler,
+            devices=devices, bucket=bucket, scope=scope,
+            every_ns=int(checkpoint_every), ckdir=checkpoint_dir,
+            world=checkpoint_world, hosts_real=h_real)
 
     def _install_scope(st, shards):
         if scope is None or st.scope is not None:
@@ -359,6 +386,74 @@ def run(state, params, app, until=None, profiler=None, devices=None,
         return state
     finally:
         trace.install(None)
+
+
+def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
+                      scope, every_ns, ckdir, world, hosts_real):
+    """run()'s checkpointing path: same block installs as the plain
+    paths (mesh pad, then scope/counters -- replay._rebuild_builder
+    mirrors this order exactly), plus a flight recorder, a windows.jsonl
+    drain, and Checkpointer saves on the memoryless launch grid
+    (replay.next_sync with hb_ns=None)."""
+    import os
+
+    from . import replay as replay_mod
+    from . import trace
+
+    n = int(devices) if devices else 1
+    mesh = None
+    if n > 1:
+        import jax as _jax
+
+        from . import parallel
+        devs = _jax.devices()
+        if len(devs) < n:
+            raise ValueError(f"sim.run: devices={n} but only {len(devs)} "
+                             f"{_jax.default_backend()} device(s) visible")
+        mesh = parallel.make_mesh(devs[:n])
+        state, params = parallel.pad_world_to_mesh(state, params, n)
+    if scope is not None and state.scope is None:
+        state = trace.ensure_flowscope(state, shards=n,
+                                       **trace.parse_scope_spec(scope))
+    if profiler is not None:
+        trace.install(profiler)
+        state = trace.ensure_counters(state)
+    state = trace.ensure_flight_recorder(state, shards=n)
+
+    os.makedirs(ckdir, exist_ok=True)
+    flight = trace.FlightDrain(os.path.join(ckdir, "windows.jsonl"))
+    ck = replay_mod.Checkpointer(ckdir, every_ns, devices=n,
+                                 bucket=bucket, hosts_real=hosts_real)
+    if world is not None and not isinstance(world, dict):
+        name, kwargs = world
+        world = {"name": name, "kwargs": dict(kwargs or {})}
+    replay_mod.write_run_json(ckdir, {
+        "world": ({"kind": "builder", **world}
+                  if world is not None else None),
+        "hb_ns": None, "every_ns": int(every_ns), "stop_ns": int(t),
+        "chunk_ns": engine.CHUNK_NS, "devices": n,
+        "bucket": bool(bucket), "hosts_real": int(hosts_real),
+        "scope": scope, "profile": profiler is not None})
+    try:
+        ck.save(state, params)          # win_0: a replay anchor always exists
+        tt = int(state.now)
+        while tt < int(t):
+            tt = replay_mod.next_sync(tt, int(t), every_ns=every_ns)
+            if mesh is not None:
+                from . import parallel
+                state = parallel.mesh_run_chunked(state, params, app, tt,
+                                                  mesh=mesh)
+            else:
+                state = engine.run_chunked(state, params, app, tt)
+            if profiler is not None:
+                trace.fetch_counters(state, profiler)
+            flight.drain(state, profiler)
+            ck.maybe(state, params, tt)
+        return state
+    finally:
+        flight.close()
+        if profiler is not None:
+            trace.install(None)
 
 
 def build_onion(num_circuits: int,

@@ -498,10 +498,17 @@ def ensure_flight_recorder(state, capacity: int = 4096, shards: int = 1):
     (idempotent).  `shards` sizes the src->dst exchange matrices and
     must match the device count of a mesh run (1 for single-device);
     the host count and pool capacity must divide it so the logical
-    shard of a host is well defined."""
+    shard of a host is well defined.
+
+    The ring cursor (`fr.total`) seeds from `state.n_windows`, so the
+    row index FlightDrain stamps into windows.jsonl is the GLOBAL
+    monotonically increasing window counter of the simulation -- the
+    same index `replay --window K` addresses -- even when the recorder
+    is installed on a mid-run state."""
     if state.fr is not None:
         return state
-    from .core.state import make_flight_recorder
+    import jax.numpy as _jnp
+    from .core.state import I64, make_flight_recorder
     h = int(state.hosts.num_hosts)
     if shards < 1 or h % shards or int(state.pool.capacity) % shards:
         raise ValueError(
@@ -509,7 +516,28 @@ def ensure_flight_recorder(state, capacity: int = 4096, shards: int = 1):
             f"host count ({h}) and pool capacity "
             f"({int(state.pool.capacity)}); pad the world to the mesh "
             f"first (parallel.pad_world_to_mesh)")
-    return state.replace(fr=make_flight_recorder(capacity, shards))
+    fr = make_flight_recorder(capacity, shards)
+    fr = fr.replace(total=_jnp.asarray(state.n_windows, I64))
+    return state.replace(fr=fr)
+
+
+class ReplayDivergence(RuntimeError):
+    """A replayed trajectory produced a flight-recorder row that differs
+    bitwise from the original run's windows.jsonl record.  Raised by
+    FlightDrain when draining with `verify_against`; carries the first
+    diverging global window index and the differing fields."""
+
+    def __init__(self, window: int, got: dict, want: dict):
+        self.window = int(window)
+        self.got = got
+        self.want = want
+        fields = sorted(k for k in want
+                        if k in got and got[k] != want[k])
+        self.fields = fields
+        super().__init__(
+            f"replay diverged at window {window}: field(s) "
+            f"{', '.join(fields) or '<missing row>'} differ from the "
+            f"recorded windows.jsonl (triage: tools/parse.py replaydiff)")
 
 
 class FlightDrain:
@@ -519,18 +547,43 @@ class FlightDrain:
     appends them to ``windows.jsonl`` when a path is given, and keeps
     them for Profiler.set_flight / aggregation.
 
-    Ring wrap between drains loses the oldest rows; lifetime totals are
-    still exact because the recorder accumulates wrap-proof sums on the
-    device (`ex_*_sum`) -- the drain reports `rows_lost` so a summary
-    reader knows row-derived aggregates are partial."""
+    Every row is stamped with its GLOBAL window index (`"window"`: the
+    simulation's monotonic window counter, which ensure_flight_recorder
+    seeds the ring cursor from) -- the address `replay --window K`
+    restores to.  Ring wrap between drains loses the oldest rows;
+    lifetime totals are still exact because the recorder accumulates
+    wrap-proof sums on the device (`ex_*_sum`) -- the drain reports
+    `rows_lost` so a summary reader knows row-derived aggregates are
+    partial.  CAVEAT: past `capacity` (default 4096) windows between
+    drains the window INDEX stays exact but the per-window RESOLUTION
+    is gone -- wrapped windows have no row, so a replay cross-check (and
+    `replay --window K` targeting) can only address windows that
+    survived into windows.jsonl; checkpoint cadences that drain at
+    least every 4096 windows keep the record gap-free.
 
-    def __init__(self, path: str | None = None):
+    `start` skips rows already drained in an earlier life of the ring:
+    a replay restores a checkpoint whose ring carries the original
+    run's rows below `fr.total`; starting the drain there emits only
+    windows the replay itself produced, numbered exactly as the
+    original run numbered them.
+
+    `verify_against` is the replay-verify hook: a {window: row} mapping
+    of the ORIGINAL run's windows.jsonl records.  Each drained row that
+    has an original counterpart is compared bitwise (full dict
+    equality, exchange matrices included); the first mismatch raises
+    ReplayDivergence naming the window -- divergence is a loud,
+    window-pinpointed error, never silent garbage."""
+
+    def __init__(self, path: str | None = None, start: int = 0,
+                 verify_against: dict | None = None):
         self.path = path
         self.rows = []
         self.rows_lost = 0
         self.shards = None      # learned from the ring at first drain
         self.capacity = None
-        self._last = 0
+        self._last = int(start)
+        self.verify_against = verify_against
+        self.verified = 0       # rows that matched an original record
         self._f = open(path, "w") if path else None
 
     def drain(self, state, profiler=None) -> int:
@@ -569,6 +622,13 @@ class FlightDrain:
                        "ex_cnt": xc[k].tolist(),
                        "ex_bytes": xb[k].tolist()}
                 self.rows.append(row)
+                if self.verify_against is not None and \
+                        w in self.verify_against:
+                    want = self.verify_against[w]
+                    if row != want:
+                        self._last = total
+                        raise ReplayDivergence(w, row, want)
+                    self.verified += 1
                 if self._f is not None:
                     self._f.write(json.dumps(row) + "\n")
             if self._f is not None:

@@ -53,8 +53,82 @@ class TestCheckpoint:
         try:
             checkpoint.load(path, other, oparams)
             assert False, "mismatched template accepted"
-        except ValueError:
-            pass
+        except ValueError as e:
+            # The manifest names the differing static, not a bare
+            # "leaf s8" structure error.
+            assert "hosts" in str(e)
+
+    def test_mismatch_names_block(self, tmp_path):
+        """A template carrying an instrumentation block the checkpoint
+        lacks is named as such, with the install-after-load hint."""
+        from shadow1_tpu import trace
+        state, params, _ = sim.build_phold(num_hosts=8, msgs_per_host=2,
+                                           stop_time=SEC)
+        path = os.path.join(tmp_path, "ckpt.npz")
+        checkpoint.save(path, state, params)
+        t2, p2, _ = sim.build_phold(num_hosts=8, msgs_per_host=2,
+                                    stop_time=SEC)
+        t2 = trace.ensure_flight_recorder(t2, shards=1)
+        try:
+            checkpoint.load(path, t2, p2)
+            assert False, "block mismatch accepted"
+        except ValueError as e:
+            assert "'fr'" in str(e) and "AFTER loading" in str(e)
+
+    def test_manifest_stamps_position(self, tmp_path):
+        state, params, app = sim.build_phold(num_hosts=8, msgs_per_host=2,
+                                             stop_time=2 * SEC, seed=5)
+        half = engine.run_until(state, params, app, 1 * SEC)
+        path = os.path.join(tmp_path, "ckpt.npz")
+        checkpoint.save(path, half, params, manifest={"devices": 1})
+        m = checkpoint.read_manifest(path)
+        assert m["t_ns"] == int(half.now)
+        assert m["window"] == int(half.n_windows)
+        assert m["devices"] == 1
+        assert "shape" in m and "blocks" in m["shape"]
+
+    def test_mesh_roundtrip(self, tmp_path):
+        """A mesh-sharded (devices=8) state saves as one gathered file
+        and loads back into a fresh single-device template bitwise."""
+        from shadow1_tpu.parallel import (make_mesh, mesh_run_chunked,
+                                          pad_world_to_mesh)
+        kw = dict(num_hosts=16, msgs_per_host=2, stop_time=SEC, seed=5)
+        state, params, app = sim.build_phold(**kw)
+        state, params = pad_world_to_mesh(state, params, 8)
+        import jax
+        mesh = make_mesh(jax.devices()[:8])
+        out = mesh_run_chunked(state, params, app, SEC // 2, mesh=mesh)
+        path = os.path.join(tmp_path, "mesh.npz")
+        checkpoint.save(path, out, params,
+                        manifest={"devices": 8, "hosts_real": 16})
+        assert checkpoint.read_manifest(path)["devices"] == 8
+        t_state, t_params, _ = sim.build_phold(**kw)
+        t_state, t_params = pad_world_to_mesh(t_state, t_params, 8)
+        restored, _ = checkpoint.load(path, t_state, t_params)
+        assert _trees_equal(restored, out)
+
+    def test_bucket_roundtrip(self, tmp_path):
+        """A bucket-padded world round-trips; a template padded to a
+        different rung is refused by name."""
+        from shadow1_tpu import shapes
+        kw = dict(num_hosts=6, msgs_per_host=2, stop_time=SEC, seed=7)
+        state, params, app = sim.build_phold(**kw)
+        state, params = shapes.pad_world_to_bucket(state, params)
+        out = engine.run_until(state, params, app, SEC // 2)
+        path = os.path.join(tmp_path, "bucket.npz")
+        checkpoint.save(path, out, params,
+                        manifest={"bucket": True, "hosts_real": 6})
+        t_state, t_params, _ = sim.build_phold(**kw)
+        t_state, t_params = shapes.pad_world_to_bucket(t_state, t_params)
+        restored, _ = checkpoint.load(path, t_state, t_params)
+        assert _trees_equal(restored, out)
+        # Unpadded template: the manifest names the hosts static.
+        u_state, u_params, _ = sim.build_phold(**kw)
+        try:
+            checkpoint.load(path, u_state, u_params)
+            assert False, "unpadded template accepted"
+        except ValueError as e:
+            assert "hosts" in str(e)
 
 
 class TestJitter:

@@ -1,0 +1,360 @@
+"""Time-travel replay: checkpoint-anchored deterministic re-execution
+with on-demand instrumentation (docs/observability.md "Time-travel
+replay").
+
+The contract under test:
+
+* Trajectory neutrality: a run with `checkpoint_every` produces a final
+  state bitwise identical (full pytree) to the same world driven over
+  the same launch grid without any saves -- checkpointing is pure
+  host-side observation.
+* HLO neutrality when absent: checkpoint-free runs lower byte-identical
+  HLO whether or not the checkpoint machinery was ever exercised, and
+  plain sim.run installs no flight recorder.
+* Anchored replay: `replay.replay(dir)` finds the nearest checkpoint at
+  or before the target window, re-runs the SAME launch grid, and
+  bitwise-verifies every replayed flight-recorder row against the
+  recorded windows.jsonl; a corrupted record raises ReplayDivergence
+  naming the window (CLI rc 1).
+* On-demand instrumentation: a flowscope installed only at replay time
+  produces the same sample rows (rate_Bps excluded: drain-cadence
+  derived) as a run instrumented from the start.
+* Mesh/bucket safety: checkpoints of --devices / bucketed runs replay
+  on the original mesh or gathered to one device, bitwise both ways.
+"""
+
+import importlib.util
+import json
+import os
+import shutil
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from shadow1_tpu import cli, replay, sim, trace
+from shadow1_tpu.core import engine, simtime
+
+MS = simtime.SIMTIME_ONE_MILLISECOND
+SEC = simtime.SIMTIME_ONE_SECOND
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+KW = dict(num_hosts=8, msgs_per_host=2, stop_time=2 * SEC, seed=3)
+EVERY = SEC // 2
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _trees_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and \
+        all(jnp.array_equal(x, y) for x, y in zip(la, lb))
+
+
+def _rows(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+@pytest.fixture(scope="module")
+def phold_run(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("phold_ck"))
+    state, params, app = sim.build_phold(**KW)
+    final = sim.run(state, params, app, checkpoint_every=EVERY,
+                    checkpoint_dir=d, checkpoint_world=("phold", KW))
+    return d, final
+
+
+def _corrupted_copy(src, dst, field="delivered", bump=7):
+    """A run dir whose recorded windows.jsonl has one falsified row;
+    returns the falsified window index."""
+    os.makedirs(dst, exist_ok=True)
+    shutil.copytree(os.path.join(src, "ckpt"), os.path.join(dst, "ckpt"))
+    rows = _rows(os.path.join(src, "windows.jsonl"))
+    w = rows[-3]["window"]
+    rows[-3][field] += bump
+    with open(os.path.join(dst, "windows.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return w
+
+
+class TestNextSync:
+    def test_memoryless_grid(self):
+        # Stop only.
+        assert replay.next_sync(0, 10 * SEC) == 10 * SEC
+        # Union of heartbeat and checkpoint grids, clipped at stop.
+        ns = lambda t: replay.next_sync(t, 10_000, hb_ns=3_000,
+                                        every_ns=4_000)
+        assert ns(0) == 3_000
+        assert ns(3_000) == 4_000
+        assert ns(4_000) == 6_000
+        assert ns(6_000) == 8_000
+        assert ns(8_000) == 9_000
+        assert ns(9_500) == 10_000
+        # Memoryless: restarting mid-grid re-derives the same boundary.
+        assert ns(4_000) == ns(4_001 - 1)
+
+    def test_clip_at_stop(self):
+        assert replay.next_sync(900, 1_000, every_ns=400) == 1_000
+
+
+class TestCheckpointedRun:
+    def test_trajectory_neutral(self, phold_run):
+        """Full-pytree bitwise equality against a manual loop over the
+        identical launch grid with no saves: checkpointing never
+        perturbs the trajectory."""
+        d, final = phold_run
+        state, params, app = sim.build_phold(**KW)
+        state = trace.ensure_flight_recorder(state, shards=1)
+        t, stop = 0, int(KW["stop_time"])
+        while t < stop:
+            t = replay.next_sync(t, stop, every_ns=EVERY)
+            state = engine.run_chunked(state, params, app, t)
+        assert _trees_equal(state, final)
+
+    def test_run_dir_layout(self, phold_run):
+        d, final = phold_run
+        ck = os.path.join(d, "ckpt")
+        names = sorted(os.listdir(ck))
+        assert "win_0.npz" in names        # pre-loop anchor
+        assert "run.json" in names and "index.json" in names
+        with open(os.path.join(ck, "run.json")) as f:
+            info = json.load(f)
+        assert info["version"] == replay.RUN_JSON_VERSION
+        assert info["world"]["kind"] == "builder"
+        assert info["world"]["name"] == "phold"
+        assert info["world"]["kwargs"]["num_hosts"] == KW["num_hosts"]
+        assert info["every_ns"] == EVERY
+        with open(os.path.join(ck, "index.json")) as f:
+            idx = json.load(f)
+        saved = {e["window"] for e in idx["checkpoints"]}
+        assert 0 in saved and int(final.n_windows) in saved
+        # Manifests stamp window + time + layout.
+        from shadow1_tpu import checkpoint
+        m = checkpoint.read_manifest(os.path.join(ck, "win_0.npz"))
+        assert m["window"] == 0 and m["t_ns"] == 0
+        assert m["devices"] == 1 and m["bucket"] is False
+
+    def test_hlo_neutral_when_absent(self):
+        """Checkpoint-free runs lower byte-identical HLO before and
+        after a checkpointed run of the same shape, and plain sim.run
+        installs no flight recorder."""
+        kw = dict(num_hosts=4, msgs_per_host=1, stop_time=SEC, seed=1)
+        state, params, app = sim.build_phold(**kw)
+        txt0 = engine.run_until.lower(state, params, app, SEC).as_text()
+        final = sim.run(state, params, app)
+        assert final.fr is None and final.scope is None
+        txt1 = engine.run_until.lower(state, params, app, SEC).as_text()
+        assert txt0 == txt1
+
+    def test_checkpoint_every_requires_dir(self):
+        state, params, app = sim.build_phold(
+            num_hosts=4, msgs_per_host=1, stop_time=SEC)
+        with pytest.raises(ValueError):
+            sim.run(state, params, app, checkpoint_every=SEC)
+
+
+class TestReplay:
+    def test_default_target_verifies_bitwise(self, phold_run):
+        d, _ = phold_run
+        res = replay.replay(d)
+        r = res["replay"]
+        assert r["windows_replayed"] == r["windows_verified"] > 0
+        assert r["from_window"] > 0      # anchored mid-run, not at 0
+        out = _rows(os.path.join(d, "replay", "windows.jsonl"))
+        rec = {x["window"]: x for x in
+               _rows(os.path.join(d, "windows.jsonl"))}
+        assert all(x == rec[x["window"]] for x in out)
+
+    def test_window_and_time_targets(self, phold_run):
+        d, _ = phold_run
+        rec = _rows(os.path.join(d, "windows.jsonl"))
+        mid = rec[len(rec) // 3]["window"]
+        r = replay.replay(d, window=mid,
+                          out_dir=os.path.join(d, "replay_w"))["replay"]
+        assert r["from_window"] <= mid <= r["target_window"]
+        assert r["windows_verified"] > 0
+        r2 = replay.replay(d, time_s=1.2,
+                           out_dir=os.path.join(d, "replay_t"))["replay"]
+        assert r2["from_seconds"] <= 1.2 <= r2["to_seconds"]
+
+    def test_cli_roundtrip(self, phold_run):
+        d, _ = phold_run
+        rc = cli.main(["replay", "--data-directory", d,
+                       "--out", os.path.join(d, "replay_cli"), "--quiet"])
+        assert rc == 0
+
+    def test_divergence_is_loud(self, phold_run, tmp_path):
+        d, _ = phold_run
+        bad = str(tmp_path / "bad")
+        w = _corrupted_copy(d, bad)
+        with pytest.raises(trace.ReplayDivergence) as ei:
+            replay.replay(bad)
+        assert ei.value.window == w
+        assert "delivered" in str(ei.value)
+        assert cli.main(["replay", "--data-directory", bad,
+                         "--quiet"]) == 1
+
+    def test_unknown_dir_and_bad_window(self, phold_run, tmp_path):
+        assert cli.main(["replay", "--data-directory",
+                         str(tmp_path / "nope"), "--quiet"]) == 2
+        d, _ = phold_run
+        with pytest.raises(ValueError):
+            replay.replay(d, window=1 << 20)
+
+
+class TestReplayDiff:
+    def test_digest_pinpoints_first_divergence(self, phold_run, tmp_path):
+        d, _ = phold_run
+        bad = str(tmp_path / "bad")
+        w = _corrupted_copy(d, bad)
+        parse = _load_tool("parse")
+        dg = parse.replaydiff(d, bad)
+        assert dg["identical"] is False
+        assert dg["diverged_windows"] == 1
+        assert dg["first_divergence"]["window"] == w
+        assert set(dg["first_divergence"]["fields"]) == {"delivered"}
+        # Divergence is a non-zero exit, like the replay verifier.
+        assert parse.main(["replaydiff", d, bad]) == 1
+        assert parse.main(["replaydiff", d, d]) == 0
+
+    def test_exchange_matrix_delta(self, phold_run, tmp_path):
+        d, _ = phold_run
+        bad = str(tmp_path / "badex")
+        os.makedirs(bad)
+        rows = _rows(os.path.join(d, "windows.jsonl"))
+        rows[-2]["ex_bytes"][0][0] += 64
+        with open(os.path.join(bad, "windows.jsonl"), "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        parse = _load_tool("parse")
+        dg = parse.replaydiff(d, bad)
+        first = dg["first_divergence"]
+        assert first["window"] == rows[-2]["window"]
+        delta = first["exchange_delta"]["ex_bytes"]
+        assert delta[0]["src"] == 0 and delta[0]["dst"] == 0
+        assert delta[0]["b"] - delta[0]["a"] == 64
+
+
+class TestMeshBucket:
+    def test_mesh_checkpoint_replay(self, tmp_path):
+        """--devices 8 run: replay restores onto the same mesh AND
+        gathers to a single device, bitwise-verified both ways."""
+        kw = dict(num_hosts=16, msgs_per_host=2, stop_time=SEC, seed=5)
+        d = str(tmp_path / "mesh_ck")
+        state, params, app = sim.build_phold(**kw)
+        sim.run(state, params, app, devices=8,
+                checkpoint_every=SEC // 4, checkpoint_dir=d,
+                checkpoint_world=("phold", kw))
+        r = replay.replay(d)["replay"]
+        assert r["devices"] == 8 and r["windows_verified"] > 0
+        r1 = replay.replay(d, devices=1,
+                           out_dir=os.path.join(d, "replay1"))["replay"]
+        assert r1["devices"] == 1
+        assert r1["windows_verified"] == r["windows_verified"]
+        # Arbitrary intermediate device counts are refused.
+        with pytest.raises(ValueError):
+            replay.replay(d, devices=4)
+
+    def test_bucket_checkpoint_replay(self, tmp_path):
+        """Bucketed run (hosts padded up the shape ladder): the manifest
+        records real vs padded hosts and replay re-pads identically."""
+        kw = dict(num_hosts=6, msgs_per_host=2, stop_time=SEC, seed=7)
+        d = str(tmp_path / "bucket_ck")
+        state, params, app = sim.build_phold(**kw)
+        sim.run(state, params, app, bucket=True,
+                checkpoint_every=SEC // 2, checkpoint_dir=d,
+                checkpoint_world=("phold", kw))
+        from shadow1_tpu import checkpoint
+        path, man = replay.find_checkpoint(d, None)
+        assert man["bucket"] is True
+        assert man["hosts_real"] == 6
+        assert man["hosts_padded"] >= 6
+        r = replay.replay(d)["replay"]
+        assert r["windows_verified"] > 0
+
+
+class TestOnDemandScope:
+    def test_replay_scope_matches_scratch(self, tmp_path):
+        """A flowscope installed only at replay time samples the same
+        rows as a run instrumented from the start: cumulative counters
+        live in the (restored) sim state, not the ring.  rate_Bps is
+        drain-cadence derived and excluded; the replay's very first
+        sample epoch may precede the scratch run's next_due and is
+        skipped."""
+        kw = dict(num_hosts=4, bytes_per_client=1 << 14,
+                  reliability=0.9, stop_time=2 * SEC, seed=2)
+        d = str(tmp_path / "bulk_ck")
+        state, params, app = sim.build_bulk(**kw)
+        sim.run(state, params, app, checkpoint_every=SEC,
+                checkpoint_dir=d, checkpoint_world=("bulk", kw))
+
+        # Target a window before the first mid-run checkpoint so the
+        # replay anchors at win_0 and spans the live-flow phase.
+        rec = _rows(os.path.join(d, "windows.jsonl"))
+        target = max(r["window"] for r in rec if r["t_end"] < SEC)
+        res = replay.replay(d, window=target, scope="flows:50ms")
+        assert res["replay"]["from_window"] == 0
+        assert res["replay"]["windows_verified"] > 0
+        got = _rows(os.path.join(d, "replay", "flows.jsonl"))
+        assert got, "replay produced no flow samples"
+
+        # From-scratch instrumented comparator on the SAME launch grid.
+        s2, p2, a2 = sim.build_bulk(**kw)
+        d2 = str(tmp_path / "bulk_scoped")
+        f2 = sim.run(s2, p2, a2, scope="flows:50ms",
+                     checkpoint_every=SEC, checkpoint_dir=d2,
+                     checkpoint_world=("bulk", kw))
+        sd = trace.ScopeDrain(
+            flows_path=os.path.join(d2, "flows.jsonl"))
+        sd.drain(f2)
+        sd.close()
+        want = {(r["t"], r["host"], r["slot"], r["peer"]): r
+                for r in _rows(os.path.join(d2, "flows.jsonl"))}
+
+        t0 = min(r["t"] for r in got)
+        compared = 0
+        for r in got:
+            if r["t"] == t0:
+                continue   # pre-grid epoch of the fresh scope
+            key = (r["t"], r["host"], r["slot"], r["peer"])
+            assert key in want, f"replay-only sample {key}"
+            w = want[key]
+            for k in r:
+                if k == "rate_Bps":
+                    continue
+                assert r[k] == w[k], (key, k, r[k], w[k])
+            compared += 1
+        assert compared > 0
+
+
+class TestConfigWorld:
+    def test_tgen_lossy_checkpoint_replay(self, tmp_path):
+        """The acceptance world: the examples/tgen-2host config
+        (packetloss 0.005) run with --checkpoint-every, replayed with
+        on-demand --scope, bitwise-verified; replaydiff agrees."""
+        cfg = os.path.join(REPO, "examples", "tgen-2host",
+                           "shadow.config.xml")
+        d = str(tmp_path / "tgen_ck")
+        rc = cli.main(["run", cfg, "--data-directory", d,
+                       "--stop-time", "6", "--checkpoint-every", "2",
+                       "--quiet"])
+        assert rc == 0
+        assert os.path.exists(os.path.join(d, "ckpt", "run.json"))
+        rc = cli.main(["replay", "--data-directory", d,
+                       "--scope", "flows", "--quiet"])
+        assert rc == 0
+        out = os.path.join(d, "replay")
+        assert _rows(os.path.join(out, "windows.jsonl"))
+        parse = _load_tool("parse")
+        dg = parse.replaydiff(d, out)
+        assert dg["identical"] is True and dg["compared"] > 0

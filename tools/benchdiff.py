@@ -160,6 +160,21 @@ def _megakernel_config(d: dict):
     return bool(cfg["megakernel"])
 
 
+def _checkpoint_config(d: dict):
+    """The checkpoint cadence a run was recorded with: the
+    config.checkpoint_every stamp (seconds, None when off), or _UNSTAMPED
+    for files written before bench.py stamped it.  Legacy files stay
+    comparable against anything -- only a both-stamped mismatch is a
+    cross-config compare (the megakernel rule)."""
+    cfg = d.get("config")
+    if not isinstance(cfg, dict) or "checkpoint_every" not in cfg:
+        return _UNSTAMPED
+    return cfg["checkpoint_every"]
+
+
+_UNSTAMPED = object()
+
+
 def _kernel_world(d: dict):
     """The fixed-world config a kernelcount report was measured on:
     (backend, world dict) for a standalone tools/kernelcount.py JSON or
@@ -320,6 +335,20 @@ def main(argv=None) -> int:
               f"megakernel configs (old megakernel={mk_old!r}, "
               f"new megakernel={mk_new!r}); re-record with matching "
               f"paths", file=sys.stderr)
+        return 2
+    ck_old, ck_new = _checkpoint_config(old), _checkpoint_config(new)
+    if ck_old is not _UNSTAMPED and ck_new is not _UNSTAMPED \
+            and ck_old != ck_new:
+        # Checkpointing is host-side (the compiled graphs are byte-
+        # identical), but the cadence splits the run into extra launch
+        # boundaries and adds device_get+npz wall time per save -- a
+        # checkpointed run's wall numbers measure a different loop than
+        # an uncheckpointed one's.  Unstamped legacy files pass, the
+        # megakernel rule.
+        print(f"benchdiff: refusing to compare runs with different "
+              f"checkpoint cadences (old checkpoint_every={ck_old!r}, "
+              f"new checkpoint_every={ck_new!r}); re-record with "
+              f"matching --checkpoint-every settings", file=sys.stderr)
         return 2
     if args.kernels:
         wo, wn = _kernel_world(old), _kernel_world(new)

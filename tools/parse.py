@@ -9,7 +9,15 @@ also get `flows`/`links` sections from flows.jsonl/links.jsonl
 (trace.ScopeDrain format): top flows by bytes, the retransmit
 leaderboard, and the busiest links.
 
+`replaydiff` compares two windows.jsonl flight-recorder records (an
+original run vs a replay, or two runs expected identical) and reports
+the FIRST diverging window with a field-by-field delta, including the
+exchange-matrix cells that differ -- the triage tool the
+trace.ReplayDivergence error points at (docs/observability.md
+"Time-travel replay").
+
 Usage: tools/parse.py <data-directory> [--json out.json] [--top N]
+       tools/parse.py replaydiff <a/windows.jsonl> <b/windows.jsonl>
 """
 
 from __future__ import annotations
@@ -138,7 +146,100 @@ def parse_links(data_dir: str, top: int = 10) -> dict | None:
     }
 
 
+def _load_windows(path: str) -> dict:
+    """windows.jsonl rows keyed by global window index.  Accepts a data
+    directory or the jsonl path itself."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "windows.jsonl")
+    rows = _load_jsonl(path)
+    if rows is None:
+        raise FileNotFoundError(f"{path}: no flight-recorder record")
+    return {r["window"]: r for r in rows}
+
+
+def _matrix_delta(a, b) -> list:
+    """Differing [src][dst] cells of two exchange matrices as
+    {src, dst, a, b} entries (handles shard-count mismatches too)."""
+    out = []
+    for i in range(max(len(a), len(b))):
+        ra = a[i] if i < len(a) else []
+        rb = b[i] if i < len(b) else []
+        for j in range(max(len(ra), len(rb))):
+            va = ra[j] if j < len(ra) else None
+            vb = rb[j] if j < len(rb) else None
+            if va != vb:
+                out.append({"src": i, "dst": j, "a": va, "b": vb})
+    return out
+
+
+def replaydiff(path_a: str, path_b: str) -> dict:
+    """Compare two windows.jsonl records window-by-window.
+
+    Returns a digest: windows compared, whether the records match over
+    their overlap, and -- on divergence -- the FIRST diverging window
+    with per-field a/b values and the exchange-matrix cell deltas.
+    Windows present in only one record (a replay covers a suffix; ring
+    wrap drops old rows) are reported as counts, not divergence."""
+    a, b = _load_windows(path_a), _load_windows(path_b)
+    common = sorted(set(a) & set(b))
+    digest = {
+        "a": {"windows": len(a),
+              "span": [min(a), max(a)] if a else None},
+        "b": {"windows": len(b),
+              "span": [min(b), max(b)] if b else None},
+        "compared": len(common),
+        "only_in_a": len(set(a) - set(b)),
+        "only_in_b": len(set(b) - set(a)),
+        "identical": True,
+        "first_divergence": None,
+        "diverged_windows": 0,
+    }
+    first = None
+    n_div = 0
+    for w in common:
+        if a[w] == b[w]:
+            continue
+        n_div += 1
+        if first is not None:
+            continue
+        ra, rb = a[w], b[w]
+        fields = {}
+        for k in sorted(set(ra) | set(rb)):
+            va, vb = ra.get(k), rb.get(k)
+            if va == vb or k in ("ex_cnt", "ex_bytes"):
+                continue
+            fields[k] = {"a": va, "b": vb}
+        ex = {}
+        for k in ("ex_cnt", "ex_bytes"):
+            d = _matrix_delta(ra.get(k) or [], rb.get(k) or [])
+            if d:
+                ex[k] = d
+        first = {"window": w,
+                 "t_start": ra.get("t_start"), "t_end": ra.get("t_end"),
+                 "fields": fields, "exchange_delta": ex}
+    digest["identical"] = n_div == 0
+    digest["diverged_windows"] = n_div
+    digest["first_divergence"] = first
+    return digest
+
+
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "replaydiff":
+        ap = argparse.ArgumentParser(prog="parse.py replaydiff")
+        ap.add_argument("a", help="windows.jsonl (or its data dir)")
+        ap.add_argument("b", help="windows.jsonl (or its data dir)")
+        ap.add_argument("--json", default=None,
+                        help="also write to this file")
+        args = ap.parse_args(argv[1:])
+        digest = replaydiff(args.a, args.b)
+        text = json.dumps(digest, indent=2, sort_keys=True)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(text + "\n")
+        print(text)
+        # Like the replay verifier: divergence is a non-zero exit.
+        return 0 if digest["identical"] else 1
     ap = argparse.ArgumentParser()
     ap.add_argument("data_dir")
     ap.add_argument("--json", default=None, help="also write to this file")
