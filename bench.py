@@ -227,6 +227,12 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
             # host-side npz wall time, so benchdiff refuses a cadence
             # mismatch; bench.py never checkpoints.
             "checkpoint_every": None,
+            # Sentinel/supervise stamps: the sentinel block adds in-loop
+            # invariant counters to the traced graph, and supervision
+            # adds host-side checks per launch, so benchdiff refuses a
+            # both-stamped mismatch on either.  bench.py runs bare.
+            "sentinel": False,
+            "supervise": False,
         },
         # Wall-clock numbers are only comparable between runs on the
         # same backend and core count; benchdiff downgrades machine-
@@ -398,6 +404,8 @@ def main_multichip(n_devices: int, gate_against: str | None = None) -> int:
             "flight": top.get("flight"),
             "scope": None,
             "checkpoint_every": None,
+            "sentinel": False,
+            "supervise": False,
         },
         "env": {
             "backend": top["backend"],

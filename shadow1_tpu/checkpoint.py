@@ -68,7 +68,15 @@ def save(path: str, state, params, manifest: dict | None = None) -> None:
     device_get below gathers every shard's rows into the full host-side
     array (parallel/sharding.py unshard), so the file layout is
     identical to a single-device save of the same world.
+
+    The write is ATOMIC: bytes land in `path + ".tmp"` and only an
+    os.replace publishes them under `path`, so a crash mid-save leaves
+    either the previous complete file or a stray .tmp -- never a torn
+    checkpoint under the real name.  A crash during save must never
+    destroy the recovery anchor the supervisor resumes from
+    (docs/robustness.md).
     """
+    import os
     from .parallel.sharding import unshard
     m = world_manifest(state, params, **(manifest or {}))
     state, params = unshard((state, params))
@@ -79,8 +87,12 @@ def save(path: str, state, params, manifest: dict | None = None) -> None:
     out["_s_struct"] = np.array(_fingerprint(state))
     out["_p_struct"] = np.array(_fingerprint(params))
     out["_manifest"] = np.array(json.dumps(m, sort_keys=True))
-    with open(path, "wb") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
         np.savez(f, **out)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def read_manifest(path: str) -> dict | None:

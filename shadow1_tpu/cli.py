@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 
 from .core import engine, simtime
+from .supervise import (RC_FAILED, RC_INVARIANT, RC_OK, RC_USAGE,
+                        UnrecoveredFailure)
 
 SEC = simtime.SIMTIME_ONE_SECOND
 MS = simtime.SIMTIME_ONE_MILLISECOND
@@ -187,6 +189,27 @@ def _parser():
                         "window to windows.jsonl, and stamp the replay "
                         "recipe into ckpt/run.json for `shadow1-tpu "
                         "replay`.  Requires --data-directory")
+    r.add_argument("--auto-resume", action="store_true",
+                   help="self-healing run (docs/robustness.md): install "
+                        "the device-side invariant sentinel, supervise "
+                        "every launch, classify failures (sentinel "
+                        "violation, NaN, OOM, hung device, interrupt), "
+                        "and walk a checkpoint-anchored degradation "
+                        "ladder (retry -> megakernel off -> halve chunk "
+                        "-> single device) before surrendering with a "
+                        "structured DATA_DIR/crash.json.  If DATA_DIR "
+                        "already holds checkpoints from an earlier "
+                        "(killed) run of the SAME config, the run "
+                        "resumes from the newest readable one instead "
+                        "of starting over -- bitwise identical to the "
+                        "uninterrupted run.  Requires --checkpoint-every")
+    r.add_argument("--watchdog", type=float, metavar="SECONDS",
+                   default=None,
+                   help="with --auto-resume: wall-clock deadline per "
+                        "device launch; a launch that exceeds it is "
+                        "classified 'hung' and the run surrenders with "
+                        "crash.json (in-process recovery is unsafe while "
+                        "a launch thread may hold the device)")
 
     rp = sub.add_parser(
         "replay",
@@ -486,7 +509,7 @@ def run_config(args) -> int:
         if not args.data_directory:
             print("error: --profile requires --data-directory",
                   file=sys.stderr)
-            return 2
+            return RC_USAGE
         profiler = trace.install(trace.Profiler(sync=True))
 
     scope_kw = None
@@ -494,7 +517,7 @@ def run_config(args) -> int:
         if not args.data_directory:
             print("error: --scope requires --data-directory",
                   file=sys.stderr)
-            return 2
+            return RC_USAGE
         try:
             scope_kw = trace.parse_scope_spec(args.scope)
         except ValueError as e:
@@ -506,12 +529,21 @@ def run_config(args) -> int:
         if args.checkpoint_every <= 0:
             print("error: --checkpoint-every must be positive",
                   file=sys.stderr)
-            return 2
+            return RC_USAGE
         if not args.data_directory:
             print("error: --checkpoint-every requires --data-directory",
                   file=sys.stderr)
-            return 2
+            return RC_USAGE
         ck_every_ns = int(args.checkpoint_every * SEC)
+
+    supervise_on = bool(getattr(args, "auto_resume", False))
+    if supervise_on and not ck_every_ns:
+        print("error: --auto-resume requires --checkpoint-every "
+              "(recovery is checkpoint-anchored)", file=sys.stderr)
+        return RC_USAGE
+    if getattr(args, "watchdog", None) and not supervise_on:
+        print("error: --watchdog requires --auto-resume", file=sys.stderr)
+        return RC_USAGE
 
     t_wall = time.perf_counter()
     try:
@@ -527,9 +559,53 @@ def run_config(args) -> int:
         print("error: --checkpoint-every is incompatible with "
               "real-process plugins: external process state cannot be "
               "snapshotted or replayed", file=sys.stderr)
-        return 2
+        return RC_USAGE
     if substrate is not None:
         from .substrate import bridge as _bridge
+
+    resumed_from = None
+    if supervise_on:
+        # The invariant sentinel rides every supervised run, so every
+        # checkpoint carries it and resume templates always match.
+        state = trace.ensure_sentinel(state)
+        import glob as _glob
+
+        from . import checkpoint as ckpt_mod
+        from . import replay as replay_mod
+        from . import supervise as sup_mod
+        if _glob.glob(os.path.join(args.data_directory, "ckpt",
+                                   "win_*.npz")):
+            try:
+                path, man = replay_mod.find_checkpoint(
+                    args.data_directory, None)
+            except FileNotFoundError as e:
+                import warnings
+                warnings.warn(
+                    f"--auto-resume: existing checkpoints are all "
+                    f"unreadable; starting the run over ({e})",
+                    RuntimeWarning, stacklevel=1)
+                path = None
+            if path is not None:
+                try:
+                    state, params = ckpt_mod.load(path, state, params)
+                except ValueError as e:
+                    print(f"error: --auto-resume: the newest checkpoint "
+                          f"in {args.data_directory} was saved by a "
+                          f"different config: {e}", file=sys.stderr)
+                    return RC_USAGE
+                resumed_from = {
+                    "file": os.path.basename(path),
+                    "window": int(man["window"]),
+                    "t_ns": int(man["t_ns"])}
+                dropped = sup_mod.trim_windows(
+                    os.path.join(args.data_directory, "windows.jsonl"),
+                    resumed_from["window"])
+                if not args.quiet:
+                    print(f"[shadow1-tpu] auto-resume: restored window "
+                          f"{resumed_from['window']} "
+                          f"(t={resumed_from['t_ns'] / SEC:g}s) from "
+                          f"{resumed_from['file']}; trimmed {dropped} "
+                          f"superseded row(s)", file=sys.stderr)
 
     tracker = None
     if args.data_directory and args.heartbeat_frequency > 0:
@@ -546,8 +622,13 @@ def run_config(args) -> int:
 
     flight = None
     if state.fr is not None and args.data_directory:
+        # A resumed run appends after the trim above, starting at the
+        # restored window so the ring's pre-resume rows (already in the
+        # file) are not re-emitted.
         flight = trace.FlightDrain(
-            os.path.join(args.data_directory, "windows.jsonl"))
+            os.path.join(args.data_directory, "windows.jsonl"),
+            start=resumed_from["window"] if resumed_from else 0,
+            mode="a" if resumed_from else "w")
 
     scope = None
     if scope_kw is not None and state.scope is not None:
@@ -564,15 +645,17 @@ def run_config(args) -> int:
         ck = replay_mod.Checkpointer(
             args.data_directory, ck_every_ns, devices=n_dev,
             bucket=args.bucket, hosts_real=len(asm.hostnames))
-        replay_mod.write_run_json(args.data_directory, {
-            "world": {"kind": "config", "args": world_args(args)},
-            "hb_ns": tracker.sample_interval_ns if tracker else None,
-            "every_ns": ck_every_ns, "stop_ns": int(stop),
-            "chunk_ns": engine.CHUNK_NS, "devices": n_dev,
-            "bucket": bool(args.bucket),
-            "hosts_real": len(asm.hostnames),
-            "scope": args.scope, "profile": bool(args.profile)})
-        ck.save(state, params)   # win_0: a replay anchor always exists
+        if resumed_from is None:
+            replay_mod.write_run_json(args.data_directory, {
+                "world": {"kind": "config", "args": world_args(args)},
+                "hb_ns": tracker.sample_interval_ns if tracker else None,
+                "every_ns": ck_every_ns, "stop_ns": int(stop),
+                "chunk_ns": engine.CHUNK_NS, "devices": n_dev,
+                "bucket": bool(args.bucket),
+                "hosts_real": len(asm.hostnames),
+                "scope": args.scope, "profile": bool(args.profile),
+                "sentinel": supervise_on, "supervise": supervise_on})
+            ck.save(state, params)  # win_0: a replay anchor always exists
         if not args.quiet:
             print(f"[shadow1-tpu] checkpoints: every "
                   f"{args.checkpoint_every}s -> {ck.dir}",
@@ -586,41 +669,69 @@ def run_config(args) -> int:
     from .replay import next_sync
     if mesh is not None:
         from . import parallel as parallel_mod
+    sup = None
+    if supervise_on:
+        from . import supervise as sup_mod
+        sup_mod.install_sigterm()
+        sup = sup_mod.Supervisor(
+            args.data_directory, app, mesh=mesh,
+            chunk_ns=engine.CHUNK_NS,
+            watchdog_s=getattr(args, "watchdog", None),
+            quiet=args.quiet,
+            resume_cmd=(f"shadow1-tpu run {args.config} --auto-resume "
+                        f"--checkpoint-every {args.checkpoint_every:g} "
+                        f"--data-directory {args.data_directory}"),
+            on_violation=(lambda st: flight.drain(st, profiler))
+            if flight is not None else None)
     hb_ns = tracker.sample_interval_ns if tracker else None
     t = int(state.now)
     hb_next = 0
-    while t < stop:
-        # Advance to the next launch boundary on the memoryless union
-        # grid of heartbeat and checkpoint multiples (replay.next_sync):
-        # the tracker samples between bounded device launches, the
-        # checkpointer saves on cadence multiples, and a replay can
-        # re-derive the identical boundary sequence from any mid-run
-        # checkpoint (window ends clip at launch targets, so the
-        # flight-recorder record depends on this schedule).
-        t_next = next_sync(t, int(stop), hb_ns, ck_every_ns)
-        if substrate is not None:
-            state = _bridge.run(substrate, state, params, app, t_next)
-        elif mesh is not None:
-            state = parallel_mod.mesh_run_chunked(state, params, app,
-                                                  t_next, mesh=mesh)
-        else:
-            state = engine.run_chunked(state, params, app, t_next)
-        t = t_next
-        if tracker is not None and t >= hb_next:
-            tracker.heartbeat(state, t)
-            hb_next = t + tracker.sample_interval_ns
-        if drain is not None:
-            drain.drain(state)
-        if profiler is not None:
-            trace.fetch_counters(state, profiler)
-        if flight is not None:
-            flight.drain(state, profiler)
-        if scope is not None:
-            scope.drain(state, profiler)
-        if ck is not None:
-            ck.maybe(state, params, t)
-        if progress is not None:
-            progress.update(state, t)
+    try:
+        while t < stop:
+            # Advance to the next launch boundary on the memoryless
+            # union grid of heartbeat and checkpoint multiples
+            # (replay.next_sync): the tracker samples between bounded
+            # device launches, the checkpointer saves on cadence
+            # multiples, and a replay can re-derive the identical
+            # boundary sequence from any mid-run checkpoint (window
+            # ends clip at launch targets, so the flight-recorder
+            # record depends on this schedule).
+            t_next = next_sync(t, int(stop), hb_ns, ck_every_ns)
+            if substrate is not None:
+                state = _bridge.run(substrate, state, params, app, t_next)
+            elif sup is not None:
+                state = sup.launch(state, params, t_next)
+            elif mesh is not None:
+                state = parallel_mod.mesh_run_chunked(state, params, app,
+                                                      t_next, mesh=mesh)
+            else:
+                state = engine.run_chunked(state, params, app, t_next)
+            t = t_next
+            if tracker is not None and t >= hb_next:
+                tracker.heartbeat(state, t)
+                hb_next = t + tracker.sample_interval_ns
+            if drain is not None:
+                drain.drain(state)
+            if profiler is not None:
+                trace.fetch_counters(state, profiler)
+            if flight is not None:
+                flight.drain(state, profiler)
+            if scope is not None:
+                scope.drain(state, profiler)
+            if ck is not None:
+                ck.maybe(state, params, t)
+            if progress is not None:
+                progress.update(state, t)
+    except UnrecoveredFailure as e:
+        for closer in (flight, drain):
+            if closer is not None:
+                try:
+                    closer.close()
+                except Exception:
+                    pass
+        print(f"error: {e}", file=sys.stderr)
+        print(json.dumps({"crash": e.crash}))
+        return e.rc
     if progress is not None:
         progress.update(state, t, force=True)
     jax.block_until_ready(state)
@@ -645,6 +756,13 @@ def run_config(args) -> int:
         "acks_thinned": int(jnp.sum(state.hosts.acks_thinned)),
         "err_flags": int(state.err),
     }
+    if sup is not None:
+        summary["supervise"] = {
+            "recoveries": sup.recoveries,
+            "ladder": sup.ladder,
+            "sentinel": sup.sentinel.row,
+            "resumed_from": resumed_from,
+        }
     if state.nm is not None:
         summary["netem"] = {
             "events_applied": int(state.nm.cursor),
@@ -725,14 +843,18 @@ def run_config(args) -> int:
         trace.install(None)
     print(json.dumps(summary))
     if substrate is not None and summary["processes_failed"]:
-        return 3
-    return 0 if int(state.err) == 0 else 2
+        return RC_FAILED
+    # A set err bitmask means the simulation violated its own capacity
+    # invariants (pool/socket/udp overflow) -- the same "simulation is
+    # wrong" class as a sentinel violation or replay divergence.
+    return RC_OK if int(state.err) == 0 else RC_INVARIANT
 
 
 def replay_cmd(args) -> int:
-    """`shadow1-tpu replay`: restore, re-run, verify.  Exit codes:
-    0 verified OK, 1 replay DIVERGED (first differing window printed),
-    2 usage/environment errors."""
+    """`shadow1-tpu replay`: restore, re-run, verify.  Exit codes
+    (supervise.py's unified table): 0 verified OK, 1 the simulation is
+    wrong (replay DIVERGED at the printed window, or the replayed span
+    reproduced a sentinel violation), 2 usage/environment errors."""
     from . import replay as replay_mod
     from .trace import ReplayDivergence
     try:
@@ -748,15 +870,23 @@ def replay_cmd(args) -> int:
         print(json.dumps({"replay_diverged": {
             "window": e.window, "fields": e.fields,
             "got": e.got, "want": e.want}}))
-        return 1
+        return RC_INVARIANT
     except CliError as e:
         print(f"error: {e}", file=sys.stderr)
         return e.rc
     except (FileNotFoundError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
-        return 2
+        return RC_USAGE
     print(json.dumps(summary))
-    return 0
+    sn = summary.get("sentinel")
+    if sn and sn.get("violations"):
+        # The replayed span re-tripped the device invariant probes: the
+        # deterministic reproduction of a supervised run's crash.json.
+        print(f"replay reproduced a sentinel violation "
+              f"({'+'.join(sn['classes'])}) at window "
+              f"{sn['first_bad_window']}", file=sys.stderr)
+        return RC_INVARIANT
+    return RC_OK
 
 
 def warm_cmd(args) -> int:
@@ -771,7 +901,7 @@ def warm_cmd(args) -> int:
     records = shapes.warm_buckets(buckets=args.buckets, apps=args.apps,
                                   log=log)
     print(json.dumps({"warmed": records}))
-    return 0
+    return RC_OK
 
 
 def main(argv=None) -> int:
@@ -782,7 +912,7 @@ def main(argv=None) -> int:
         return replay_cmd(args)
     if args.cmd == "warm":
         return warm_cmd(args)
-    return 1
+    return RC_USAGE
 
 
 if __name__ == "__main__":

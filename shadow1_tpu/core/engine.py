@@ -75,6 +75,8 @@ from .state import (ERR_POOL_OVERFLOW, I32, I64, U32, PROTO_TCP, PROTO_UDP,
                     LOG_WARNING, LOG_DEBUG, LOG_DROP_INET, LOG_DROP_ROUTER,
                     LOG_DROP_TAIL, LOG_DROP_POOL, LOG_DELIVER, LOG_SEND,
                     LOG_NETEM_DOWN,
+                    SENTINEL_CONSERVATION, SENTINEL_TIME, SENTINEL_BOUNDS,
+                    SENTINEL_NONFINITE, SENTINEL_TIMER_MAX_NS,
                     enc_lo, enc_hi, dec_i64, SimState, host_ids)
 # Fault/dynamics overlay operators (netem/apply.py).  Every call site
 # guards on `state.nm is None` (a trace-time pytree check), so worlds
@@ -786,6 +788,129 @@ def _fr_record(state: SimState, snap, ws, we) -> SimState:
         ex_cnt_sum=fr.ex_cnt_sum + fr.cur_ex_cnt.astype(I64),
         ex_bytes_sum=fr.ex_bytes_sum + fr.cur_ex_bytes,
         total=fr.total + 1))
+
+
+# ---------------------------------------------------------------------------
+# Invariant sentinel: per-window health checks (state.SentinelBlock)
+# ---------------------------------------------------------------------------
+
+
+def _sentinel_counters(state: SimState):
+    """Shard-local conservation ledger at a window boundary: lifetime
+    emission/delivery/drop sums plus the live slot census.  Taken at
+    window OPEN (before the exchange, which thins acks and drops data)
+    and again at close; the per-window deltas satisfy
+
+        d_sent - d_recv - d_router - d_thinned - d_occupied
+            in [0, d_inet + d_pool + d_killed]
+
+    exactly: every packet placed in the pool (pkts_sent) leaves the
+    system through delivery, a router drop, ack thinning, a
+    delivery-side inet/pool drop or netem kill, or still occupies a
+    slot -- and the stage-side halves of the inet/pool counters are
+    non-negative.  Seeded worlds and mid-run installs are immune
+    because only deltas are checked."""
+    h = state.hosts
+    occ = (jnp.sum((state.pool.stage != STAGE_FREE).astype(I64))
+           + jnp.sum((state.inbox.stage != STAGE_FREE).astype(I64)))
+    return (jnp.sum(h.pkts_sent.astype(I64)),
+            jnp.sum(h.pkts_recv.astype(I64)),
+            jnp.sum(h.pkts_dropped_router.astype(I64)),
+            jnp.sum(h.acks_thinned.astype(I64)),
+            jnp.sum(h.pkts_dropped_inet.astype(I64)),
+            jnp.sum(h.pkts_dropped_pool.astype(I64)),
+            jnp.asarray(0, I64) if state.nm is None
+            else state.nm.killed.astype(I64),
+            occ)
+
+
+def _sentinel_check(state: SimState, snap, ws, we) -> SimState:
+    """Run every invariant probe for the window that just closed and
+    fold the result into the sentinel block.  Under a mesh the deltas
+    psum and the ok-flags pmin/pmax to globals first (the _fr_record
+    rule), so the replicated block stays bitwise identical per shard.
+    Only the sentinel block is written: installing it never perturbs
+    the trajectory."""
+    sn = state.sentinel
+    mesh = _on_mesh(state)
+
+    # -- packet conservation (window delta vs the open snapshot) --------
+    d = [b - a for a, b in zip(snap, _sentinel_counters(state))]
+    if mesh:
+        d = [jax.lax.psum(x, MESH_AXIS) for x in d]
+    d_sent, d_recv, d_rtr, d_ack, d_inet, d_pool, d_kill, d_occ = d
+    resid_low = d_sent - d_recv - d_rtr - d_ack - d_occ
+    resid_high = d_inet + d_pool + d_kill - resid_low
+    # Overflow windows (err bit set) legitimately leak the identity --
+    # the ERR_* flag is already the loud signal for those.
+    err_any = state.err
+    if mesh:
+        err_any = jax.lax.pmax(err_any, MESH_AXIS)
+    v_cons = ((resid_low < 0) | (resid_high < 0)) & (err_any == 0)
+
+    # -- window-time monotonicity ---------------------------------------
+    # we/ws are uniform across shards (pmin'd predicates), so this needs
+    # no reduction.
+    v_time = (we <= sn.last_we) | (we < ws)
+
+    # -- stage domain / queue accounting / ring cursor bounds -----------
+    ok = (jnp.all((state.pool.stage >= STAGE_FREE)
+                  & (state.pool.stage <= STAGE_IN_FLIGHT))
+          & jnp.all((state.inbox.stage >= STAGE_FREE)
+                    & (state.inbox.stage <= STAGE_RX_QUEUED)
+                    & (state.inbox.stage != STAGE_TX_QUEUED))
+          & jnp.all(state.hosts.tx_queued >= 0)
+          & jnp.all(state.hosts.rx_queued >= 0)
+          & (jnp.sum(state.hosts.tx_queued.astype(I64))
+             == jnp.sum((state.pool.stage == STAGE_TX_QUEUED).astype(I64)))
+          & (jnp.sum(state.hosts.rx_queued.astype(I64))
+             == jnp.sum((state.inbox.stage == STAGE_RX_QUEUED)
+                        .astype(I64))))
+    if state.fr is not None:
+        ok = ok & (state.fr.total >= 0)
+    if state.cap is not None:
+        ok = ok & jnp.all(state.cap.total >= 0)
+    if state.log is not None:
+        ok = ok & jnp.all(state.log.total >= 0)
+    if state.scope is not None:
+        ok = ok & jnp.all(state.scope.f_total >= 0) \
+            & jnp.all(state.scope.l_total >= 0)
+    if mesh:
+        ok = jax.lax.pmin(ok.astype(I32), MESH_AXIS) > 0
+    v_bounds = ~ok
+
+    # -- finiteness probe over the float islands + timer plausibility --
+    # The float-dtype filter is a trace-time static, so int-only worlds
+    # pay nothing here beyond the three timer-leaf range checks.
+    bad = jnp.asarray(0, I64)
+    for leaf in jax.tree_util.tree_leaves(state):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+            bad = bad + jnp.sum(~jnp.isfinite(leaf), dtype=I64)
+    # srtt/rttvar/rto live in i64 ns: a NaN bit pattern poisoning them
+    # lands as a huge positive integer, so a range ceiling catches it.
+    for t in (state.socks.srtt, state.socks.rttvar, state.socks.rto):
+        bad = bad + jnp.sum((t < 0) | (t > SENTINEL_TIMER_MAX_NS),
+                            dtype=I64)
+    if mesh:
+        bad = jax.lax.pmax(bad, MESH_AXIS)
+    v_fin = bad > 0
+
+    bits = (jnp.where(v_cons, SENTINEL_CONSERVATION, 0)
+            | jnp.where(v_time, SENTINEL_TIME, 0)
+            | jnp.where(v_bounds, SENTINEL_BOUNDS, 0)
+            | jnp.where(v_fin, SENTINEL_NONFINITE, 0)).astype(I32)
+    win = state.n_windows - 1  # the just-closed window's global index
+    fresh = (bits != 0) & (sn.first_bad_window < 0)
+    return state.replace(sentinel=sn.replace(
+        checks=sn.checks + 1,
+        violations=sn.violations | bits,
+        last_violation=bits,
+        first_bad_window=jnp.where(fresh, win, sn.first_bad_window),
+        first_bad_t=jnp.where(fresh, we, sn.first_bad_t),
+        last_we=jnp.asarray(we, I64),
+        resid_low=resid_low,
+        resid_high=resid_high,
+        nonfinite=bad))
 
 
 # ---------------------------------------------------------------------------
@@ -1879,6 +2004,10 @@ def run_until_impl(state: SimState, params, app, t_target):
         st, _, _, _ = carry
         if st.fr is not None:
             st, fr_snap = _fr_snapshot(st)
+        if st.sentinel is not None:
+            # Conservation ledger at window open, before the exchange
+            # (which thins acks and drops data mid-identity).
+            sn_snap = _sentinel_counters(st)
         # Boundary exchange first: everything in flight becomes visible
         # in the destination slabs before the window's scan.
         st = _exchange(st, params, fused=fused and not mesh)
@@ -1924,6 +2053,8 @@ def run_until_impl(state: SimState, params, app, t_target):
             # Sample at window close: the cadence check and cursors are
             # replicated, so every shard takes the same branch.
             st = _scope_sample(st, ctx, we)
+        if st.sentinel is not None:
+            st = _sentinel_check(st, sn_snap, ws, we)
         return st, t_h, gmin, outbox_pending(st)
 
     t_h0, gmin0 = scan(state)

@@ -978,6 +978,79 @@ def make_flowscope(flow_capacity: int = 1 << 16,
 
 
 # ---------------------------------------------------------------------------
+# Invariant sentinel (per-window health checks; trace.SentinelDrain)
+# ---------------------------------------------------------------------------
+
+
+# Violation classes (SentinelBlock.violations bitmask).
+SENTINEL_CONSERVATION = 1 << 0  # packet conservation identity broken
+SENTINEL_TIME = 1 << 1          # window end not strictly monotone
+SENTINEL_BOUNDS = 1 << 2        # stage domain / queue count / cursor bounds
+SENTINEL_NONFINITE = 1 << 3     # non-finite float leaf or implausible timer
+
+SENTINEL_CLASS_NAMES = {
+    SENTINEL_CONSERVATION: "conservation",
+    SENTINEL_TIME: "time",
+    SENTINEL_BOUNDS: "bounds",
+    SENTINEL_NONFINITE: "nonfinite",
+}
+
+# Plausibility ceiling for the TCP timer leaves (srtt/rttvar/rto live in
+# i64 ns, so a NaN bit pattern lands as a huge positive integer rather
+# than a float NaN; any sane RTT estimate sits far below ten minutes).
+SENTINEL_TIMER_MAX_NS = 600 * 1_000_000_000
+
+
+@struct.dataclass
+class SentinelBlock:
+    """Per-window invariant monitor -- the run's smoke detector.
+    Present in SimState only when installed (trace.ensure_sentinel), so
+    sentinel-less runs trace byte-identical graphs: the same
+    present-or-None contract as cap/log/tr/fr/scope/nm.
+
+    engine._sentinel_check runs at every window close on cheap
+    reductions of state the window already touched: the packet
+    conservation identity (emitted = delivered + dropped + thinned +
+    still-occupied, bounded by the stage-vs-delivery drop split),
+    window-end monotonicity, stage-domain / queue-count / ring-cursor
+    bounds, and a finiteness probe over the float leaves plus a
+    plausibility ceiling on the i64 TCP timers.  All fields are scalars
+    computed from psum/pmin/pmax-reduced inputs, so the block is
+    REPLICATED under a mesh (the flight-recorder rule) and bitwise
+    identical on every shard.
+
+    The block only ever observes: installing it never perturbs the
+    trajectory (bitwise-neutral, tests/test_sentinel.py).  Violations
+    are sticky; `first_bad_window`/`first_bad_t` freeze the earliest
+    failure so a drain long after the fact still points replay at the
+    right window."""
+
+    checks: jnp.ndarray            # i64 lifetime windows checked
+    violations: jnp.ndarray        # i32 sticky SENTINEL_* bitmask
+    last_violation: jnp.ndarray    # i32 most recent window's bits
+    first_bad_window: jnp.ndarray  # i64 window index of first violation, -1
+    first_bad_t: jnp.ndarray      # i64 window end (sim ns) at first violation
+    last_we: jnp.ndarray          # i64 previous window end (monotonicity)
+    resid_low: jnp.ndarray        # i64 conservation lower slack (>= 0 ok)
+    resid_high: jnp.ndarray       # i64 conservation upper slack (>= 0 ok)
+    nonfinite: jnp.ndarray        # i64 bad float/timer elements last check
+
+
+def make_sentinel() -> SentinelBlock:
+    return SentinelBlock(
+        checks=jnp.asarray(0, I64),
+        violations=jnp.asarray(0, I32),
+        last_violation=jnp.asarray(0, I32),
+        first_bad_window=jnp.asarray(-1, I64),
+        first_bad_t=jnp.asarray(-1, I64),
+        last_we=jnp.asarray(-1, I64),
+        resid_low=jnp.asarray(0, I64),
+        resid_high=jnp.asarray(0, I64),
+        nonfinite=jnp.asarray(0, I64),
+    )
+
+
+# ---------------------------------------------------------------------------
 # Trace counter block (runtime profiling; trace.py)
 # ---------------------------------------------------------------------------
 
@@ -1049,6 +1122,11 @@ class SimState:
     # when a fault schedule is installed, so static worlds compile the
     # whole overlay away.
     nm: any = struct.field(pytree_node=True, default=None)  # NetemBlock | None
+    # Per-window invariant monitor (trace.ensure_sentinel): present only
+    # when installed, so unsupervised runs trace byte-identical graphs.
+    # Replicated (never sharded) under a mesh -- every shard computes
+    # identical scalars from psum/pmin/pmax-reduced inputs.
+    sentinel: any = struct.field(pytree_node=True, default=None)  # SentinelBlock | None
     # Telemetry (reference scheduler built-in timers, scheduler.c:266-268):
     n_steps: jnp.ndarray = struct.field(default=None)    # i64 micro-steps
     n_windows: jnp.ndarray = struct.field(default=None)  # i64 windows run

@@ -67,10 +67,11 @@ def _state_specs(state):
 
     def spec(path, leaf):
         name = getattr(path[0], "name", "")
-        if name in ("nm", "fr"):
+        if name in ("nm", "fr", "sentinel"):
             # Replicated blocks: netem gathers by global ids; the flight
-            # recorder computes identical rows on every shard from
-            # psum/all_gather-reduced inputs (engine._fr_record).
+            # recorder and the invariant sentinel compute identical
+            # values on every shard from psum/pmin/pmax-reduced inputs
+            # (engine._fr_record / engine._sentinel_check).
             return P()
         if name in ("log", "cap", "scope"):
             # Sharded observability rings (make_log_ring/make_capture_ring

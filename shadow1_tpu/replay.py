@@ -109,6 +109,22 @@ class Checkpointer:
         self.hosts_real = hosts_real
         self.saved = []
         self._next = 0          # save at t=0 (win_0), then every multiple
+        # A resumed run continues an existing index: keep the prior
+        # entries so the ladder can still reach back past the resume
+        # point (save() prunes forward entries it overwrites).
+        idx = os.path.join(self.dir, "index.json")
+        if os.path.exists(idx):
+            try:
+                with open(idx) as f:
+                    self.saved = list(json.load(f)["checkpoints"])
+            except (json.JSONDecodeError, KeyError, OSError) as e:
+                import warnings
+                warnings.warn(
+                    f"{idx}: unreadable checkpoint index ({e}); "
+                    f"starting a fresh one (existing win_*.npz files "
+                    f"are still discoverable by filename)",
+                    RuntimeWarning, stacklevel=2)
+                self.saved = []
 
     def _extra(self, state, params) -> dict:
         h = int(state.hosts.num_hosts)
@@ -125,11 +141,17 @@ class Checkpointer:
         path = os.path.join(self.dir, f"win_{w}.npz")
         checkpoint.save(path, state, params,
                         manifest=self._extra(state, params))
+        # Resumed runs re-save windows they re-cover bitwise; drop the
+        # superseded entries rather than duplicating them.
+        self.saved = [e for e in self.saved if int(e["window"]) < w]
         self.saved.append({"window": w, "t_ns": t,
                            "file": os.path.basename(path)})
         self._next = (t // self.every_ns + 1) * self.every_ns
-        with open(os.path.join(self.dir, "index.json"), "w") as f:
+        # Atomic like the npz itself: the index must never be torn.
+        idx = os.path.join(self.dir, "index.json")
+        with open(idx + ".tmp", "w") as f:
             json.dump({"checkpoints": self.saved}, f, indent=1)
+        os.replace(idx + ".tmp", idx)
         return path
 
     def maybe(self, state, params, t) -> bool:
@@ -187,28 +209,49 @@ def load_windows(path_or_dir: str) -> list:
 
 
 def find_checkpoint(data_dir: str, window: int | None):
-    """(path, manifest) of the nearest checkpoint at-or-before the
-    global window index `window` (None: the newest checkpoint)."""
-    best = None
+    """(path, manifest) of the nearest READABLE checkpoint at-or-before
+    the global window index `window` (None: the newest checkpoint).
+
+    Torn or partial files -- a save the process died inside, a truncated
+    copy -- are skipped with a loud warning and the next-older candidate
+    is tried, so one bad file never strands a recoverable run.  Saves
+    are atomic (checkpoint.save writes .tmp + os.replace), so a torn
+    file under the real name means external damage, not a crashed
+    save."""
+    cands = []
     for p in glob.glob(os.path.join(data_dir, "ckpt", "win_*.npz")):
         name = os.path.basename(p)
         try:
             w = int(name[4:-4])
         except ValueError:
             continue
-        if (window is None or w <= window) and \
-                (best is None or w > best[0]):
-            best = (w, p)
-    if best is None:
+        if window is None or w <= window:
+            cands.append((w, p))
+    if not cands:
         raise FileNotFoundError(
             f"no checkpoint at or before window {window} under "
             f"{os.path.join(data_dir, 'ckpt')}")
-    man = checkpoint.read_manifest(best[1])
-    if man is None:
-        raise ValueError(
-            f"{best[1]} predates the manifest format and cannot anchor "
-            f"a replay (re-run with --checkpoint-every)")
-    return best[1], man
+    errors = []
+    for w, p in sorted(cands, reverse=True):
+        try:
+            man = checkpoint.read_manifest(p)
+        except Exception as e:  # torn zip, truncated file, bad JSON
+            import warnings
+            warnings.warn(
+                f"{p}: unreadable checkpoint ({type(e).__name__}: {e}); "
+                f"skipping it and trying the next-older one",
+                RuntimeWarning, stacklevel=2)
+            errors.append(f"{os.path.basename(p)}: {e}")
+            continue
+        if man is None:
+            raise ValueError(
+                f"{p} predates the manifest format and cannot anchor "
+                f"a replay (re-run with --checkpoint-every)")
+        return p, man
+    raise FileNotFoundError(
+        f"every checkpoint at or before window {window} under "
+        f"{os.path.join(data_dir, 'ckpt')} is unreadable: "
+        + "; ".join(errors))
 
 
 def rebuild_world(info: dict, data_dir: str, *, want_mesh: bool = True):
@@ -228,7 +271,11 @@ def rebuild_world(info: dict, data_dir: str, *, want_mesh: bool = True):
                                 **world["args"])
         w = cli.build_world(ns, quiet=True, want_mesh=want_mesh,
                             allow_substrate=False)
-        return {"state": w.state, "params": w.params, "app": w.app,
+        st = w.state
+        if info.get("sentinel") or info.get("supervise"):
+            from . import trace
+            st = trace.ensure_sentinel(st)
+        return {"state": st, "params": w.params, "app": w.app,
                 "n_dev": w.n_dev, "mesh": w.mesh, "asm": w.asm,
                 "hostnames": list(w.asm.hostnames)}
     if kind == "builder":
@@ -275,6 +322,8 @@ def _rebuild_builder(info: dict, want_mesh: bool = True):
     if info.get("profile"):
         state = trace.ensure_counters(state)
     state = trace.ensure_flight_recorder(state, shards=n)
+    if info.get("sentinel") or info.get("supervise"):
+        state = trace.ensure_sentinel(state)
     h_real = int(info.get("hosts_real") or int(state.hosts.num_hosts))
     return {"state": state, "params": params, "app": app, "n_dev": n,
             "mesh": mesh, "asm": None,
@@ -379,7 +428,14 @@ def replay(data_dir: str, *, window: int | None = None,
 
     built = rebuild_world(info, data_dir,
                           want_mesh=exec_dev > 1)
-    state, params = checkpoint.load(ckpt_path, built["state"],
+    tmpl_state = built["state"]
+    # Supervised runs carry the invariant sentinel; the checkpoint
+    # manifest records the block, so install it on the template even
+    # when run.json predates the stamp (a resumed legacy run).
+    if "sentinel" in (man or {}).get("shape", {}).get("blocks", {}) \
+            and tmpl_state.sentinel is None:
+        tmpl_state = trace_mod.ensure_sentinel(tmpl_state)
+    state, params = checkpoint.load(ckpt_path, tmpl_state,
                                     built["params"])
     app, mesh = built["app"], built["mesh"]
     if int(state.now) != t0:
@@ -514,6 +570,12 @@ def replay(data_dir: str, *, window: int | None = None,
         },
         "err_flags": int(state.err),
     }
+    if state.sentinel is not None:
+        # A supervised run's checkpoint carries the sentinel, so a
+        # replayed crash re-trips the same violation at the same window
+        # -- the row in the summary IS the deterministic reproduction
+        # of crash.json (the CLI maps a nonzero bitmask to rc 1).
+        summary["sentinel"] = trace_mod.SentinelDrain().drain(state)
     if pcap and state.cap is not None:
         from .observe import write_pcap
         asm = built.get("asm")

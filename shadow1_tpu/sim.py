@@ -280,7 +280,7 @@ def add_churn(state, params, rate_per_s: float,
 
 def run(state, params, app, until=None, profiler=None, devices=None,
         bucket=False, scope=None, checkpoint_every=None,
-        checkpoint_dir=None, checkpoint_world=None):
+        checkpoint_dir=None, checkpoint_world=None, supervise=None):
     """Run to `until` (default: params.stop_time).
 
     With `profiler` (a trace.Profiler), the run is profiled: the
@@ -326,6 +326,17 @@ def run(state, params, app, until=None, profiler=None, devices=None,
     those kwargs at replay time.  Without it the checkpoints still
     save/load programmatically, but the CLI cannot rebuild the
     template on its own.
+
+    With `supervise` (True, or a dict of supervise.Supervisor kwargs:
+    watchdog_s, quiet, resume_cmd) the run self-heals
+    (docs/robustness.md): the invariant sentinel rides the state, every
+    launch runs under supervise.Supervisor, and failures walk the
+    checkpoint-anchored degradation ladder; an unrecovered failure
+    raises supervise.UnrecoveredFailure after writing
+    `checkpoint_dir`/crash.json.  Requires `checkpoint_every` --
+    recovery is checkpoint-anchored.  The supervised trajectory is
+    bitwise identical to an unsupervised one (the sentinel and every
+    ladder rung are bitwise-neutral).
     """
     h_real = int(state.hosts.num_hosts)
     if bucket:
@@ -341,7 +352,12 @@ def run(state, params, app, until=None, profiler=None, devices=None,
             state, params, app, int(t), profiler=profiler,
             devices=devices, bucket=bucket, scope=scope,
             every_ns=int(checkpoint_every), ckdir=checkpoint_dir,
-            world=checkpoint_world, hosts_real=h_real)
+            world=checkpoint_world, hosts_real=h_real,
+            supervise=supervise)
+    if supervise:
+        raise ValueError(
+            "sim.run: supervise requires checkpoint_every and "
+            "checkpoint_dir (recovery is checkpoint-anchored)")
 
     def _install_scope(st, shards):
         if scope is None or st.scope is not None:
@@ -389,7 +405,8 @@ def run(state, params, app, until=None, profiler=None, devices=None,
 
 
 def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
-                      scope, every_ns, ckdir, world, hosts_real):
+                      scope, every_ns, ckdir, world, hosts_real,
+                      supervise=None):
     """run()'s checkpointing path: same block installs as the plain
     paths (mesh pad, then scope/counters -- replay._rebuild_builder
     mirrors this order exactly), plus a flight recorder, a windows.jsonl
@@ -419,6 +436,8 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
         trace.install(profiler)
         state = trace.ensure_counters(state)
     state = trace.ensure_flight_recorder(state, shards=n)
+    if supervise:
+        state = trace.ensure_sentinel(state)
 
     os.makedirs(ckdir, exist_ok=True)
     flight = trace.FlightDrain(os.path.join(ckdir, "windows.jsonl"))
@@ -433,13 +452,23 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
         "hb_ns": None, "every_ns": int(every_ns), "stop_ns": int(t),
         "chunk_ns": engine.CHUNK_NS, "devices": n,
         "bucket": bool(bucket), "hosts_real": int(hosts_real),
-        "scope": scope, "profile": profiler is not None})
+        "scope": scope, "profile": profiler is not None,
+        "sentinel": bool(supervise), "supervise": bool(supervise)})
+    sup = None
+    if supervise:
+        from . import supervise as sup_mod
+        opts = dict(supervise) if isinstance(supervise, dict) else {}
+        sup = sup_mod.Supervisor(
+            ckdir, app, mesh=mesh, chunk_ns=engine.CHUNK_NS,
+            on_violation=lambda st: flight.drain(st, profiler), **opts)
     try:
         ck.save(state, params)          # win_0: a replay anchor always exists
         tt = int(state.now)
         while tt < int(t):
             tt = replay_mod.next_sync(tt, int(t), every_ns=every_ns)
-            if mesh is not None:
+            if sup is not None:
+                state = sup.launch(state, params, tt)
+            elif mesh is not None:
                 from . import parallel
                 state = parallel.mesh_run_chunked(state, params, app, tt,
                                                   mesh=mesh)

@@ -1,0 +1,443 @@
+"""Self-healing runs: failure classification and checkpoint-anchored
+auto-recovery around the chunked window loop (docs/robustness.md).
+
+The device half of the story is the invariant sentinel
+(core/state.py SentinelBlock, core/engine.py _sentinel_check): a
+present-or-None block of replicated scalars that checks packet
+conservation, window-time monotonicity, stage/queue/cursor bounds, and
+finiteness of the state's float islands at every window close.  This
+module is the host half: `Supervisor` wraps the launch loop that
+sim.run / the CLI already drive, classifies anything that goes wrong --
+a sentinel violation, a NaN, an XLA RESOURCE_EXHAUSTED, a hung launch,
+a SIGTERM -- and walks a degradation ladder anchored on the newest
+readable checkpoint:
+
+    retry from checkpoint
+      -> megakernel off (params.megakernel is bitwise-neutral)
+      -> halve the chunk length (chunking is trajectory-invariant)
+      -> gather the mesh to one device (sharding is bitwise-neutral)
+      -> surrender: structured crash.json + UnrecoveredFailure
+
+Every rung re-executes from the last checkpoint, and every rung is a
+bitwise-neutral execution change (docs/parallel.md, docs/perf.md), so
+a run that recovers produces the SAME trajectory it would have without
+the failure -- recovery never forks the simulation.  Deterministic
+failure classes (a sentinel violation, a NaN) skip the plain-retry
+rung: they reproduce bitwise, so only an execution-strategy change
+could dodge a backend bug, and if none does the crash is real and the
+ladder surrenders with the evidence.
+
+crash.json is the surrender report: failure class and message, the
+window index and sim time, the sentinel row (if the sentinel fired),
+the nearest checkpoint, the ladder rungs taken, and the exact replay
+command that reproduces the death deterministically
+(`shadow1-tpu replay --window <first_bad_window>`).
+
+The unified exit-code table every entry point maps onto:
+
+    0  run/replay completed, invariants intact
+    1  the simulation itself is wrong: replay divergence, sentinel
+       violation, NaN, state.err set -- deterministic, replayable
+    2  usage error or refusal (bad flags, incompatible configs,
+       benchdiff refusing a cross-config compare)
+    3  infrastructure failure the ladder could not recover (OOM, hung
+       device, crash, interrupt)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+from .core import engine
+from .core.simtime import SIMTIME_ONE_SECOND
+
+# ---------------------------------------------------------------------------
+# The unified exit-code table (cli.py returns these; tools/benchdiff.py
+# and tools/faultdrill.py use the same meanings).
+
+RC_OK = 0          # completed, invariants intact
+RC_INVARIANT = 1   # simulation wrong: divergence / sentinel / NaN / err
+RC_USAGE = 2       # usage error or refusal
+RC_FAILED = 3      # unrecovered infrastructure failure
+
+# Failure classes (crash.json "failure.class").
+F_SENTINEL = "sentinel"        # device invariant probe fired
+F_NAN = "nan"                  # non-finite values (sentinel or jax)
+F_OOM = "oom"                  # XLA RESOURCE_EXHAUSTED / out of memory
+F_HUNG = "hung"                # wall-clock watchdog fired
+F_INTERRUPTED = "interrupted"  # KeyboardInterrupt / SIGTERM
+F_ERROR = "error"              # anything else
+
+# Deterministic classes reproduce bitwise from the same checkpoint, so
+# plain retry is pointless (skipped on the ladder) and exhausting the
+# ladder means the SIMULATION is wrong -> rc 1, not rc 3.
+DETERMINISTIC = frozenset({F_SENTINEL, F_NAN})
+
+# Ladder rungs, in order.  Each is taken at most once per run; every
+# degradation is sticky for the rest of the run.
+RUNGS = ("retry", "megakernel_off", "halve_chunk", "gather_single")
+
+# Chunk-halving floor: below ~250 ms of sim time per launch the host
+# loop overhead dominates and shrinking further cannot dodge anything.
+MIN_CHUNK_NS = SIMTIME_ONE_SECOND // 4
+
+CRASH_VERSION = 1
+
+
+class HungLaunch(RuntimeError):
+    """The wall-clock watchdog fired: a device launch did not complete
+    within the deadline.  The launch thread may still hold the device,
+    so in-process recovery is unsafe -- the supervisor surrenders and
+    the crash.json resume hint restarts in a fresh process."""
+
+
+class UnrecoveredFailure(RuntimeError):
+    """The degradation ladder is exhausted (or the failure class rules
+    in-process recovery out).  Carries the crash report dict and the
+    crash.json path; `rc` is the exit code the process should die with:
+    1 for deterministic simulation failures, 3 for infrastructure."""
+
+    def __init__(self, crash: dict, path: str):
+        self.crash = crash
+        self.path = path
+        f = crash.get("failure", {})
+        super().__init__(
+            f"unrecovered {f.get('class', 'error')} failure: "
+            f"{f.get('message', '')} (crash report: {path})")
+
+    @property
+    def rc(self) -> int:
+        cls = self.crash.get("failure", {}).get("class")
+        return RC_INVARIANT if cls in DETERMINISTIC else RC_FAILED
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception from a launch to a failure class."""
+    from . import trace
+    if isinstance(exc, trace.SentinelViolation):
+        from .core.state import SENTINEL_NONFINITE
+        bits = int(exc.row.get("violations", 0)) if exc.row else 0
+        # Pure non-finiteness is the NaN class; anything else (alone or
+        # mixed) is a logic-invariant violation.
+        return F_NAN if bits == SENTINEL_NONFINITE else F_SENTINEL
+    if isinstance(exc, KeyboardInterrupt):
+        return F_INTERRUPTED
+    if isinstance(exc, HungLaunch):
+        return F_HUNG
+    if isinstance(exc, FloatingPointError):
+        return F_NAN  # jax_debug_nans raises this on the poisoned op
+    msg = str(exc)
+    if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+        return F_OOM
+    return F_ERROR
+
+
+def install_sigterm() -> bool:
+    """Convert SIGTERM into KeyboardInterrupt so a polite kill walks the
+    same surrender path as ctrl-C (crash.json + rc 3) instead of dying
+    with drains unflushed.  Returns False outside the main thread."""
+    import signal
+
+    def _handler(signum, frame):
+        raise KeyboardInterrupt(f"terminated by signal {signum}")
+
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+        return True
+    except ValueError:
+        return False
+
+
+def trim_windows(path: str, before_window: int) -> int:
+    """Drop flight-recorder rows at-or-after `before_window` from a
+    windows.jsonl (atomically).  Auto-resume rewinds to a checkpoint at
+    window K and re-records every window >= K bitwise; trimming first
+    keeps the file one contiguous, duplicate-free record.  Returns the
+    number of rows dropped."""
+    if not os.path.exists(path):
+        return 0
+    kept, dropped = [], 0
+    with open(path) as f:
+        for line in f:
+            s = line.strip()
+            if not s:
+                continue
+            try:
+                w = json.loads(s).get("window")
+            except json.JSONDecodeError:
+                dropped += 1  # torn tail line from a crashed writer
+                continue
+            if w is not None and int(w) >= int(before_window):
+                dropped += 1
+            else:
+                kept.append(s)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        for s in kept:
+            f.write(s + "\n")
+    os.replace(tmp, path)
+    return dropped
+
+
+def _json_safe(obj):
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, (str, bool)) or obj is None:
+        return obj
+    if isinstance(obj, float):
+        return obj
+    try:
+        return int(obj)  # numpy scalars off a device_get
+    except (TypeError, ValueError):
+        return str(obj)
+
+
+class Supervisor:
+    """Failure-classifying wrapper around the chunked launch loop.
+
+    `launch(state, params, t_next)` advances the simulation to `t_next`
+    exactly like (mesh_)run_chunked, checks the sentinel, and on any
+    failure reloads the newest readable checkpoint and walks the
+    degradation ladder.  On success the returned state is at `t_next`
+    with the sentinel clean; params are never mutated (megakernel-off
+    is applied per-launch to a copy, so checkpoints keep the run's
+    canonical static stamps and replay templates stay valid).
+
+    `mesh` is owned by the supervisor: the gather_single rung sets it
+    to None, and callers should dispatch through launch() only.
+    `on_violation(state)` -- optional -- is called with the violated
+    state before a sentinel failure is handled, so the caller can drain
+    the flight recorder and windows.jsonl keeps the bad window's row
+    for the crash report's replay command.
+    """
+
+    def __init__(self, data_dir: str, app, *, mesh=None, chunk_ns=None,
+                 watchdog_s: float | None = None, quiet: bool = False,
+                 resume_cmd: str | None = None, on_violation=None):
+        from . import trace
+        self.data_dir = data_dir
+        self.app = app
+        self.mesh = mesh
+        self.chunk_ns = int(chunk_ns) if chunk_ns else engine.CHUNK_NS
+        self.watchdog_s = watchdog_s
+        self.quiet = quiet
+        self.resume_cmd = resume_cmd
+        self.on_violation = on_violation
+        self.sentinel = trace.SentinelDrain()
+        self.megakernel_off = False
+        self.ladder = []       # crash.json trail: rungs taken/skipped
+        self.recoveries = 0    # rungs actually taken
+        self._rung = 0         # next RUNGS index to consider
+
+    # -- public ----------------------------------------------------------
+
+    def launch(self, state, params, t_next):
+        """Advance `state` to sim time `t_next` under supervision."""
+        from . import trace
+        t_next = int(t_next)
+        while True:
+            try:
+                out = self._attempt(state, params, t_next)
+                try:
+                    self.sentinel.check(out)
+                except trace.SentinelViolation:
+                    if self.on_violation is not None:
+                        try:
+                            self.on_violation(out)
+                        except Exception:
+                            pass  # best-effort evidence flush
+                    raise
+                return out
+            except BaseException as e:
+                cls = classify(e)
+                row = getattr(e, "row", None) or self.sentinel.row
+                self._say(f"supervise: launch failed "
+                          f"({cls}: {type(e).__name__}: {e})")
+                if cls in (F_INTERRUPTED, F_HUNG):
+                    # A hung thread may still own the device; an
+                    # interrupt means the user wants out.  Both resume
+                    # in a fresh process via the crash.json hint.
+                    raise self._surrender(
+                        e, cls, state, row,
+                        touch_state=(cls != F_HUNG)) from e
+                state = self._recover(e, cls, state, params, row)
+
+    # -- execution -------------------------------------------------------
+
+    def _attempt(self, state, params, t_next):
+        exec_params = params
+        if self.megakernel_off and bool(getattr(params, "megakernel",
+                                                False)):
+            exec_params = params.replace(megakernel=False)
+
+        def go():
+            if self.mesh is not None:
+                from .parallel import mesh as pmesh
+                return pmesh.mesh_run_chunked(
+                    state, exec_params, self.app, t_next,
+                    mesh=self.mesh, chunk_ns=self.chunk_ns)
+            return engine.run_chunked(state, exec_params, self.app,
+                                      t_next, chunk_ns=self.chunk_ns)
+
+        if not self.watchdog_s:
+            return go()
+        box = {}
+
+        def work():
+            try:
+                import jax
+                out = go()
+                jax.block_until_ready(out)  # async dispatch would hide
+                box["out"] = out            # a wedged device
+            except BaseException as e:      # noqa: BLE001
+                box["exc"] = e
+
+        th = threading.Thread(target=work, daemon=True,
+                              name="shadow1-supervised-launch")
+        th.start()
+        th.join(self.watchdog_s)
+        if th.is_alive():
+            raise HungLaunch(
+                f"device launch did not complete within "
+                f"{self.watchdog_s:g}s wall-clock")
+        if "exc" in box:
+            raise box["exc"]
+        return box["out"]
+
+    # -- the ladder ------------------------------------------------------
+
+    def _recover(self, exc, cls, state, params, row):
+        while self._rung < len(RUNGS):
+            rung = RUNGS[self._rung]
+            self._rung += 1
+            skip = self._skip_reason(rung, cls, state, params)
+            if skip is not None:
+                self.ladder.append({"rung": rung, "action": "skipped",
+                                    "reason": skip})
+                continue
+            if rung == "megakernel_off":
+                self.megakernel_off = True
+            elif rung == "halve_chunk":
+                self.chunk_ns = max(self.chunk_ns // 2, MIN_CHUNK_NS)
+            elif rung == "gather_single":
+                self.mesh = None
+            try:
+                state, ck = self._reload(state, params)
+            except (FileNotFoundError, ValueError, OSError) as e:
+                raise self._surrender(
+                    exc, cls, state, row,
+                    note=f"ladder rung {rung!r} could not reload a "
+                         f"checkpoint: {e}") from exc
+            self.ladder.append({"rung": rung, "action": "taken",
+                                "failure": cls, "checkpoint": ck})
+            self.recoveries += 1
+            self._say(f"supervise: ladder rung {rung!r}: resuming from "
+                      f"window {ck['window']} (t={ck['t_ns']} ns)")
+            return state
+        raise self._surrender(exc, cls, state, row) from exc
+
+    def _skip_reason(self, rung, cls, state, params):
+        if rung == "retry" and cls in DETERMINISTIC:
+            return ("deterministic failure class reproduces bitwise; "
+                    "plain retry cannot help")
+        if rung == "megakernel_off":
+            if not bool(getattr(params, "megakernel", False)):
+                return "megakernel already off"
+        if rung == "halve_chunk" and self.chunk_ns <= MIN_CHUNK_NS:
+            return f"chunk already at the {MIN_CHUNK_NS} ns floor"
+        if rung == "gather_single":
+            if self.mesh is None:
+                return "already single-device"
+            sharded = self._sharded_rings(state)
+            if sharded:
+                return (f"sharded ring(s) {sharded} cannot run "
+                        f"single-device (rebuild with shards=1 to "
+                        f"allow the gather rung)")
+        return None
+
+    @staticmethod
+    def _sharded_rings(state):
+        out = []
+        for name in ("cap", "log"):
+            r = getattr(state, name, None)
+            if r is not None and getattr(r.total, "ndim", 0) == 1 \
+                    and r.total.shape[0] > 1:
+                out.append(name)
+        sc = getattr(state, "scope", None)
+        if sc is not None and int(sc.n_shards) > 1:
+            out.append("scope")
+        return out
+
+    def _reload(self, state, params):
+        """(state, checkpoint-info) from the newest readable checkpoint.
+        The current state/params serve as the load template; the loaded
+        params are discarded -- NetParams never changes mid-run (the
+        netem schedule lives in state.nm), so the caller's canonical
+        params stay authoritative and megakernel-off remains a
+        launch-time override, never a saved static."""
+        from . import checkpoint, replay
+        path, man = replay.find_checkpoint(self.data_dir, None)
+        st, _ = checkpoint.load(path, state, params)
+        ck = {"file": os.path.basename(path),
+              "window": None if man is None else int(man["window"]),
+              "t_ns": None if man is None else int(man["t_ns"])}
+        return st, ck
+
+    # -- surrender -------------------------------------------------------
+
+    def _surrender(self, exc, cls, state, row, touch_state=True,
+                   note=None):
+        """Write crash.json and return the UnrecoveredFailure to raise."""
+        from . import replay
+        crash = {
+            "version": CRASH_VERSION,
+            "failure": {"class": cls, "type": type(exc).__name__,
+                        "message": str(exc)},
+            "window": None,
+            "t_ns": None,
+            "sentinel": _json_safe(row) if row else None,
+            "checkpoint": None,
+            "ladder": _json_safe(self.ladder),
+            "resume": self.resume_cmd,
+        }
+        if note:
+            crash["failure"]["note"] = note
+        if row and int(row.get("first_bad_window", -1)) >= 0:
+            crash["window"] = int(row["first_bad_window"])
+            crash["t_ns"] = int(row["first_bad_t"])
+        elif touch_state and state is not None:
+            try:
+                import jax
+                w, t = jax.device_get((state.n_windows, state.now))
+                crash["window"], crash["t_ns"] = int(w), int(t)
+            except Exception:
+                pass  # never let evidence collection mask the failure
+        try:
+            path, man = replay.find_checkpoint(self.data_dir, None)
+            crash["checkpoint"] = {
+                "file": os.path.basename(path),
+                "window": None if man is None else int(man["window"]),
+                "t_ns": None if man is None else int(man["t_ns"])}
+        except Exception:
+            pass
+        if crash["window"] is not None:
+            crash["replay"] = (f"shadow1-tpu replay --data-directory "
+                               f"{self.data_dir} --window "
+                               f"{crash['window']}")
+        out = os.path.join(self.data_dir, "crash.json")
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(crash, f, indent=1, sort_keys=True)
+        os.replace(tmp, out)
+        self._say(f"supervise: unrecovered {cls} failure; crash report "
+                  f"at {out}")
+        return UnrecoveredFailure(crash, out)
+
+    def _say(self, msg):
+        if not self.quiet:
+            print(msg, file=sys.stderr)

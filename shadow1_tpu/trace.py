@@ -572,10 +572,16 @@ class FlightDrain:
     has an original counterpart is compared bitwise (full dict
     equality, exchange matrices included); the first mismatch raises
     ReplayDivergence naming the window -- divergence is a loud,
-    window-pinpointed error, never silent garbage."""
+    window-pinpointed error, never silent garbage.
+
+    `mode="a"` appends to an existing windows.jsonl instead of
+    truncating it: auto-resume (supervise.py) trims the file to rows
+    below the resume checkpoint's window, then appends the re-recorded
+    (bitwise-identical) rows from there, keeping one contiguous record
+    across process lifetimes."""
 
     def __init__(self, path: str | None = None, start: int = 0,
-                 verify_against: dict | None = None):
+                 verify_against: dict | None = None, mode: str = "w"):
         self.path = path
         self.rows = []
         self.rows_lost = 0
@@ -584,7 +590,7 @@ class FlightDrain:
         self._last = int(start)
         self.verify_against = verify_against
         self.verified = 0       # rows that matched an original record
-        self._f = open(path, "w") if path else None
+        self._f = open(path, mode) if path else None
 
     def drain(self, state, profiler=None) -> int:
         """Fetch rows appended since the last drain; returns how many."""
@@ -607,6 +613,32 @@ class FlightDrain:
                                    fr.ex_bytes))
             p.transfer(sum(a.nbytes for a in arrs), count=1)
             if new > c:
+                # Ring wrap between drains: rows [self._last, total - c)
+                # are gone.  When this drain is verifying a replay, a
+                # wrapped-away verify target can never be checked --
+                # fail loudly rather than silently skipping it; if every
+                # verify target survived the wrap, verify the surviving
+                # suffix but say so explicitly.
+                if self.verify_against is not None:
+                    gone = [w for w in self.verify_against
+                            if self._last <= w < total - c]
+                    if gone:
+                        self._last = total
+                        raise RuntimeError(
+                            f"flight-recorder ring wrapped over "
+                            f"{len(gone)} window(s) awaiting replay "
+                            f"verification (first {min(gone)}, last "
+                            f"{max(gone)}): the gap between drains "
+                            f"exceeded the ring capacity ({c}); rerun "
+                            f"with a larger recorder or a drain/"
+                            f"checkpoint cadence under {c} windows")
+                    import warnings
+                    warnings.warn(
+                        f"flight-recorder ring wrapped during a "
+                        f"verified replay ({new - c} row(s) lost, none "
+                        f"of them verify targets); only the surviving "
+                        f"suffix of windows.jsonl is being verified",
+                        RuntimeWarning, stacklevel=2)
                 self.rows_lost += new - c
                 start = total - c
             else:
@@ -679,6 +711,98 @@ class FlightDrain:
             if sim_s > 0:
                 out["windows_per_sim_s"] = round(len(self.rows) / sim_s, 3)
         return out
+
+
+# ---------------------------------------------------------------------------
+# Invariant sentinel (the SentinelBlock on SimState; core/state.py)
+# ---------------------------------------------------------------------------
+
+
+def ensure_sentinel(state):
+    """Return `state` with the per-window invariant sentinel installed
+    (idempotent).  The block is a handful of replicated scalars, so it
+    needs no shard sizing -- the same install works single-device and
+    on any mesh.  `last_we` seeds from the current sim time so a
+    mid-run install never trips the monotonicity probe on its first
+    window."""
+    if state.sentinel is not None:
+        return state
+    import jax.numpy as _jnp
+    from .core.state import I64, make_sentinel
+    sn = make_sentinel()
+    sn = sn.replace(last_we=_jnp.asarray(state.now, I64))
+    return state.replace(sentinel=sn)
+
+
+def sentinel_classes(bits: int) -> list:
+    """The violation-class names set in a SENTINEL_* bitmask."""
+    from .core.state import SENTINEL_CLASS_NAMES
+    return [name for bit, name in sorted(SENTINEL_CLASS_NAMES.items())
+            if int(bits) & bit]
+
+
+class SentinelViolation(RuntimeError):
+    """A device-side invariant probe fired: the simulation violated
+    packet conservation, window-time monotonicity, a stage/queue/cursor
+    bound, or finiteness of its float islands.  Raised by
+    SentinelDrain.check(); carries the full sentinel row (the same dict
+    the supervisor stamps into crash.json)."""
+
+    def __init__(self, row: dict):
+        self.row = row
+        names = sentinel_classes(row.get("violations", 0))
+        super().__init__(
+            f"sentinel violation ({'+'.join(names) or 'unknown'}) first "
+            f"at window {row.get('first_bad_window')} "
+            f"(t={row.get('first_bad_t')} ns); replay it with "
+            f"`shadow1-tpu replay --window {row.get('first_bad_window')}`"
+        )
+
+
+class SentinelDrain:
+    """Host-side drain of the invariant sentinel: ONE bulk fetch of the
+    block's scalars at chunk boundaries (riding the existing sync
+    points, like FlightDrain).  `drain` returns the current row;
+    `check` additionally raises SentinelViolation the moment any sticky
+    violation bit is set, which is what the supervisor catches."""
+
+    def __init__(self):
+        self.row = None
+
+    def drain(self, state, profiler=None):
+        sn = getattr(state, "sentinel", None)
+        if sn is None:
+            return None
+        import jax
+        p = profiler if profiler is not None else _active
+        with p.span("sentinel_drain"):
+            vals = jax.device_get((sn.checks, sn.violations,
+                                   sn.last_violation, sn.first_bad_window,
+                                   sn.first_bad_t, sn.last_we,
+                                   sn.resid_low, sn.resid_high,
+                                   sn.nonfinite))
+            p.transfer(8 * len(vals), count=1)
+        (checks, bits, last, fw, ft, lwe, rlo, rhi, nf) = map(int, vals)
+        self.row = {
+            "checks": checks,
+            "violations": bits,
+            "classes": sentinel_classes(bits),
+            "last_violation": last,
+            "first_bad_window": fw,
+            "first_bad_t": ft,
+            "last_we": lwe,
+            "resid_low": rlo,
+            "resid_high": rhi,
+            "nonfinite": nf,
+        }
+        return self.row
+
+    def check(self, state, profiler=None):
+        """Drain; raise SentinelViolation if any probe has ever fired."""
+        row = self.drain(state, profiler)
+        if row is not None and row["violations"]:
+            raise SentinelViolation(row)
+        return row
 
 
 # ---------------------------------------------------------------------------

@@ -175,6 +175,30 @@ def _checkpoint_config(d: dict):
 _UNSTAMPED = object()
 
 
+def _sentinel_config(d: dict):
+    """Whether a run carried the sentinel block: the config.sentinel
+    stamp (bool), or _UNSTAMPED for files written before bench.py
+    stamped it.  The block adds invariant counters to the traced graph,
+    so sentinel-on vs sentinel-off measure different programs; legacy
+    files stay comparable (the checkpoint rule)."""
+    cfg = d.get("config")
+    if not isinstance(cfg, dict) or "sentinel" not in cfg:
+        return _UNSTAMPED
+    return bool(cfg["sentinel"])
+
+
+def _supervise_config(d: dict):
+    """Whether a run was supervised: the config.supervise stamp (bool),
+    or _UNSTAMPED for pre-stamp files.  Supervision adds a host-side
+    sentinel check (a device_get of the reduced counters) per launch,
+    so supervised wall numbers measure a different loop than bare
+    ones; legacy files stay comparable (the checkpoint rule)."""
+    cfg = d.get("config")
+    if not isinstance(cfg, dict) or "supervise" not in cfg:
+        return _UNSTAMPED
+    return bool(cfg["supervise"])
+
+
 def _kernel_world(d: dict):
     """The fixed-world config a kernelcount report was measured on:
     (backend, world dict) for a standalone tools/kernelcount.py JSON or
@@ -349,6 +373,28 @@ def main(argv=None) -> int:
               f"checkpoint cadences (old checkpoint_every={ck_old!r}, "
               f"new checkpoint_every={ck_new!r}); re-record with "
               f"matching --checkpoint-every settings", file=sys.stderr)
+        return 2
+    sn_old, sn_new = _sentinel_config(old), _sentinel_config(new)
+    if sn_old is not _UNSTAMPED and sn_new is not _UNSTAMPED \
+            and sn_old != sn_new:
+        # The sentinel block compiles invariant counters into the window
+        # loop, so sentinel-on vs sentinel-off are different graphs --
+        # the megakernel rule.  Unstamped legacy files pass.
+        print(f"benchdiff: refusing to compare runs with different "
+              f"sentinel configs (old sentinel={sn_old!r}, "
+              f"new sentinel={sn_new!r}); re-record with matching "
+              f"settings", file=sys.stderr)
+        return 2
+    sv_old, sv_new = _supervise_config(old), _supervise_config(new)
+    if sv_old is not _UNSTAMPED and sv_new is not _UNSTAMPED \
+            and sv_old != sv_new:
+        # Supervision is host-side (graphs match), but the per-launch
+        # sentinel device_get adds wall time, so supervised vs bare
+        # runs measure different loops -- the checkpoint rule.
+        print(f"benchdiff: refusing to compare a supervised run "
+              f"against a bare one (old supervise={sv_old!r}, "
+              f"new supervise={sv_new!r}); re-record with matching "
+              f"--auto-resume settings", file=sys.stderr)
         return 2
     if args.kernels:
         wo, wn = _kernel_world(old), _kernel_world(new)

@@ -1,0 +1,325 @@
+"""Fault-injection drills for the self-healing run loop.
+
+Exercises the supervision stack (shadow1_tpu/supervise.py, the sentinel
+block, checkpoint-anchored --auto-resume) against the failures it is
+built for, end to end through real subprocesses:
+
+    python tools/faultdrill.py examples/tgen-2host/shadow.config.xml
+
+Drills (--drill, default "all"):
+
+* kill -- SIGKILL the run after its second checkpoint lands, then
+  re-launch with --auto-resume.  Passes when the resumed run finishes
+  rc 0 and its windows.jsonl is byte-identical to an uninterrupted
+  reference run (the flight-recorder rows capture the full per-window
+  trajectory, so byte equality there is bitwise trajectory equality).
+* torn -- same SIGKILL, then truncate the newest checkpoint file to
+  simulate a save that died mid-write.  Passes when --auto-resume
+  skips the torn file, anchors on the next-older checkpoint, and still
+  reproduces the reference windows.jsonl byte-for-byte.
+* nan -- poison an srtt lane of a mid-run checkpoint with a NaN bit
+  pattern (the classic silent-corruption case: f64 garbage in an
+  i64 timer leaf), drop the later checkpoints, and --auto-resume.
+  Passes when the sentinel trips in the first resumed window (rc 1,
+  crash.json with failure.class "nan" and a walked ladder) and
+  `shadow1-tpu replay --window K` reproduces the violation (rc 1).
+
+Why NaN and not a counter poison: the conservation sentinel is
+delta-based (it snapshots counters at window open), so corruption
+injected BETWEEN windows lands in the snapshot too and cancels out --
+by design only in-window engine bugs can trip it.  Host injection
+therefore drills the nonfinite/bounds/time classes; see
+docs/robustness.md.
+
+Each drill is independent; the reference run is shared.  Exit 0 when
+every requested drill passes, 1 on the first failure.  Not part of the
+test suite (a full drill is ~3 uninterrupted runs of the config);
+tests/test_supervise.py covers the same machinery in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# srtt lane poisoned by the nan drill: host 0, slot 1, with the bit
+# pattern of a float64 NaN reinterpreted as i64 -- far above the 600 s
+# timer-plausibility ceiling, so the nonfinite probe trips on it.
+NAN_BITS = 9221120237041090560
+
+
+def _cmd(config: str, data_dir: str, *, every: float, stop: int,
+         resume: bool) -> list:
+    argv = [sys.executable, "-m", "shadow1_tpu", "run", config,
+            "--checkpoint-every", f"{every:g}", "--stop-time", str(stop),
+            "--data-directory", data_dir, "--quiet"]
+    if resume:
+        argv.append("--auto-resume")
+    return argv
+
+
+def _run(argv: list) -> tuple:
+    p = subprocess.run(argv, cwd=REPO, capture_output=True, text=True)
+    return p.returncode, p.stdout, p.stderr
+
+
+def _summary(stdout: str) -> dict:
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise ValueError(f"no JSON summary in run output: {stdout!r}")
+
+
+# Deterministic summary fields: everything machine-bound (wall time,
+# absolute paths) or resume-dependent (the supervise block's check
+# counter restarts with the process) is excluded, so a resumed run must
+# match the reference exactly on what's left.
+_DETERMINISTIC = ("simulated_seconds", "hosts", "streams_completed",
+                  "streams_failed", "packets_sent", "packets_received",
+                  "bytes_sent", "drops_inet", "drops_router",
+                  "drops_pool", "acks_thinned", "err_flags")
+
+
+def _strip(summary: dict) -> dict:
+    return {k: summary.get(k) for k in _DETERMINISTIC}
+
+
+def _kill_after_checkpoints(argv: list, ckpt_dir: str, n: int = 2,
+                            timeout_s: float = 600.0) -> None:
+    """Launch argv and SIGKILL it once n checkpoint files exist."""
+    p = subprocess.Popen(argv, cwd=REPO, stdout=subprocess.DEVNULL,
+                         stderr=subprocess.DEVNULL)
+    deadline = time.time() + timeout_s
+    try:
+        while time.time() < deadline:
+            if p.poll() is not None:
+                raise RuntimeError(
+                    f"victim run exited rc {p.returncode} before "
+                    f"{n} checkpoints landed -- raise --stop-time or "
+                    f"lower --checkpoint-every")
+            if len(glob.glob(os.path.join(ckpt_dir, "win_*.npz"))) >= n:
+                p.send_signal(signal.SIGKILL)
+                p.wait()
+                return
+            time.sleep(0.1)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    raise RuntimeError(f"no {n}th checkpoint within {timeout_s:g}s")
+
+
+def _compare(ref_dir: str, got_dir: str, ref_sum: dict, got_sum: dict,
+             label: str) -> list:
+    errs = []
+    if _strip(ref_sum) != _strip(got_sum):
+        errs.append(f"{label}: summary diverged from reference:\n"
+                    f"  ref {_strip(ref_sum)}\n  got {_strip(got_sum)}")
+    with open(os.path.join(ref_dir, "windows.jsonl"), "rb") as f:
+        ref_rows = f.read()
+    with open(os.path.join(got_dir, "windows.jsonl"), "rb") as f:
+        got_rows = f.read()
+    if ref_rows != got_rows:
+        errs.append(f"{label}: windows.jsonl is not byte-identical to "
+                    f"the reference ({len(ref_rows)} vs {len(got_rows)} "
+                    f"bytes)")
+    return errs
+
+
+def drill_kill(config, wd, ref_dir, ref_sum, every, stop, *, torn=False):
+    """SIGKILL mid-run, optionally tear the newest checkpoint, resume."""
+    name = "torn" if torn else "kill"
+    d = os.path.join(wd, name)
+    argv = _cmd(config, d, every=every, stop=stop, resume=True)
+    _kill_after_checkpoints(argv, os.path.join(d, "ckpt"))
+    if torn:
+        files = glob.glob(os.path.join(d, "ckpt", "win_*.npz"))
+        newest = max(files, key=os.path.getmtime)
+        size = os.path.getsize(newest)
+        with open(newest, "r+b") as f:
+            f.truncate(size // 2)
+        print(f"  tore {os.path.basename(newest)} "
+              f"({size} -> {size // 2} bytes)")
+    rc, out, err = _run(argv)
+    if rc != 0:
+        return [f"{name}: resume exited rc {rc}\n{err}"]
+    s = _summary(out)
+    resumed = (s.get("supervise") or {}).get("resumed_from")
+    if not resumed:
+        return [f"{name}: resume did not anchor on a checkpoint "
+                f"(supervise.resumed_from is null)"]
+    print(f"  resumed from window {resumed['window']} "
+          f"({resumed['file']})")
+    return _compare(ref_dir, d, ref_sum, s, name)
+
+
+def _poison_checkpoint(data_dir: str) -> dict:
+    """NaN-poison the srtt leaf of a mid-run checkpoint and drop every
+    later one, so --auto-resume must anchor on the poisoned state.
+    Returns the chosen index entry."""
+    import numpy as np
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from shadow1_tpu import checkpoint, replay
+
+    ckdir = os.path.join(data_dir, "ckpt")
+    idx_path = os.path.join(ckdir, "index.json")
+    with open(idx_path) as f:
+        idx = json.load(f)
+    entries = sorted(idx["checkpoints"], key=lambda e: e["window"])
+    if len(entries) < 3:
+        raise RuntimeError(
+            f"need >= 3 checkpoints to pick a mid-run one, have "
+            f"{len(entries)} -- lower --checkpoint-every")
+    # The second checkpoint: past warm-up (transfers active, so the
+    # poisoned timer is actually read) but with later saves to drop.
+    victim = entries[1]
+    for e in entries[2:]:
+        os.remove(os.path.join(ckdir, e["file"]))
+    idx["checkpoints"] = entries[:2]
+    with open(idx_path, "w") as f:
+        json.dump(idx, f, indent=1)
+
+    info = replay.load_run(data_dir)
+    built = replay.rebuild_world(info, data_dir, want_mesh=False)
+    path = os.path.join(ckdir, victim["file"])
+    man = checkpoint.read_manifest(path)
+    state, params = checkpoint.load(path, built["state"],
+                                    built["params"])
+    srtt = np.asarray(state.socks.srtt).copy()
+    srtt[0, 1] = np.int64(NAN_BITS)
+    state = state.replace(socks=state.socks.replace(srtt=srtt))
+    checkpoint.save(path, state, params, manifest=man)
+    return victim
+
+
+def drill_nan(config, wd, ref_dir, every, stop):
+    d = os.path.join(wd, "nan")
+    os.makedirs(d)
+    shutil.copytree(os.path.join(ref_dir, "ckpt"),
+                    os.path.join(d, "ckpt"))
+    shutil.copy(os.path.join(ref_dir, "windows.jsonl"),
+                os.path.join(d, "windows.jsonl"))
+    victim = _poison_checkpoint(d)
+    print(f"  poisoned srtt[0,1] in {victim['file']} "
+          f"(window {victim['window']})")
+
+    rc, out, err = _run(_cmd(config, d, every=every, stop=stop,
+                             resume=True))
+    errs = []
+    if rc != 1:
+        errs.append(f"nan: expected rc 1 (invariant violation), "
+                    f"got {rc}\n{err}")
+    crash_path = os.path.join(d, "crash.json")
+    if not os.path.exists(crash_path):
+        return errs + ["nan: no crash.json written"]
+    with open(crash_path) as f:
+        crash = json.load(f)
+    fail = crash.get("failure", {})
+    if fail.get("class") != "nan":
+        errs.append(f"nan: crash.json classified the failure as "
+                    f"{fail.get('class')!r}, expected 'nan'")
+    if not crash.get("ladder"):
+        errs.append("nan: crash.json records no ladder walk")
+    window = crash.get("window")
+    print(f"  sentinel tripped at window {window}, ladder walked "
+          f"{len(crash.get('ladder', []))} rungs")
+
+    rc2, out2, err2 = _run([sys.executable, "-m", "shadow1_tpu",
+                            "replay", "--data-directory", d,
+                            "--window", str(window), "--quiet"])
+    if rc2 != 1:
+        errs.append(f"nan: replay of window {window} exited rc {rc2}, "
+                    f"expected 1 (reproduced violation)\n{err2}")
+    elif "sentinel" not in err2:
+        errs.append(f"nan: replay rc 1 but stderr does not mention the "
+                    f"sentinel:\n{err2}")
+    else:
+        print(f"  replay reproduced the violation (rc 1)")
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fault-injection drills for supervised runs")
+    ap.add_argument("config", help="shadow.config.xml to drill with")
+    ap.add_argument("--drill", choices=("all", "kill", "torn", "nan"),
+                    default="all")
+    ap.add_argument("--checkpoint-every", type=float, default=2.0,
+                    metavar="SECONDS")
+    ap.add_argument("--stop-time", type=int, default=8,
+                    metavar="SECONDS")
+    ap.add_argument("--workdir", default=None,
+                    help="scratch directory (default: a fresh tempdir)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch directory for inspection")
+    args = ap.parse_args(argv)
+
+    config = os.path.abspath(args.config)
+    wd = args.workdir or tempfile.mkdtemp(prefix="faultdrill_")
+    os.makedirs(wd, exist_ok=True)
+    drills = (("kill", "torn", "nan") if args.drill == "all"
+              else (args.drill,))
+
+    print(f"faultdrill: reference run ({args.stop_time}s sim, "
+          f"checkpoint every {args.checkpoint_every:g}s) ...")
+    ref_dir = os.path.join(wd, "ref")
+    # A stale ref from an earlier --keep run would auto-resume (and
+    # trim its own windows.jsonl) instead of re-recording; start clean.
+    shutil.rmtree(ref_dir, ignore_errors=True)
+    for name in drills:
+        shutil.rmtree(os.path.join(wd, name), ignore_errors=True)
+    rc, out, err = _run(_cmd(config, ref_dir,
+                             every=args.checkpoint_every,
+                             stop=args.stop_time, resume=True))
+    if rc != 0:
+        print(f"faultdrill: reference run failed rc {rc}\n{err}",
+              file=sys.stderr)
+        return 1
+    ref_sum = _summary(out)
+
+    failures = []
+    for name in drills:
+        print(f"faultdrill: drill '{name}' ...")
+        if name == "kill":
+            errs = drill_kill(config, wd, ref_dir, ref_sum,
+                              args.checkpoint_every, args.stop_time)
+        elif name == "torn":
+            errs = drill_kill(config, wd, ref_dir, ref_sum,
+                              args.checkpoint_every, args.stop_time,
+                              torn=True)
+        else:
+            errs = drill_nan(config, wd, ref_dir,
+                             args.checkpoint_every, args.stop_time)
+        if errs:
+            failures.extend(errs)
+            print(f"faultdrill: drill '{name}' FAILED")
+        else:
+            print(f"faultdrill: drill '{name}' passed")
+
+    if not args.keep and not failures:
+        shutil.rmtree(wd, ignore_errors=True)
+    elif failures:
+        print(f"faultdrill: artifacts kept under {wd}")
+    for e in failures:
+        print(f"faultdrill: {e}", file=sys.stderr)
+    print(f"faultdrill: {'FAIL' if failures else 'PASS'} "
+          f"({len(drills)} drill(s))")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
