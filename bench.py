@@ -223,6 +223,10 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
             # compare (the ring writes change the traced graph), like
             # the netem/flight refusals.  bench.py never samples.
             "scope": None,
+            # Lineage stamp: a packet-lineage tracer adds span-ring
+            # writes to the traced graph, so benchdiff refuses a
+            # traced-vs-untraced compare too.  bench.py never traces.
+            "lineage": None,
             # Checkpoint stamp: cadenced saves add launch boundaries and
             # host-side npz wall time, so benchdiff refuses a cadence
             # mismatch; bench.py never checkpoints.
@@ -403,6 +407,7 @@ def main_multichip(n_devices: int, gate_against: str | None = None) -> int:
             # graph), mirroring the netem refusal.
             "flight": top.get("flight"),
             "scope": None,
+            "lineage": None,
             "checkpoint_every": None,
             "sentinel": False,
             "supervise": False,

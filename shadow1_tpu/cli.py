@@ -53,7 +53,8 @@ _WORLD_ARGS = (
     "tcp_congestion_control", "interface_qdisc", "cpu_threshold",
     "cpu_precision", "pcap", "pcap_ring", "netem", "churn",
     "churn_downtime", "log_level", "log_ring", "profile", "bucket",
-    "devices", "scope", "checkpoint_every")
+    "devices", "scope", "trace_packets", "flight_rows",
+    "checkpoint_every")
 
 
 def world_args(args) -> dict:
@@ -178,6 +179,22 @@ def _parser():
                         "'flows', 'flows,links:50ms' (default interval "
                         "100ms).  Sampling never perturbs the "
                         "trajectory; see docs/observability.md")
+    r.add_argument("--trace-packets", metavar="RATE", default=None,
+                   help="packet lineage: assign trace IDs to a seeded, "
+                        "deterministic RATE-sized sample of packets at "
+                        "emission (e.g. 0.01, 1%%, or 'all') and record "
+                        "one span per hop (emit/stage/tx/link/exchange/"
+                        "deliver, with the drop reason where a packet "
+                        "died) to spans.jsonl in the data directory.  "
+                        "Tracing never perturbs the trajectory; see "
+                        "docs/observability.md 'Packet lineage'")
+    r.add_argument("--flight-rows", type=int, default=None, metavar="N",
+                   help="flight-recorder ring capacity in windows "
+                        "(default 4096): size it above the number of "
+                        "windows between drains/checkpoints to keep "
+                        "windows.jsonl gap-free (wrapped windows lose "
+                        "their per-window row; lifetime totals stay "
+                        "exact either way)")
     r.add_argument("--checkpoint-every", type=float, metavar="SECONDS",
                    default=None,
                    help="make the run replayable (docs/observability.md "
@@ -241,6 +258,16 @@ def _parser():
                          "span (same SPEC as run --scope) even if the "
                          "original run had none -- trajectory-neutral, "
                          "so the replay still verifies bitwise")
+    rp.add_argument("--trace-packets", metavar="RATE", default=None,
+                    help="install packet-lineage tracing on the "
+                         "replayed span (same RATE spec as run "
+                         "--trace-packets) even if the original run "
+                         "had none: sampling is a seeded function of "
+                         "(source host, emission counter), so the "
+                         "replay traces exactly the packets the "
+                         "original run would have -- trajectory-"
+                         "neutral, so the replay still verifies "
+                         "bitwise; spans land in OUT/spans.jsonl")
     rp.add_argument("--log-level", choices=("off", "warning", "debug"),
                     default="off",
                     help="event-log the replayed span to "
@@ -471,14 +498,17 @@ def build_world(args, *, quiet: bool = False, want_mesh: bool = True,
                   f"{int(state.hosts.num_hosts) // args.devices} hosts "
                   f"per shard", file=sys.stderr)
 
-    if args.profile or getattr(args, "checkpoint_every", None):
+    if args.profile or getattr(args, "checkpoint_every", None) \
+            or getattr(args, "flight_rows", None):
         # Per-window flight recorder (installed AFTER mesh padding so the
         # shard matrices match the padded host count); drained at the
         # same chunk boundaries as the counters -- no extra syncs.
         # Checkpointed runs always carry it: windows.jsonl is the record
-        # replay verifies against.
+        # replay verifies against.  --flight-rows overrides the 4096-row
+        # default for drain cadences that would wrap the ring.
         from . import trace
-        state = trace.ensure_flight_recorder(state, shards=n_dev)
+        state = trace.ensure_flight_recorder(
+            state, shards=n_dev, rows=getattr(args, "flight_rows", None))
 
     if args.scope:
         # Flowscope sampling block (same AFTER-mesh-padding rule: each
@@ -492,6 +522,21 @@ def build_world(args, *, quiet: bool = False, want_mesh: bool = True,
                                             **scope_kw)
         if not quiet:
             print(f"[shadow1-tpu] scope: {args.scope}", file=sys.stderr)
+
+    if getattr(args, "trace_packets", None):
+        # Packet-lineage tracer (same AFTER-mesh-padding rule: span-ring
+        # segments and the pool/inbox side arrays are laid out per
+        # shard).
+        from . import trace as _trace_mod2
+        try:
+            rate = _trace_mod2.parse_lineage_rate(args.trace_packets)
+        except ValueError as e:
+            raise CliError(str(e))
+        state = _trace_mod2.ensure_lineage(state, rate=rate,
+                                           shards=n_dev)
+        if not quiet:
+            print(f"[shadow1-tpu] lineage: sampling {rate:g} of "
+                  f"emissions", file=sys.stderr)
 
     return types.SimpleNamespace(
         asm=asm, state=state, params=params, app=app, stop=int(stop),
@@ -523,6 +568,17 @@ def run_config(args) -> int:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+
+    if getattr(args, "trace_packets", None):
+        if not args.data_directory:
+            print("error: --trace-packets requires --data-directory",
+                  file=sys.stderr)
+            return RC_USAGE
+        try:
+            trace.parse_lineage_rate(args.trace_packets)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return RC_USAGE
 
     ck_every_ns = None
     if getattr(args, "checkpoint_every", None):
@@ -639,6 +695,11 @@ def run_config(args) -> int:
             if scope_kw["links"] else None,
             real_hosts=len(asm.hostnames))
 
+    spans = None
+    if state.lineage is not None and args.data_directory:
+        spans = trace.LineageDrain(
+            os.path.join(args.data_directory, "spans.jsonl"))
+
     ck = None
     if ck_every_ns:
         from . import replay as replay_mod
@@ -654,6 +715,8 @@ def run_config(args) -> int:
                 "bucket": bool(args.bucket),
                 "hosts_real": len(asm.hostnames),
                 "scope": args.scope, "profile": bool(args.profile),
+                "flight_rows": int(state.fr.steps.shape[0]),
+                "lineage": getattr(args, "trace_packets", None),
                 "sentinel": supervise_on, "supervise": supervise_on})
             ck.save(state, params)  # win_0: a replay anchor always exists
         if not args.quiet:
@@ -718,12 +781,14 @@ def run_config(args) -> int:
                 flight.drain(state, profiler)
             if scope is not None:
                 scope.drain(state, profiler)
+            if spans is not None:
+                spans.drain(state, profiler)
             if ck is not None:
                 ck.maybe(state, params, t)
             if progress is not None:
                 progress.update(state, t)
     except UnrecoveredFailure as e:
-        for closer in (flight, drain):
+        for closer in (flight, drain, spans):
             if closer is not None:
                 try:
                     closer.close()
@@ -798,6 +863,12 @@ def run_config(args) -> int:
         if profiler is not None:
             profiler.set_scope(scope.flow_rows, scope.link_rows,
                                summary["net"])
+    if spans is not None:
+        spans.drain(state, profiler)
+        spans.close()
+        summary["lineage"] = spans.summary()
+        if profiler is not None:
+            profiler.set_lineage(spans.rows, summary["lineage"])
     if tracker is not None:
         tracker.summary(summary, state)
     if substrate is not None:
@@ -861,6 +932,7 @@ def replay_cmd(args) -> int:
         summary = replay_mod.replay(
             args.data_directory, window=args.window, time_s=args.time,
             out_dir=args.out, devices=args.devices, scope=args.scope,
+            lineage=args.trace_packets,
             log_level=args.log_level, pcap=args.pcap,
             pcap_ring=args.pcap_ring, log_ring=args.log_ring,
             profile=args.profile, progress=args.progress,

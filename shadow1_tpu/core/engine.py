@@ -75,6 +75,9 @@ from .state import (ERR_POOL_OVERFLOW, I32, I64, U32, PROTO_TCP, PROTO_UDP,
                     LOG_WARNING, LOG_DEBUG, LOG_DROP_INET, LOG_DROP_ROUTER,
                     LOG_DROP_TAIL, LOG_DROP_POOL, LOG_DELIVER, LOG_SEND,
                     LOG_NETEM_DOWN,
+                    SPAN_EMIT, SPAN_STAGE, SPAN_TX, SPAN_LINK, SPAN_EXCHANGE,
+                    SPAN_DELIVER, LREASON_QDISC, LREASON_LOSS,
+                    LREASON_HOST_DOWN, LREASON_ACK_SHED, LREASON_POOL,
                     SENTINEL_CONSERVATION, SENTINEL_TIME, SENTINEL_BOUNDS,
                     SENTINEL_NONFINITE, SENTINEL_TIMER_MAX_NS,
                     enc_lo, enc_hi, dec_i64, SimState, host_ids)
@@ -252,6 +255,48 @@ def _log_append(state: SimState, mask, code: int, level: int, time_v,
     ))
 
 
+def _lineage_append(state: SimState, mask, *, time_v, id_v, host_v, stage,
+                    reason_v=0):
+    """Append one span row per set mask element into the lineage ring
+    (traced away entirely when no tracer is installed).  `mask`/`time_v`/
+    `id_v`/`host_v` are flat arrays of equal length; untraced rows
+    (id 0) are masked out here so call sites pass raw side-array
+    gathers.  `host_v` carries GLOBAL host ids; `stage` is a static
+    SPAN_* code and `reason_v` an LREASON_* scalar or flat array.
+
+    The overflow policy is the capture ring's: one batch larger than
+    the ring keeps its first `c` rows deterministically, and `lost`
+    counts what a bigger ring would have kept."""
+    if state.lineage is None:
+        return state
+    ln = state.lineage
+    c = ln.capacity         # local segment size under a mesh shard
+    if ln.total.ndim == 1 and ln.total.shape[0] != 1:
+        raise ValueError(
+            "sharded lineage ring outside a mesh: a tracer built with "
+            "make_lineage(shards=N) only runs under "
+            "parallel.mesh_run_until (each shard needs its own cursor "
+            "slice); build it with shards=1 for single-device runs")
+    tot0 = ln.total.reshape(())    # scalar, or this shard's [1] cursor
+    m = mask & (id_v != 0)
+    rank = jnp.cumsum(m) - 1
+    n_tot = jnp.sum(m).astype(I64)
+    n_new = jnp.minimum(n_tot, c)
+    pos = ((tot0 + rank) % c).astype(I32)
+    idx = jnp.where(m & (rank < c), pos, c)
+    return state.replace(lineage=ln.replace(
+        s_time=ln.s_time.at[idx].set(time_v, mode="drop"),
+        s_id=ln.s_id.at[idx].set(id_v.astype(I32), mode="drop"),
+        s_host=ln.s_host.at[idx].set(host_v.astype(I32), mode="drop"),
+        s_stage=ln.s_stage.at[idx].set(stage, mode="drop"),
+        s_reason=ln.s_reason.at[idx].set(
+            reason_v if not hasattr(reason_v, "astype")
+            else reason_v.astype(I32), mode="drop"),
+        total=ln.total + n_new,
+        lost=ln.lost + (n_tot - n_new),
+    ))
+
+
 # ---------------------------------------------------------------------------
 # Next-event scan (replaces priority-queue peeks)
 # ---------------------------------------------------------------------------
@@ -363,13 +408,15 @@ def _rank_by_dst(mask, dstp, h, m):
     return off.reshape(-1)[blkid * h + dstp] + rank_in, total
 
 
-def _exchange_core(pool, ib, h, params):
+def _exchange_core(pool, ib, h, params, ret_islot=False):
     """Slab machinery of the boundary exchange, free of SimState
     packaging: rank movers by destination, splice them into inbox free
     slots, clear the outbox stage.  Returns (pool, inbox, total,
     total_prot, n_free) -- the three [H] per-destination tallies are
     what the accounting tail (_exchange_body) derives drops, trace
-    counters and recorder rows from.
+    counters and recorder rows from.  `ret_islot` (lineage tracing)
+    appends the slot-assignment internals (islot, ok, mvp, pad) so the
+    tail can move trace ids under the identical permutation.
 
     Split out so the megakernel path can run it as ONE single-block
     pallas call (megakernel.exchange_call): every op here is integer
@@ -457,6 +504,8 @@ def _exchange_core(pool, ib, h, params):
     # overflowed (and whether it was a shed ACK or a counted drop) is
     # the accounting tail's business, derived from the tallies below.
     pool = pool.replace(stage=jnp.where(moving, STAGE_FREE, pool.stage))
+    if ret_islot:
+        return pool, ib, total, total_prot, n_free, (islot, ok, mvp, pad)
     return pool, ib, total, total_prot, n_free
 
 
@@ -472,6 +521,38 @@ def _exchange_body(state: SimState, params, fused: bool = False) -> SimState:
         from . import megakernel as mk
         pool, ib, total, total_prot, n_free = mk.exchange_call(
             state.pool, state.inbox, h, params)
+    elif state.lineage is not None:
+        # Trace ids ride the IDENTICAL slot permutation the packed rows
+        # take: movers' pool_id entries scatter into inbox_id via the
+        # core's islot, moved rows clear, and each placed/overflowed
+        # mover gets an EXCHANGE/DELIVER-reason span.  Pure observation
+        # on side arrays -- pool/inbox bytes are untouched.
+        pool, ib, total, total_prot, n_free, (islot_l, ok_l, mvp_l,
+                                              pad_l) = _exchange_core(
+            state.pool, state.inbox, h, params, ret_islot=True)
+        ln = state.lineage
+        lpad = jnp.pad(ln.pool_id, (0, pad_l))
+        state = state.replace(lineage=ln.replace(
+            inbox_id=ln.inbox_id.at[islot_l].set(lpad, mode="drop"),
+            pool_id=jnp.where(moving, 0, ln.pool_id)))
+        now_p = jnp.broadcast_to(state.now, lpad.shape)
+        dst_p = jnp.pad(dst, (0, pad_l))
+        state = _lineage_append(state, ok_l, time_v=now_p, id_v=lpad,
+                                host_v=dst_p, stage=SPAN_EXCHANGE)
+        # Overflowed movers die here: shed pure ACKs vs counted drops
+        # (the two-class re-rank puts acks last exactly when drops
+        # exist, so a dropped pure ack under overflow IS a shed one).
+        if state.inbox.blk.shape[1] >= ICOLS:
+            from ..transport.tcp import pure_ack as _pure_ack_l
+            shed_l = jnp.pad(_pure_ack_l(
+                state.pool.blk[:, ICOL_PROTO], state.pool.blk[:, ICOL_FLAGS],
+                state.pool.blk[:, ICOL_LEN]), (0, pad_l)) & mvp_l
+        else:
+            shed_l = jnp.zeros_like(mvp_l)
+        state = _lineage_append(
+            state, mvp_l & ~ok_l, time_v=now_p, id_v=lpad, host_v=dst_p,
+            stage=SPAN_EXCHANGE,
+            reason_v=jnp.where(shed_l, LREASON_ACK_SHED, LREASON_POOL))
     else:
         pool, ib, total, total_prot, n_free = _exchange_core(
             state.pool, state.inbox, h, params)
@@ -600,6 +681,11 @@ def _exchange_body_mesh(state: SimState, params) -> SimState:
     trail = [dst_g[:, None]]
     if params.pds_trail:
         trail.append(pool.status[:, None])
+    if state.lineage is not None:
+        # Trace ids travel the collective as one extra trailer column,
+        # so they ride the exact permutation the packed rows take; the
+        # packed row width itself is untouched.
+        trail.append(state.lineage.pool_id[:, None])
     row = jnp.pad(jnp.concatenate([vals] + trail, axis=1),
                   ((0, pad), (0, 0)))                      # [npad, cs]
     cs = row.shape[1]
@@ -665,6 +751,32 @@ def _exchange_body_mesh(state: SimState, params) -> SimState:
                                        mode="drop")
         if params.pds_trail else ib.status,
     )
+
+    if state.lineage is not None:
+        # Receive side of the trailer column: splice arriving trace ids
+        # into this shard's inbox_id under the same islot, clear the ids
+        # of every local mover (they all left, placed or not), and write
+        # spans.  Hosts are GLOBAL dst ids and the time is the uniform
+        # window-open `now`, so the mesh span multiset matches the
+        # single-device exchange row for row.
+        ln = state.lineage
+        lin_col = ic + 1 + (1 if params.pds_trail else 0)
+        rlin = jnp.pad(rb[:, lin_col], (0, pad2))
+        state = state.replace(lineage=ln.replace(
+            inbox_id=ln.inbox_id.at[islot].set(rlin, mode="drop"),
+            pool_id=jnp.where(moving, 0, ln.pool_id)))
+        now_p = jnp.broadcast_to(state.now, rlin.shape)
+        rdst_p = jnp.pad(rdst_g, (0, pad2))
+        state = _lineage_append(state, ok, time_v=now_p, id_v=rlin,
+                                host_v=rdst_p, stage=SPAN_EXCHANGE)
+        if ic >= ICOLS:
+            shed_l = ackp
+        else:
+            shed_l = jnp.zeros_like(rvp)
+        state = _lineage_append(
+            state, rvp & ~ok, time_v=now_p, id_v=rlin, host_v=rdst_p,
+            stage=SPAN_EXCHANGE,
+            reason_v=jnp.where(shed_l, LREASON_ACK_SHED, LREASON_POOL))
 
     if state.tr is not None:
         # Local partials; pkts_exchanged / occ_max are finalized across
@@ -1139,6 +1251,22 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
         jnp.sum(tail_drop, axis=1),
         rx_queued=hosts.rx_queued + jnp.sum(due, axis=1, dtype=I32))
 
+    if state.lineage is not None and params.has_iface_buf:
+        # Interface-buffer tail drops end a traced packet's life at the
+        # router: one DELIVER/qdisc span, then the freed slot's id
+        # clears.
+        td_f = tail_drop.reshape(-1)
+        ln = state.lineage
+        state = _lineage_append(
+            state, td_f,
+            time_v=jnp.broadcast_to(tick_t[:, None], (h, ki)).reshape(-1),
+            id_v=ln.inbox_id,
+            host_v=jnp.broadcast_to(host_ids(state, I32)[:, None],
+                                    (h, ki)).reshape(-1),
+            stage=SPAN_DELIVER, reason_v=LREASON_QDISC)
+        state = state.replace(lineage=state.lineage.replace(
+            inbox_id=jnp.where(td_f, 0, state.lineage.inbox_id)))
+
     # -- delivery rounds -----------------------------------------------------
     # Round 0 delivers each host's earliest queued packet at tick_t, like
     # the reference's one-event-per-pop.  Apps that declare `rx_batch` > 1
@@ -1291,6 +1419,22 @@ def _rx_phase(state: SimState, params, em, tick_t, active, app,
             inbox=ib.replace(stage=st2.reshape(-1), status=status),
             hosts=hosts)
         ib = state.inbox
+
+        if state.lineage is not None:
+            # Every funded dequeue ends this hop: delivered (reason 0),
+            # CoDel/router-dropped (qdisc), or killed at a down host.
+            # Read the slot's id before the freed slot clears it.
+            ln = state.lineage
+            lid_h = jnp.where(have, ln.inbox_id[flat], 0)
+            reason_h = jnp.where(drop, LREASON_QDISC, 0)
+            if nm_kill is not None:
+                reason_h = jnp.where(nm_kill, LREASON_HOST_DOWN, reason_h)
+            state = _lineage_append(state, funded, time_v=t_eff,
+                                    id_v=lid_h, host_v=rows_g,
+                                    stage=SPAN_DELIVER, reason_v=reason_h)
+            freed = (oh & funded[:, None]).reshape(-1)
+            state = state.replace(lineage=state.lineage.replace(
+                inbox_id=jnp.where(freed, 0, state.lineage.inbox_id)))
 
         # Event log (traced away when disabled).  Records carry GLOBAL
         # host ids (rows_g == rows off-mesh).
@@ -1482,6 +1626,26 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     src2 = jnp.broadcast_to(host_ids(state, I32)[:, None], (h, e))
     ctr2 = ctr[:, None] + rank
 
+    if state.lineage is not None:
+        # Lineage sampling + trace-id assignment, functionally keyed by
+        # (src, per-src emission counter): any mesh shape samples -- and
+        # ids -- exactly the same packets.  The threshold rides as
+        # TRACED data (state.lineage.rate_x1p32), so one compiled graph
+        # serves every sample rate.  Ids are odd-ended 31-bit positives
+        # ((bits >> 1) | 1), so 0 stays the "untraced" sentinel;
+        # collisions are possible and harmless (docs/observability.md).
+        lkey = rng.purpose_key(params.seed_key, rng.PURPOSE_LINEAGE)
+        lc_lo = ctr2.astype(jnp.uint32)
+        lc_hi = (ctr2 >> 32).astype(jnp.uint32)
+        sampled = valid & (rng.keyed_bits(lkey, src2, lc_lo, lc_hi)
+                           <= state.lineage.rate_x1p32)
+        lid2 = jnp.where(sampled, ((rng.keyed_bits(lkey, lc_lo, lc_hi, src2)
+                                    >> jnp.uint32(1)) | jnp.uint32(1))
+                         .astype(I32), 0)
+    else:
+        sampled = None
+        lid2 = None
+
     # Routing: latency (+ per-packet jitter) + reliability, loopback
     # shortcut.  vs is the emitting host's own vertex -- a broadcast, not
     # a gather.  host_vertex stays replicated under the mesh (em.dst holds
@@ -1603,13 +1767,23 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
     )
     state = state.replace(pool=pool, hosts=hosts)
 
+    if state.lineage is not None:
+        # Trace ids enter the outbox side array under the SAME one-hot
+        # merge as the packed rows: every freshly claimed slot gets its
+        # emission's id (0 when untraced), untouched slots keep theirs.
+        ln = state.lineage
+        lv = jnp.sum(jnp.where(oh, lid2[:, :, None], 0), axis=1, dtype=I32)
+        state = state.replace(lineage=ln.replace(
+            pool_id=jnp.where(hit, lv,
+                              ln.pool_id.reshape(h, ko)).reshape(-1)))
+
     # --- loopback: straight into the sender's own inbox slab (row-local
     # allocation; the block write is an [H*E]-row scatter, traced away
     # when the app never loops back).
     lb_placed = jnp.zeros_like(lb)
     if _may_loopback(app):
         state, lb_placed = _loopback_insert(state, params, em, lb, src2,
-                                            ctr2, send_t)
+                                            ctr2, send_t, lin_ids=lid2)
 
     all_placed = placed | lb_placed
     overflow = jnp.any(live & ~all_placed & ~lb) | jnp.any(lb & ~lb_placed)
@@ -1640,6 +1814,36 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
         state = _log_append(state, all_placed.reshape(-1), LOG_SEND,
                             LOG_DEBUG, timef, hostf, dstf)
 
+    if state.lineage is not None:
+        # One EMIT span per sampled emission -- with the death reason
+        # when it never left the source (reliability draw, netem block,
+        # slab overflow) -- then the hop the survivors took: parked
+        # under the token bucket (STAGE), straight onto the wire (TX),
+        # or the loopback shortcut (LINK).
+        reason2 = jnp.where(dropped, LREASON_LOSS, 0)
+        if state.nm is not None:
+            br = netem_apply.block_reason(state.nm, src2, em.dst)
+            reason2 = jnp.where(dropped & (br > 0), br, reason2)
+        reason2 = jnp.where(live & ~all_placed, LREASON_POOL, reason2)
+        lhost = src2.reshape(-1)
+        ltime = send_t.reshape(-1)
+        lidf = lid2.reshape(-1)
+        state = _lineage_append(state, sampled.reshape(-1), time_v=ltime,
+                                id_v=lidf, host_v=lhost, stage=SPAN_EMIT,
+                                reason_v=reason2.reshape(-1))
+        state = _lineage_append(state, (parked & sampled).reshape(-1),
+                                time_v=ltime, id_v=lidf, host_v=lhost,
+                                stage=SPAN_STAGE)
+        state = _lineage_append(state, (admit & sampled).reshape(-1),
+                                time_v=ltime, id_v=lidf, host_v=lhost,
+                                stage=SPAN_TX)
+        state = _lineage_append(state, (lb_placed & sampled).reshape(-1),
+                                time_v=ltime, id_v=lidf, host_v=lhost,
+                                stage=SPAN_LINK)
+        state = state.replace(lineage=state.lineage.replace(
+            n_assigned=state.lineage.n_assigned
+            + jnp.sum(sampled).astype(I64)))
+
     # Packet capture (PCAP analog; only traced when a CaptureRing is
     # installed): record every placed emission at send time.
     if state.cap is not None:
@@ -1657,9 +1861,11 @@ def _stage_emissions(state: SimState, params, em: emit.Emissions, tick_t,
 
 
 def _loopback_insert(state: SimState, params, em, lb, src2, ctr2,
-                     send_t):
+                     send_t, lin_ids=None):
     """Insert loopback emissions into the sender's own inbox slab.
-    Arrival = send + 1ns (reference network_interface.c:548-555)."""
+    Arrival = send + 1ns (reference network_interface.c:548-555).
+    `lin_ids` [H,E] carries lineage trace ids into the claimed slots'
+    inbox_id rows (present exactly when the tracer is installed)."""
     ib = state.inbox
     h, e = lb.shape
     p1 = ib.capacity
@@ -1702,7 +1908,13 @@ def _loopback_insert(state: SimState, params, em, lb, src2, ctr2,
         status=ib.status.at[islot].set(pds, mode="drop")
         if params.pds_trail else ib.status,
     )
-    return state.replace(inbox=ib), ok
+    state = state.replace(inbox=ib)
+    if state.lineage is not None and lin_ids is not None:
+        ln = state.lineage
+        state = state.replace(lineage=ln.replace(
+            inbox_id=ln.inbox_id.at[islot].set(
+                jnp.where(ok, lin_ids, 0).reshape(-1), mode="drop")))
+    return state, ok
 
 
 def _select_tx_slab(pool, tick_t, active, h):
@@ -1815,7 +2027,15 @@ def _tx_drain_body(state: SimState, params, tick_t, active, bw_up,
         jnp.where(funded & (hosts.tx_queued > 0), tick_t,
                   jnp.asarray(INV, I64)))
     hosts = hosts.replace(t_resume=jnp.minimum(hosts.t_resume, t_res))
-    return state.replace(pool=pool, hosts=hosts)
+    state = state.replace(pool=pool, hosts=hosts)
+    if state.lineage is not None:
+        # A parked packet departing the NIC: the row stays in place
+        # (stage flip only), so the side array needs no move -- just the
+        # TX hop span at the drain instant.
+        state = _lineage_append(state, funded, time_v=tick_t,
+                                id_v=state.lineage.pool_id[slot],
+                                host_v=host_ids(state, I32), stage=SPAN_TX)
+    return state
 
 
 # ---------------------------------------------------------------------------

@@ -76,12 +76,14 @@ _PARAMS_REP = ("route_blk", "host_vertex", "min_latency_ns", "seed_key",
 
 def enabled(state: SimState, params, app) -> bool:
     """Trace-time static: does this world take the fused path?  The
-    log/capture rings append at global cursors (cross-row state the
-    kernels do not carry), so observability-instrumented worlds fall
-    back to the reference graph -- they are debug runs by definition."""
+    log/capture rings and the lineage span ring append at global cursors
+    (cross-row state the kernels do not carry), so observability-
+    instrumented worlds fall back to the reference graph -- they are
+    debug runs by definition (docs/megakernel.md, follow-ups)."""
     if not getattr(params, "megakernel", False):
         return False
-    return state.log is None and state.cap is None
+    return state.log is None and state.cap is None \
+        and state.lineage is None
 
 
 def _interpret() -> bool:

@@ -29,6 +29,7 @@ PURPOSE_ATTACH = 3
 PURPOSE_JITTER = 4
 PURPOSE_SCHED = 5
 PURPOSE_CHAOS = 6   # netem churn process draws (netem/timeline.py)
+PURPOSE_LINEAGE = 7  # packet-lineage sampling + trace-id assignment
 
 
 def root_key(seed: int) -> jax.Array:

@@ -978,6 +978,146 @@ def make_flowscope(flow_capacity: int = 1 << 16,
 
 
 # ---------------------------------------------------------------------------
+# Packet lineage (sampled per-packet span tracing; trace.LineageDrain)
+# ---------------------------------------------------------------------------
+
+# Span stage enum: where in a packet's life a LineageBlock span row was
+# written.  A traced packet's life story is the time-ordered chain of its
+# span rows (tools/parse.py spans).
+SPAN_EMIT = 0      # emission staged at the source (reason set if it died there)
+SPAN_STAGE = 1     # parked TX_QUEUED under the uplink token bucket
+SPAN_TX = 2        # departed the NIC onto the wire (direct admit or _tx_drain)
+SPAN_LINK = 3      # same-host loopback wire hop (bypasses the exchange)
+SPAN_EXCHANGE = 4  # moved outbox -> inbox at a window-boundary exchange
+SPAN_DELIVER = 5   # delivery attempt at the destination NIC/transport
+
+SPAN_STAGE_NAMES = {
+    SPAN_EMIT: "emit",
+    SPAN_STAGE: "stage",
+    SPAN_TX: "tx",
+    SPAN_LINK: "link",
+    SPAN_EXCHANGE: "exchange",
+    SPAN_DELIVER: "deliver",
+}
+
+# Drop-reason enum (span rows; 0 = the hop succeeded).  A nonzero reason
+# marks the hop where the packet left the simulation.
+LREASON_NONE = 0
+LREASON_QDISC = 1      # router/CoDel drop or interface-buffer tail drop
+LREASON_LOSS = 2       # reliability draw (baseline wire loss or netem loss)
+LREASON_LINK_DOWN = 3  # netem: the src<->dst link is down
+LREASON_PARTITION = 4  # netem: endpoints on opposite partition sides
+LREASON_HOST_DOWN = 5  # netem: an endpoint host is down
+LREASON_ACK_SHED = 6   # pure ACK shed at an overflowing boundary exchange
+LREASON_TTL = 7        # reserved: hop-limit expiry (engine has no TTL yet)
+LREASON_POOL = 8       # slab-capacity overflow (staging or exchange)
+
+LREASON_NAMES = {
+    LREASON_NONE: "none",
+    LREASON_QDISC: "qdisc_overflow",
+    LREASON_LOSS: "loss",
+    LREASON_LINK_DOWN: "link_down",
+    LREASON_PARTITION: "partition",
+    LREASON_HOST_DOWN: "host_down",
+    LREASON_ACK_SHED: "ack_shed",
+    LREASON_TTL: "ttl",
+    LREASON_POOL: "pool_overflow",
+}
+
+
+@struct.dataclass
+class LineageBlock:
+    """Sampled per-packet span tracer -- request tracing for packets.
+    Present in SimState only when installed (trace.ensure_lineage), so
+    lineage-less runs trace byte-identical graphs: the same
+    present-or-None contract as cap/log/tr/fr/scope/nm.
+
+    A seeded, deterministic sample of emissions is assigned a nonzero
+    i32 trace id at staging (PURPOSE_LINEAGE-keyed on (src, send_ctr),
+    core/rng.py), so single-device and mesh runs of the same world
+    sample -- and id -- exactly the same packets.  `rate_x1p32` is the
+    sample threshold in uint32 space (sample iff keyed bits <= it) and
+    rides as TRACED data, so one compiled graph serves every rate.
+
+    The id travels in `pool_id`/`inbox_id`: side arrays shaped like the
+    outbox/inbox row axes, moved under the exact permutations the
+    engine applies to the packed blocks (staging one-hot merge, the
+    exchange scatter / all_to_all trailer column, delivery slot free)
+    -- the packed 18/28-column widths are untouched.
+
+    Every hop appends one span row (sim time, GLOBAL host id, SPAN_*
+    stage, LREASON_* drop reason) into the span ring.  Under a mesh the
+    ring partitions into per-shard segments with [D] cursors (the
+    cap/log layout); trace.LineageDrain merges segments in sim-time
+    order into spans.jsonl.  Lifetime counters (`n_assigned`, `total`,
+    `lost`) survive ring wrap.
+
+    The block only ever observes: installing it never perturbs the
+    trajectory (bitwise-neutral, tests/test_lineage.py)."""
+
+    rate_x1p32: jnp.ndarray  # u32 scalar: sample threshold (traced)
+    n_assigned: jnp.ndarray  # i64 scalar: lifetime sampled emissions
+
+    pool_id: jnp.ndarray     # [P0] i32 trace id of each outbox row (0=none)
+    inbox_id: jnp.ndarray    # [P1] i32 trace id of each inbox row (0=none)
+
+    s_time: jnp.ndarray      # [C] i64 sim time of the hop
+    s_id: jnp.ndarray        # [C] i32 trace id (always nonzero)
+    s_host: jnp.ndarray      # [C] i32 GLOBAL host id where the hop happened
+    s_stage: jnp.ndarray     # [C] i32 SPAN_* stage enum
+    s_reason: jnp.ndarray    # [C] i32 LREASON_* drop reason (0 = alive)
+    total: jnp.ndarray       # i64 scalar | [D]: lifetime span rows appended
+    lost: jnp.ndarray        # i64 scalar | [D]: rows dropped (batch > ring)
+
+    @property
+    def capacity(self) -> int:
+        return self.s_time.shape[0]
+
+    @property
+    def n_shards(self) -> int:
+        return 1 if self.total.ndim == 0 else self.total.shape[0]
+
+
+def lineage_rate_bits(rate: float) -> int:
+    """Sample-rate fraction -> uint32 threshold (sample iff
+    keyed_bits <= threshold).  rate >= 1.0 traces every packet."""
+    r = float(rate)
+    if not (0.0 < r <= 1.0):
+        raise ValueError(f"lineage sample rate must be in (0, 1], got {r}")
+    if r >= 1.0:
+        return 0xFFFFFFFF
+    return max(0, min(int(round(r * 4294967296.0)) - 1, 0xFFFFFFFF))
+
+
+def make_lineage(pool_rows: int, inbox_rows: int, rate: float = 0.01,
+                 capacity: int = 1 << 16, shards: int = 1) -> LineageBlock:
+    """Build the tracer block for a world whose outbox/inbox row axes are
+    `pool_rows`/`inbox_rows` (install AFTER mesh/bucket padding, so the
+    side arrays match the padded pools).  shards > 1 builds the MESH
+    layout (cap/log pattern): the span ring grows to a multiple of
+    `shards` and partitions into per-shard segments, cursors become
+    [shards] vectors so each shard appends into its own segment."""
+    capacity = -(-max(int(capacity), shards) // shards) * shards
+
+    def _cursor():
+        return jnp.asarray(0, I64) if shards == 1 else _zeros((shards,), I64)
+
+    return LineageBlock(
+        rate_x1p32=jnp.asarray(lineage_rate_bits(rate), U32),
+        n_assigned=jnp.asarray(0, I64),
+        pool_id=_zeros((pool_rows,), I32),
+        inbox_id=_zeros((inbox_rows,), I32),
+        s_time=_zeros((capacity,), I64),
+        s_id=_zeros((capacity,), I32),
+        s_host=_zeros((capacity,), I32),
+        s_stage=_zeros((capacity,), I32),
+        s_reason=_zeros((capacity,), I32),
+        total=_cursor(),
+        lost=_cursor(),
+    )
+
+
+# ---------------------------------------------------------------------------
 # Invariant sentinel (per-window health checks; trace.SentinelDrain)
 # ---------------------------------------------------------------------------
 
@@ -1127,6 +1267,11 @@ class SimState:
     # Replicated (never sharded) under a mesh -- every shard computes
     # identical scalars from psum/pmin/pmax-reduced inputs.
     sentinel: any = struct.field(pytree_node=True, default=None)  # SentinelBlock | None
+    # Sampled per-packet span tracer (trace.ensure_lineage): present only
+    # when installed, so untraced runs trace byte-identical graphs.
+    # Sharded under a mesh (per-shard span-ring segments + cursor slices,
+    # the cap/log layout); pool_id/inbox_id shard with their pools.
+    lineage: any = struct.field(pytree_node=True, default=None)  # LineageBlock | None
     # Telemetry (reference scheduler built-in timers, scheduler.c:266-268):
     n_steps: jnp.ndarray = struct.field(default=None)    # i64 micro-steps
     n_windows: jnp.ndarray = struct.field(default=None)  # i64 windows run

@@ -140,6 +140,25 @@ def route_overlay(nm: NetemBlock, src, dst, lat, rel):
     return lat, rel
 
 
+def block_reason(nm: NetemBlock, src, dst):
+    """i32 [..] lineage drop-reason code for src->dst pairs the overlay
+    blocks (core.state.LREASON_*): host_down > link_down > partition in
+    priority, 0 where the pair is routable.  Pure observer for the
+    packet-lineage tracer -- the kill itself stays on route_overlay's
+    rel=0 path, so installing lineage never perturbs the trajectory."""
+    from ..core.state import (LREASON_HOST_DOWN, LREASON_LINK_DOWN,
+                              LREASON_PARTITION)
+    h = nm.host_up.shape[0]
+    dstc = jnp.clip(dst, 0, h - 1)
+    _, _, link_down = _pair_overrides(nm, src, dstc)
+    host_down = (nm.host_up[src] <= 0) | (nm.host_up[dstc] <= 0)
+    reason = jnp.zeros(jnp.broadcast_shapes(src.shape, dstc.shape), I32)
+    reason = jnp.where(_partitioned(nm, src, dstc), LREASON_PARTITION, reason)
+    reason = jnp.where(link_down, LREASON_LINK_DOWN, reason)
+    reason = jnp.where(host_down, LREASON_HOST_DOWN, reason)
+    return reason
+
+
 def alive(nm: NetemBlock):
     """[H] bool: hosts currently up (delivery gate)."""
     return nm.host_up > 0

@@ -73,15 +73,19 @@ def _state_specs(state):
             # values on every shard from psum/pmin/pmax-reduced inputs
             # (engine._fr_record / engine._sentinel_check).
             return P()
-        if name in ("log", "cap", "scope"):
+        if name in ("log", "cap", "scope", "lineage"):
             # Sharded observability rings (make_log_ring/make_capture_ring
-            # /make_flowscope with shards=D): slot arrays partition into
-            # per-shard segments and the [D] cursors into per-shard
-            # scalars, so each shard appends independently;
-            # observe.LogDrain / write_pcap / trace.ScopeDrain merge the
-            # segments in sim-time order.  The flowscope cadence scalars
-            # (interval/next_due/samples) are 0-d and replicate, keeping
-            # the sample cond collective-safe.
+            # /make_flowscope/make_lineage with shards=D): slot arrays
+            # partition into per-shard segments and the [D] cursors into
+            # per-shard scalars, so each shard appends independently;
+            # observe.LogDrain / write_pcap / trace.ScopeDrain /
+            # trace.LineageDrain merge the segments in sim-time order.
+            # The cadence/config scalars (flowscope interval/next_due/
+            # samples, lineage rate_x1p32/n_assigned) are 0-d and
+            # replicate, keeping every cond collective-safe.  The
+            # lineage pool_id/inbox_id side arrays are [P0]/[P1]-leading
+            # and shard with their pools via the host_rows rule below --
+            # this branch's ndim>=1 test covers them identically.
             if hasattr(leaf, "ndim") and leaf.ndim >= 1:
                 return P(HOST_AXIS)
             return P()
@@ -115,6 +119,7 @@ def _build(app, mesh, sspecs, pspecs):
         n_ev0 = st.n_events
         tr0 = st.tr
         killed0 = None if st.nm is None else st.nm.killed
+        ln0 = None if st.lineage is None else st.lineage.n_assigned
 
         st = engine.run_until_impl(st, params, app, t_target)
 
@@ -142,6 +147,10 @@ def _build(app, mesh, sspecs, pspecs):
                 pkts_exchanged=tr0.pkts_exchanged + jax.lax.psum(
                     st.tr.pkts_exchanged - tr0.pkts_exchanged, HOST_AXIS),
                 occ_max=jax.lax.pmax(st.tr.occ_max, HOST_AXIS)))
+        if ln0 is not None:
+            st = st.replace(lineage=st.lineage.replace(
+                n_assigned=ln0 + jax.lax.psum(
+                    st.lineage.n_assigned - ln0, HOST_AXIS)))
         return st.replace(hoff=None)
 
     return jax.jit(shard_map(
@@ -191,6 +200,12 @@ def mesh_run_until(state, params, app, t_target, mesh=None):
             f"{state.scope.n_shards} shard(s) but the mesh has {d} "
             f"devices; install it with trace.ensure_flowscope(state, "
             f"shards={d}) so every shard gets its own ring segment")
+    if state.lineage is not None and state.lineage.n_shards != d:
+        raise ValueError(
+            f"mesh_run_until: lineage tracer built for "
+            f"{state.lineage.n_shards} shard(s) but the mesh has {d} "
+            f"devices; install it with trace.ensure_lineage(state, "
+            f"shards={d}) so every shard gets its own span-ring segment")
     h = state.hosts.num_hosts
     hp = params.host_vertex.shape[0]
     if hp != h:

@@ -21,11 +21,12 @@ existing chunk-boundary sync) is a *resume point for the debugger*:
      Divergence is a loud trace.ReplayDivergence naming the first
      differing window -- never silent garbage.
   3. On-demand instrumentation -- the replayed span can carry blocks
-     the original run did not pay for (--scope, --log, --pcap,
-     --profile): installed AFTER the checkpoint loads, they are
-     trajectory-neutral (observability never feeds back into the
-     simulation), so the replay still verifies bitwise while producing
-     the flow samples / event log / capture the original never wrote.
+     the original run did not pay for (--scope, --trace-packets,
+     --log, --pcap, --profile): installed AFTER the checkpoint loads,
+     they are trajectory-neutral (observability never feeds back into
+     the simulation), so the replay still verifies bitwise while
+     producing the flow samples / packet spans / event log / capture
+     the original never wrote.
 
 Determinism fine print: window boundaries clip at launch targets
 (core/engine.py run_until_impl ends each launch at exactly t_target),
@@ -41,8 +42,9 @@ record the shard layout and padding in the manifest (checkpoint.py).
 The template is ALWAYS rebuilt at the original device count (padding
 and per-shard ring segmentation are baked into the saved arrays);
 `replay --devices` only picks the *execution* -- the original mesh, or
-a single-device gather, which refuses when per-shard cap/log/scope
-ring segments are present (those only run under their mesh) but is
+a single-device gather, which refuses when per-shard
+cap/log/scope/lineage ring segments are present (those only run under
+their mesh) but is
 always legal for the flight recorder (its shard matrices are computed
 from host ids off-mesh, bitwise identical; core/state.py).
 
@@ -289,7 +291,7 @@ def _rebuild_builder(info: dict, want_mesh: bool = True):
     """A programmatic world: re-call sim.build_<name>(**kwargs) and
     re-apply the instrumentation the checkpointed run carried, in the
     same order sim.run's checkpoint path installs it (bucket pad, mesh
-    pad, scope, counters, flight recorder)."""
+    pad, scope, lineage, counters, flight recorder)."""
     from . import sim, trace
     world = info["world"]
     name = world.get("name")
@@ -319,9 +321,17 @@ def _rebuild_builder(info: dict, want_mesh: bool = True):
     if info.get("scope"):
         state = trace.ensure_flowscope(
             state, shards=n, **trace.parse_scope_spec(info["scope"]))
+    if info.get("lineage"):
+        state = trace.ensure_lineage(
+            state, rate=trace.parse_lineage_rate(info["lineage"]),
+            shards=n)
     if info.get("profile"):
         state = trace.ensure_counters(state)
-    state = trace.ensure_flight_recorder(state, shards=n)
+    # Honor the recorded ring size (--flight-rows): the restored
+    # checkpoint carries a ring of that capacity, and a mismatched
+    # template would refuse to load it.
+    state = trace.ensure_flight_recorder(state, shards=n,
+                                         rows=info.get("flight_rows"))
     if info.get("sentinel") or info.get("supervise"):
         state = trace.ensure_sentinel(state)
     h_real = int(info.get("hosts_real") or int(state.hosts.num_hosts))
@@ -335,17 +345,32 @@ def _ring_shards(total) -> int:
 
 
 def _reset_instrumentation(state):
-    """Zero the cap/log/scope rings of a freshly loaded checkpoint so
-    replay drains emit only rows the replayed span itself produces, not
-    stale records the original run left in the saved rings.  Ring
-    contents never feed back into the simulation (observability is
-    trajectory-neutral by design), so this cannot perturb the replay;
-    the flowscope keeps its interval/next_due so sampling stays on the
-    original cadence phase.  The flight recorder is NOT reset -- its
-    cursor is the global window index FlightDrain(start=K0) needs."""
+    """Zero the cap/log/scope/lineage rings of a freshly loaded
+    checkpoint so replay drains emit only rows the replayed span itself
+    produces, not stale records the original run left in the saved
+    rings.  Ring contents never feed back into the simulation
+    (observability is trajectory-neutral by design), so this cannot
+    perturb the replay; the flowscope keeps its interval/next_due so
+    sampling stays on the original cadence phase, and the lineage
+    tracer keeps its rate, its lifetime n_assigned counter, and the
+    pool/inbox side arrays -- packets in flight at the checkpoint carry
+    their trace IDs into the replayed span, exactly as they did in the
+    original run.  The flight recorder is NOT reset -- its cursor is
+    the global window index FlightDrain(start=K0) needs."""
     from .core.state import (make_capture_ring, make_flowscope,
                              make_log_ring)
     reps = {}
+    if state.lineage is not None:
+        ln = state.lineage
+        import jax.numpy as _jnp
+        reps["lineage"] = ln.replace(
+            s_time=_jnp.zeros_like(ln.s_time),
+            s_id=_jnp.zeros_like(ln.s_id),
+            s_host=_jnp.zeros_like(ln.s_host),
+            s_stage=_jnp.zeros_like(ln.s_stage),
+            s_reason=_jnp.zeros_like(ln.s_reason),
+            total=_jnp.zeros_like(ln.total),
+            lost=_jnp.zeros_like(ln.lost))
     if state.cap is not None:
         reps["cap"] = make_capture_ring(
             state.cap.capacity, shards=_ring_shards(state.cap.total))
@@ -370,6 +395,7 @@ _LOG_LVL = {None: 0, "off": 0, "warning": 1, "debug": 2}
 def replay(data_dir: str, *, window: int | None = None,
            time_s: float | None = None, out_dir: str | None = None,
            devices: int | None = None, scope: str | None = None,
+           lineage: str | None = None,
            log_level: str = "off", pcap: bool = False,
            pcap_ring: int = 1 << 17, log_ring: int = 0,
            profile: bool = False, progress: bool = False,
@@ -383,8 +409,10 @@ def replay(data_dir: str, *, window: int | None = None,
     every replayed flight-recorder row against the original
     windows.jsonl, raising trace.ReplayDivergence at the first bitwise
     mismatch.  Instrumentation the original run lacked (`scope`,
-    `log_level`, `pcap`, `profile`) is installed AFTER the checkpoint
-    loads; outputs land in `out_dir` (default `<data_dir>/replay`).
+    `lineage` -- a --trace-packets rate spec, sampling the SAME seeded
+    packet set the original run would have traced -- `log_level`,
+    `pcap`, `profile`) is installed AFTER the checkpoint loads;
+    outputs land in `out_dir` (default `<data_dir>/replay`).
     Returns a summary dict."""
     import jax
 
@@ -409,9 +437,29 @@ def replay(data_dir: str, *, window: int | None = None,
         window = max(cands)
     window = int(window)
     if window not in by_w:
+        # Name the replayable span: checkpoint anchors from ckpt/
+        # index.json bound where a replay can START, recorded windows
+        # bound what it can verify AGAINST.  The CLI maps this to rc 2.
+        span = f"{min(by_w)}..{max(by_w)}"
+        anchors = ""
+        idx = os.path.join(data_dir, "ckpt", "index.json")
+        try:
+            with open(idx) as f:
+                cks = json.load(f)["checkpoints"]
+            if cks:
+                anchors = (f"; checkpoint anchors in index.json cover "
+                           f"windows {min(int(e['window']) for e in cks)}"
+                           f"..{max(int(e['window']) for e in cks)}")
+        except (OSError, ValueError, KeyError):
+            pass
+        if window > max(by_w) or window < min(by_w):
+            raise ValueError(
+                f"--window {window} is outside the recorded range: "
+                f"windows.jsonl holds windows {span}{anchors} -- pick a "
+                f"window inside the recorded span")
         raise ValueError(
             f"window {window} is not in the recorded windows.jsonl "
-            f"(recorded span: {min(by_w)}..{max(by_w)}; rows older than "
+            f"(recorded span: {span}{anchors}; rows older than "
             f"the ring capacity wrap away between drains -- checkpoint "
             f"more often to keep the record gap-free)")
 
@@ -443,7 +491,7 @@ def replay(data_dir: str, *, window: int | None = None,
             f"{ckpt_path}: manifest t_ns {t0} does not match the saved "
             f"state's clock {int(state.now)} (corrupt checkpoint?)")
     if exec_dev == 1 and n_dev_orig > 1:
-        for blk_name in ("cap", "log", "scope"):
+        for blk_name in ("cap", "log", "scope", "lineage"):
             blk = getattr(state, blk_name)
             if blk is not None and _ring_shards(
                     blk.total if blk_name != "scope"
@@ -467,6 +515,10 @@ def replay(data_dir: str, *, window: int | None = None,
     if scope and state.scope is None:
         state = trace_mod.ensure_flowscope(
             state, shards=exec_dev, **trace_mod.parse_scope_spec(scope))
+    if lineage and state.lineage is None:
+        state = trace_mod.ensure_lineage(
+            state, rate=trace_mod.parse_lineage_rate(lineage),
+            shards=exec_dev)
     lvl = _LOG_LVL.get(log_level, 0) if isinstance(log_level, str) \
         else int(log_level)
     if lvl and state.log is None:
@@ -508,6 +560,10 @@ def replay(data_dir: str, *, window: int | None = None,
             links_path=os.path.join(out, "links.jsonl")
             if sc.sample_links else None,
             real_hosts=h_real)
+    lineage_drain = None
+    if state.lineage is not None:
+        lineage_drain = trace_mod.LineageDrain(
+            os.path.join(out, "spans.jsonl"))
 
     hb_ns = info.get("hb_ns")
     every_ns = info.get("every_ns")
@@ -538,6 +594,8 @@ def replay(data_dir: str, *, window: int | None = None,
             flight.drain(state, profiler)
             if scope_drain is not None:
                 scope_drain.drain(state, profiler)
+            if lineage_drain is not None:
+                lineage_drain.drain(state, profiler)
             if prog is not None:
                 prog.update(state, t)
         if prog is not None:
@@ -587,6 +645,13 @@ def replay(data_dir: str, *, window: int | None = None,
         scope_drain.drain(state, profiler)
         scope_drain.close()
         summary["net"] = scope_drain.summary()
+    if lineage_drain is not None:
+        lineage_drain.drain(state, profiler)
+        lineage_drain.close()
+        summary["lineage"] = lineage_drain.summary()
+        if profiler is not None:
+            profiler.set_lineage(lineage_drain.rows,
+                                 lineage_drain.summary())
     if profiler is not None:
         trace_mod.fetch_counters(state, profiler)
         profiler.set_flight(flight.rows,

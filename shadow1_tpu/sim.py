@@ -279,7 +279,7 @@ def add_churn(state, params, rate_per_s: float,
 
 
 def run(state, params, app, until=None, profiler=None, devices=None,
-        bucket=False, scope=None, checkpoint_every=None,
+        bucket=False, scope=None, lineage=None, checkpoint_every=None,
         checkpoint_dir=None, checkpoint_world=None, supervise=None):
     """Run to `until` (default: params.stop_time).
 
@@ -311,6 +311,18 @@ def run(state, params, app, until=None, profiler=None, devices=None,
     sampled trajectory is bitwise-identical to an unsampled one; read
     the rings back with trace.ScopeDrain.  Installed after all padding,
     sharded to match `devices`.
+
+    With `lineage` (a sampling-rate spec: ``"0.01"``, ``"1%"``, a
+    float, or ``"all"``; same syntax as the CLI --trace-packets flag)
+    a packet-lineage tracer rides the state: a seeded, deterministic
+    sample of packets gets i32 trace IDs at emission and appends one
+    span row per hop (emit/stage/tx/link/exchange/deliver, with a
+    drop-reason code where the packet died) into a device-side ring
+    (docs/observability.md "Packet lineage").  The traced trajectory
+    is bitwise-identical to an untraced one; read the spans back with
+    trace.LineageDrain.  Installed after all padding, sharded to
+    match `devices`.  Under checkpointing the spans drain to
+    `checkpoint_dir`/spans.jsonl automatically.
 
     With `checkpoint_every` (a sim-time cadence in ns) the run becomes
     replayable (replay.py, docs/observability.md "Time-travel replay"):
@@ -350,7 +362,7 @@ def run(state, params, app, until=None, profiler=None, devices=None,
                 "(where ckpt/ and windows.jsonl land)")
         return _run_checkpointed(
             state, params, app, int(t), profiler=profiler,
-            devices=devices, bucket=bucket, scope=scope,
+            devices=devices, bucket=bucket, scope=scope, lineage=lineage,
             every_ns=int(checkpoint_every), ckdir=checkpoint_dir,
             world=checkpoint_world, hosts_real=h_real,
             supervise=supervise)
@@ -365,6 +377,13 @@ def run(state, params, app, until=None, profiler=None, devices=None,
         from . import trace
         return trace.ensure_flowscope(st, shards=shards,
                                       **trace.parse_scope_spec(scope))
+
+    def _install_lineage(st, shards):
+        if lineage is None or st.lineage is not None:
+            return st
+        from . import trace
+        return trace.ensure_lineage(
+            st, rate=trace.parse_lineage_rate(lineage), shards=shards)
     if devices is not None and int(devices) > 1:
         import jax as _jax
 
@@ -377,6 +396,7 @@ def run(state, params, app, until=None, profiler=None, devices=None,
         mesh = parallel.make_mesh(devs[:n])
         state, params = parallel.pad_world_to_mesh(state, params, n)
         state = _install_scope(state, n)
+        state = _install_lineage(state, n)
         if profiler is None:
             return parallel.mesh_run_chunked(state, params, app, int(t),
                                              mesh=mesh)
@@ -391,6 +411,7 @@ def run(state, params, app, until=None, profiler=None, devices=None,
         finally:
             trace.install(None)
     state = _install_scope(state, 1)
+    state = _install_lineage(state, 1)
     if profiler is None:
         return engine.run_until(state, params, app, t)
     from . import trace
@@ -406,7 +427,7 @@ def run(state, params, app, until=None, profiler=None, devices=None,
 
 def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
                       scope, every_ns, ckdir, world, hosts_real,
-                      supervise=None):
+                      lineage=None, supervise=None):
     """run()'s checkpointing path: same block installs as the plain
     paths (mesh pad, then scope/counters -- replay._rebuild_builder
     mirrors this order exactly), plus a flight recorder, a windows.jsonl
@@ -432,6 +453,9 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
     if scope is not None and state.scope is None:
         state = trace.ensure_flowscope(state, shards=n,
                                        **trace.parse_scope_spec(scope))
+    if lineage is not None and state.lineage is None:
+        state = trace.ensure_lineage(
+            state, rate=trace.parse_lineage_rate(lineage), shards=n)
     if profiler is not None:
         trace.install(profiler)
         state = trace.ensure_counters(state)
@@ -441,6 +465,9 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
 
     os.makedirs(ckdir, exist_ok=True)
     flight = trace.FlightDrain(os.path.join(ckdir, "windows.jsonl"))
+    spans = None
+    if state.lineage is not None:
+        spans = trace.LineageDrain(os.path.join(ckdir, "spans.jsonl"))
     ck = replay_mod.Checkpointer(ckdir, every_ns, devices=n,
                                  bucket=bucket, hosts_real=hosts_real)
     if world is not None and not isinstance(world, dict):
@@ -453,6 +480,8 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
         "chunk_ns": engine.CHUNK_NS, "devices": n,
         "bucket": bool(bucket), "hosts_real": int(hosts_real),
         "scope": scope, "profile": profiler is not None,
+        "flight_rows": int(state.fr.steps.shape[0]),
+        "lineage": (str(lineage) if lineage is not None else None),
         "sentinel": bool(supervise), "supervise": bool(supervise)})
     sup = None
     if supervise:
@@ -477,10 +506,16 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
             if profiler is not None:
                 trace.fetch_counters(state, profiler)
             flight.drain(state, profiler)
+            if spans is not None:
+                spans.drain(state, profiler)
             ck.maybe(state, params, tt)
         return state
     finally:
         flight.close()
+        if spans is not None:
+            spans.close()
+            if profiler is not None:
+                profiler.set_lineage(spans.rows, spans.summary())
         if profiler is not None:
             trace.install(None)
 

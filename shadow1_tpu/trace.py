@@ -169,6 +169,8 @@ class Profiler:
         self.scope_flow_rows = []   # drained FlowScope flow rows
         self.scope_link_rows = []   # drained FlowScope link rows
         self.scope_summary = None   # aggregate `net` section|None
+        self.lineage_rows = []      # drained LineageDrain span rows
+        self.lineage_summary = None  # aggregate `lineage` section|None
 
     # -- recording hooks ----------------------------------------------------
 
@@ -216,6 +218,16 @@ class Profiler:
         self.scope_flow_rows = list(flow_rows)
         self.scope_link_rows = list(link_rows)
         self.scope_summary = summary
+
+    def set_lineage(self, rows: list, summary: dict | None):
+        """Attach drained packet-lineage spans (LineageDrain.rows) +
+        their aggregate.  The aggregate becomes the `lineage` section of
+        metrics(); the rows become a per-packet waterfall track (pid 3)
+        in trace_events() -- each traced packet renders as one span from
+        its first hop to its last, alongside wall time (pid 1) and sim
+        time (pid 2)."""
+        self.lineage_rows = list(rows)
+        self.lineage_summary = summary
 
     def set_metric(self, name: str, value):
         """Attach one named scalar metric (e.g. a measured phase cost
@@ -279,6 +291,8 @@ class Profiler:
             out["mesh"] = self.flight_summary
         if self.scope_summary is not None:
             out["net"] = self.scope_summary
+        if self.lineage_summary is not None:
+            out["lineage"] = self.lineage_summary
         out.update(self.extra_metrics)
         return out
 
@@ -364,6 +378,34 @@ class Profiler:
                 evs.append({"name": "link_drops", "cat": "net", "ph": "C",
                             "pid": 2, "ts": ts,
                             "args": {"link_drops": agg[t][1]}})
+        if self.lineage_rows:
+            # Packet-lineage waterfall on the sim-time clock (pid 3):
+            # one span per traced packet from its first hop to its last,
+            # the hop chain + death reason in args.  Bounded to the
+            # first _LINEAGE_TRACK_IDS packets by first-hop time so a
+            # high-rate trace cannot bloat trace.json.
+            meta.append({"name": "process_name", "ph": "M", "pid": 3,
+                         "args": {"name": "packet lineage (spans)"}})
+            by_id = {}
+            for r in self.lineage_rows:
+                by_id.setdefault(r["id"], []).append(r)
+            order = sorted(by_id, key=lambda i: by_id[i][0]["t"])
+            if len(order) > _LINEAGE_TRACK_IDS:
+                order = order[:_LINEAGE_TRACK_IDS]
+            for n, pid_ in enumerate(order):
+                hops = by_id[pid_]
+                t0, t1 = hops[0]["t"], hops[-1]["t"]
+                reason = next((h["reason"] for h in hops
+                               if h["reason"] != "none"), "none")
+                row_tid = (n % 64) + 1
+                evs.append({"name": f"pkt {pid_:08x}", "cat": "lineage",
+                            "ph": "X", "pid": 3, "tid": row_tid,
+                            "ts": round(t0 / 1e3, 3),
+                            "dur": round(max(t1 - t0, 1) / 1e3, 3),
+                            "args": {"id": pid_,
+                                     "chain": "->".join(h["stage"]
+                                                        for h in hops),
+                                     "reason": reason}})
         return meta + evs
 
     def write_trace(self, path: str):
@@ -413,7 +455,12 @@ def _pct(sorted_vals, q):
 # wall overlap with `device_step` spans is the host_drain_overlap_pct
 # metric (the async-window-pipeline yardstick in ROADMAP.md).
 _HOST_DRAIN_PHASES = frozenset(
-    ("heartbeat", "log_drain", "flight_drain", "scope_drain", "progress"))
+    ("heartbeat", "log_drain", "flight_drain", "scope_drain",
+     "lineage_drain", "progress"))
+
+# Most traced packets rendered as pid-3 waterfall spans in trace.json
+# (ordered by first hop); the full span set always lands in spans.jsonl.
+_LINEAGE_TRACK_IDS = 256
 
 
 def _union(intervals):
@@ -493,12 +540,16 @@ def fetch_counters(state, profiler=None) -> dict:
 # ---------------------------------------------------------------------------
 
 
-def ensure_flight_recorder(state, capacity: int = 4096, shards: int = 1):
+def ensure_flight_recorder(state, capacity: int = 4096, shards: int = 1,
+                           rows: int | None = None):
     """Return `state` with a per-window FlightRecorder ring installed
     (idempotent).  `shards` sizes the src->dst exchange matrices and
     must match the device count of a mesh run (1 for single-device);
     the host count and pool capacity must divide it so the logical
-    shard of a host is well defined.
+    shard of a host is well defined.  `rows` (the `--flight-rows` CLI
+    surface) overrides `capacity`: long runs whose drain/checkpoint
+    cadence exceeds 4096 windows size the ring up instead of losing
+    per-window resolution to wrap (the FlightDrain caveat).
 
     The ring cursor (`fr.total`) seeds from `state.n_windows`, so the
     row index FlightDrain stamps into windows.jsonl is the GLOBAL
@@ -509,6 +560,12 @@ def ensure_flight_recorder(state, capacity: int = 4096, shards: int = 1):
         return state
     import jax.numpy as _jnp
     from .core.state import I64, make_flight_recorder
+    if rows is not None:
+        capacity = int(rows)
+    if capacity < 1:
+        raise ValueError(
+            f"ensure_flight_recorder: ring capacity must be positive, "
+            f"got {capacity}")
     h = int(state.hosts.num_hosts)
     if shards < 1 or h % shards or int(state.pool.capacity) % shards:
         raise ValueError(
@@ -1051,4 +1108,186 @@ class ScopeDrain:
                 "bytes_forwarded": sum(r["tx"] for r in fin_l.values()),
                 "drops": sum(r["drops"] for r in fin_l.values()),
             }
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Packet lineage (sampled per-packet span tracing; docs/observability.md)
+# ---------------------------------------------------------------------------
+
+
+def parse_lineage_rate(spec) -> float:
+    """Parse a ``--trace-packets`` / ``run(lineage=...)`` rate spec.
+
+    Accepts a float string (``"0.01"``), a percentage (``"1%"``), the
+    word ``"all"`` (rate 1.0), or a plain number.  The rate is a
+    sampling PROBABILITY in (0, 1]; rates above 1 are an error rather
+    than a silent clamp so a fat-fingered ``--trace-packets 10``
+    (meant as a percent) fails loudly."""
+    if isinstance(spec, (int, float)) and not isinstance(spec, bool):
+        rate = float(spec)
+    else:
+        s = str(spec).strip().lower()
+        if s == "all":
+            return 1.0
+        try:
+            if s.endswith("%"):
+                rate = float(s[:-1]) / 100.0
+            else:
+                rate = float(s)
+        except ValueError:
+            raise ValueError(
+                f"--trace-packets: bad rate {spec!r} (expected a "
+                f"probability like 0.01, a percentage like 1%, or 'all')")
+    if not (0.0 < rate <= 1.0):
+        raise ValueError(
+            f"--trace-packets: rate must be in (0, 1], got {rate!r} "
+            f"(use e.g. 0.01 for one packet in a hundred)")
+    return rate
+
+
+def ensure_lineage(state, rate: float = 0.01, capacity: int = 1 << 16,
+                   shards: int = 1):
+    """Return `state` with the packet-lineage tracer installed
+    (idempotent).  `rate` is the sampling probability (a seeded,
+    deterministic function of (src host, emission counter), so every
+    device count -- and a replay -- samples the SAME packets);
+    `capacity` sizes the span ring (rounded up to a multiple of
+    `shards`).  `shards` must match the device count of a mesh run and
+    divide the host count, pool capacity, and inbox capacity; install
+    AFTER mesh padding, like the flight recorder and flowscope."""
+    if state.lineage is not None:
+        return state
+    from .core.state import make_lineage
+    h = int(state.hosts.num_hosts)
+    pc, ic = int(state.pool.capacity), int(state.inbox.capacity)
+    if shards < 1 or h % shards or pc % shards or ic % shards:
+        raise ValueError(
+            f"ensure_lineage: shards={shards} must divide the host count "
+            f"({h}), pool capacity ({pc}) and inbox capacity ({ic}); pad "
+            f"the world to the mesh first (parallel.pad_world_to_mesh)")
+    return state.replace(lineage=make_lineage(
+        pc, ic, rate=rate, capacity=capacity, shards=shards))
+
+
+_SPAN_FIELDS = ("s_time", "s_id", "s_host", "s_stage", "s_reason")
+
+
+class LineageDrain:
+    """Host-side drain of the lineage span ring: fetches new rows at
+    chunk boundaries (one scalar probe, bulk fetch only when rows are
+    new -- the FlightDrain pattern), merges per-shard ring segments
+    into global sim-time order (the ScopeDrain pattern), and appends
+    them to ``spans.jsonl`` when a path is given.
+
+    Each row is one hop of one traced packet's life story:
+    ``{"t", "id", "host", "stage", "reason"}`` with `stage` one of
+    emit/stage/tx/link/exchange/deliver and `reason` naming why a
+    packet died at that hop (qdisc_overflow, loss, link_down,
+    partition, host_down, ack_shed, pool_overflow; "none" for hops
+    that succeeded).  Ring wrap between drains loses the OLDEST
+    pending rows (append-side policy: the ring keeps the first
+    `capacity` rows per drain interval and counts the rest into
+    `lineage.lost`); `spans_lost` in the summary makes the gap
+    visible, and lifetime counters (`n_assigned`, the drop totals the
+    drained rows carry) stay exact."""
+
+    def __init__(self, spans_path: str | None = None):
+        self.rows = []
+        self.rows_lost = 0
+        self.n_assigned = 0
+        self.rate = None            # learned from the block at first drain
+        self.shards = None
+        self._last = None           # [shards] drained-cursor array
+        self._wrap_lost = 0
+        self._f = open(spans_path, "w") if spans_path else None
+
+    def drain(self, state, profiler=None) -> int:
+        """Fetch span rows appended since the last drain; returns how
+        many.  Rides existing sync points -- call at chunk boundaries."""
+        ln = getattr(state, "lineage", None)
+        if ln is None:
+            return 0
+        import jax
+        import numpy as np
+        from .core.state import LREASON_NAMES, SPAN_STAGE_NAMES
+        p = profiler if profiler is not None else _active
+        with p.span("lineage_drain"):
+            probe = jax.device_get((ln.rate_x1p32, ln.n_assigned,
+                                    ln.total, ln.lost))
+            p.transfer(sum(getattr(a, "nbytes", 8) for a in probe),
+                       count=1)
+            self.rate = (int(probe[0]) + 1) / 4294967296.0
+            self.n_assigned = int(probe[1])
+            tot = np.atleast_1d(np.asarray(probe[2], np.int64))
+            lost = np.atleast_1d(np.asarray(probe[3], np.int64))
+            self.shards = tot.shape[0]
+            self.rows_lost = int(lost.sum()) + self._wrap_lost
+            if self._last is None:
+                self._last = np.zeros(self.shards, np.int64)
+            if int(tot.sum()) == int(self._last.sum()):
+                return 0
+            arrs = jax.device_get(tuple(
+                getattr(ln, name) for name in _SPAN_FIELDS))
+            p.transfer(sum(a.nbytes for a in arrs), count=1)
+            per = arrs[0].shape[0] // self.shards
+            parts = []
+            for s in range(self.shards):
+                total_s = int(tot[s])
+                ns = total_s - int(self._last[s])
+                if ns <= 0:
+                    continue
+                if ns > per:
+                    self._wrap_lost += ns - per
+                    self.rows_lost += ns - per
+                    start = total_s - per
+                else:
+                    start = int(self._last[s])
+                parts.append(s * per + (np.arange(start, total_s) % per))
+                self._last[s] = total_s
+            if not parts:
+                return 0
+            idx = np.concatenate(parts)
+            order = np.argsort(arrs[0][idx], kind="stable")
+            n = 0
+            for k in idx[order]:
+                row = {"t": int(arrs[0][k]), "id": int(arrs[1][k]),
+                       "host": int(arrs[2][k]),
+                       "stage": SPAN_STAGE_NAMES.get(
+                           int(arrs[3][k]), str(int(arrs[3][k]))),
+                       "reason": LREASON_NAMES.get(
+                           int(arrs[4][k]), str(int(arrs[4][k])))}
+                self.rows.append(row)
+                if self._f is not None:
+                    self._f.write(json.dumps(row) + "\n")
+                n += 1
+            if self._f is not None:
+                self._f.flush()
+            return n
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+        self._f = None
+
+    def summary(self) -> dict:
+        """Aggregate the drained spans into the `lineage` metrics
+        section: span/ID counts, the drop-reason leaderboard, and how
+        many traced packets reached delivery."""
+        ids = set()
+        delivered = set()
+        drops = {}
+        for r in self.rows:
+            ids.add(r["id"])
+            if r["reason"] != "none":
+                drops[r["reason"]] = drops.get(r["reason"], 0) + 1
+            elif r["stage"] == "deliver":
+                delivered.add(r["id"])
+        out = {"rate": self.rate, "n_assigned": self.n_assigned,
+               "spans": len(self.rows), "spans_lost": self.rows_lost,
+               "ids_seen": len(ids), "ids_delivered": len(delivered),
+               "shards": self.shards or 1}
+        if drops:
+            out["drops"] = dict(sorted(drops.items(),
+                                       key=lambda kv: -kv[1]))
         return out

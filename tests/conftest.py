@@ -74,6 +74,11 @@ def pytest_configure(config):
         "slow: long-running redundancy tests excluded from the tier-1 "
         "sweep (`-m 'not slow'`); run explicitly before perf-sensitive "
         "merges")
+    config.addinivalue_line(
+        "markers",
+        "tier0: the <5-minute smoke subset (tools/smoke.py, `-m tier0`):"
+        " at least one bitwise pin per subsystem, for a fast "
+        "did-I-break-determinism signal before the full tier-1 sweep")
 
 
 @pytest.fixture(autouse=True, scope="module")

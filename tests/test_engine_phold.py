@@ -39,6 +39,7 @@ def test_phold_runs_and_conserves_messages():
     assert int(out.now) == 500 * MS
 
 
+@pytest.mark.tier0
 def test_phold_deterministic_across_window_batching():
     state, params, app = sim.build_phold(
         num_hosts=8, latency_ns=10 * MS, stop_time=400 * MS, seed=7)

@@ -65,6 +65,7 @@ def _phold(**kw):
 
 
 class TestPholdNeutrality:
+    @pytest.mark.tier0
     @pytest.mark.parametrize("rx_batch", [1, 2])
     def test_run_until_bitwise_identical(self, rx_batch):
         state, params, app = _phold(rx_batch=rx_batch)

@@ -17,6 +17,7 @@ import os
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import shadow1_tpu  # noqa: F401  (x64)
 from shadow1_tpu import netem, sim, trace
@@ -90,6 +91,7 @@ class TestBuild:
 
 
 class TestEngineOverlay:
+    @pytest.mark.tier0
     def test_neutral_block_bitwise_identity(self):
         # A block whose only event fires long after stop_time must leave
         # the run bitwise identical to one with no block at all (the

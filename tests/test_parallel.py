@@ -72,6 +72,7 @@ class TestMeshRunUntil:
     determinism contract of docs/parallel.md, verified on the 8-virtual-
     device CPU mesh the conftest forces."""
 
+    @pytest.mark.tier0
     @pytest.mark.parametrize("rx_batch", [1, 2])
     def test_phold_8dev_bitwise_and_chunking_invariant(self, rx_batch):
         t_end = 300 * MS

@@ -164,6 +164,7 @@ class TestCheckpointedRun:
 
 
 class TestReplay:
+    @pytest.mark.tier0
     def test_default_target_verifies_bitwise(self, phold_run):
         d, _ = phold_run
         res = replay.replay(d)

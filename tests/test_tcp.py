@@ -103,6 +103,7 @@ class TestManyClients:
 
 
 class TestDeterminism:
+    @pytest.mark.tier0
     def test_bitwise_identical_runs(self):
         a, _, _ = _run_bulk(num_hosts=4, bytes_per_client=80_000,
                             latency_ns=10 * MS, reliability=0.9,

@@ -53,7 +53,8 @@ _MACHINE_BOUND = ("events_per_sec", "value", "vs_baseline", "wall_sec",
 # backend, so the dotted prefix downgrades the entire block -- a probe
 # time never flags across environments.  The flowscope drain costs
 # (profile.scope.*) are host-side fetch/merge wall times, same class.
-_MACHINE_BOUND_PREFIXES = ("profile.flight.", "profile.scope.", "mesh.")
+_MACHINE_BOUND_PREFIXES = ("profile.flight.", "profile.scope.",
+                           "profile.lineage.", "mesh.")
 
 
 def _machine_bound(name: str) -> bool:
@@ -147,6 +148,22 @@ def _scope_config(d: dict):
         return {"interval_ns": net.get("interval_ns"),
                 "flows": "flows" in net, "links": "links" in net}
     return None
+
+
+def _lineage_config(d: dict):
+    """Normalized packet-lineage config of a run: the config.lineage
+    stamp (a rate spec, None when tracing was off), or _UNSTAMPED for
+    files written before bench.py stamped it.  The tracer adds span-ring
+    writes to the traced graph, so traced-vs-untraced (or different
+    rates) measure different programs; legacy unstamped files stay
+    comparable (the checkpoint rule).  A metrics.json's `lineage`
+    summary section also marks a traced run."""
+    cfg = d.get("config")
+    if isinstance(cfg, dict) and "lineage" in cfg:
+        return cfg["lineage"]
+    if isinstance(d.get("lineage"), dict):
+        return d["lineage"].get("rate")
+    return _UNSTAMPED
 
 
 def _megakernel_config(d: dict):
@@ -347,6 +364,18 @@ def main(argv=None) -> int:
               f"flowscope configs (old scope={sc_old!r}, "
               f"new scope={sc_new!r}); rerun with matching --scope "
               f"settings", file=sys.stderr)
+        return 2
+    ln_old, ln_new = _lineage_config(old), _lineage_config(new)
+    if ln_old is not _UNSTAMPED and ln_new is not _UNSTAMPED \
+            and ln_old != ln_new:
+        # The lineage tracer compiles span-ring writes into the window
+        # loop, so traced vs untraced runs (or different sampling
+        # rates) measure different programs -- the flowscope rule.
+        # Unstamped legacy files pass.
+        print(f"benchdiff: refusing to compare runs with different "
+              f"packet-lineage configs (old lineage={ln_old!r}, "
+              f"new lineage={ln_new!r}); re-record with matching "
+              f"--trace-packets settings", file=sys.stderr)
         return 2
     mk_old, mk_new = _megakernel_config(old), _megakernel_config(new)
     if mk_old is not None and mk_new is not None and mk_old != mk_new:

@@ -9,6 +9,11 @@ also get `flows`/`links` sections from flows.jsonl/links.jsonl
 (trace.ScopeDrain format): top flows by bytes, the retransmit
 leaderboard, and the busiest links.
 
+`spans` digests a spans.jsonl packet-lineage record (trace.LineageDrain
+format, from --trace-packets runs) into per-packet life stories: the
+hop chain of every traced packet, the drop-reason leaderboard, and the
+slowest end-to-end deliveries (docs/observability.md "Packet lineage").
+
 `replaydiff` compares two windows.jsonl flight-recorder records (an
 original run vs a replay, or two runs expected identical) and reports
 the FIRST diverging window with a field-by-field delta, including the
@@ -17,6 +22,7 @@ trace.ReplayDivergence error points at (docs/observability.md
 "Time-travel replay").
 
 Usage: tools/parse.py <data-directory> [--json out.json] [--top N]
+       tools/parse.py spans <data-dir-or-spans.jsonl> [--top N]
        tools/parse.py replaydiff <a/windows.jsonl> <b/windows.jsonl>
 """
 
@@ -59,6 +65,10 @@ def parse_dir(data_dir: str, top: int = 10) -> dict:
     links = parse_links(data_dir, top=top)
     if links is not None:
         out["links"] = links
+    spans = parse_spans(data_dir, top=top) \
+        if os.path.exists(os.path.join(data_dir, "spans.jsonl")) else None
+    if spans is not None:
+        out["lineage"] = spans
     return out
 
 
@@ -146,6 +156,72 @@ def parse_links(data_dir: str, top: int = 10) -> dict | None:
     }
 
 
+def _chain(hops) -> str:
+    """Render one traced packet's hop chain: `stage@h<host>` per hop in
+    time order, with the drop reason bracketed onto the hop where the
+    packet died -- e.g. ``emit@h3 -> tx@h3 -> deliver@h7[link_down]``."""
+    parts = []
+    for r in hops:
+        s = f"{r['stage']}@h{r['host']}"
+        if r.get("reason", "none") != "none":
+            s += f"[{r['reason']}]"
+        parts.append(s)
+    return " -> ".join(parts)
+
+
+def parse_spans(path: str, top: int = 10) -> dict | None:
+    """Digest spans.jsonl (trace.LineageDrain format) into per-packet
+    life stories: how many traced packets lived and died, the
+    drop-reason leaderboard, the slowest end-to-end deliveries (emit ->
+    final deliver latency), and a rendered hop chain for each
+    leaderboard entry.  Accepts a data directory or the jsonl path."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "spans.jsonl")
+    rows = _load_jsonl(path)
+    if rows is None:
+        return None
+    by_id: dict = {}
+    for r in rows:
+        by_id.setdefault(r["id"], []).append(r)
+    for hops in by_id.values():
+        hops.sort(key=lambda r: r["t"])
+
+    reasons: dict = {}
+    dropped = []
+    delivered = []
+    for pid, hops in by_id.items():
+        fatal = next((r for r in hops
+                      if r.get("reason", "none") != "none"), None)
+        if fatal is not None:
+            reasons[fatal["reason"]] = reasons.get(fatal["reason"], 0) + 1
+            dropped.append((pid, hops, fatal))
+            continue
+        ends = [r for r in hops if r["stage"] == "deliver"]
+        if ends:
+            delivered.append((pid, hops, ends[-1]["t"] - hops[0]["t"]))
+
+    def _story(pid, hops, **extra):
+        return {"id": f"{pid:08x}", "hops": len(hops),
+                "t_first": hops[0]["t"], "t_last": hops[-1]["t"],
+                "chain": _chain(hops), **extra}
+
+    slowest = sorted(delivered, key=lambda e: -e[2])[:top]
+    return {
+        "spans": len(rows),
+        "ids_seen": len(by_id),
+        "ids_delivered": len(delivered),
+        "ids_dropped": len(dropped),
+        "drop_reasons": dict(sorted(reasons.items(),
+                                    key=lambda kv: -kv[1])),
+        "slowest_deliveries": [
+            _story(pid, hops, latency_ns=lat)
+            for pid, hops, lat in slowest],
+        "dropped_examples": [
+            _story(pid, hops, reason=fatal["reason"])
+            for pid, hops, fatal in dropped[:top]],
+    }
+
+
 def _load_windows(path: str) -> dict:
     """windows.jsonl rows keyed by global window index.  Accepts a data
     directory or the jsonl path itself."""
@@ -225,6 +301,25 @@ def replaydiff(path_a: str, path_b: str) -> dict:
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "spans":
+        ap = argparse.ArgumentParser(prog="parse.py spans")
+        ap.add_argument("path", help="spans.jsonl (or its data dir)")
+        ap.add_argument("--json", default=None,
+                        help="also write to this file")
+        ap.add_argument("--top", type=int, default=10,
+                        help="leaderboard length")
+        args = ap.parse_args(argv[1:])
+        digest = parse_spans(args.path, top=args.top)
+        if digest is None:
+            print(f"error: {args.path}: no spans.jsonl record",
+                  file=sys.stderr)
+            return 2
+        text = json.dumps(digest, indent=2, sort_keys=True)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(text + "\n")
+        print(text)
+        return 0
     if argv and argv[0] == "replaydiff":
         ap = argparse.ArgumentParser(prog="parse.py replaydiff")
         ap.add_argument("a", help="windows.jsonl (or its data dir)")

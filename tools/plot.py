@@ -25,6 +25,14 @@ silently when its file is absent:
                       forwarded bytes / netem-scaled capacity
                       (links.jsonl)
 
+When the run traced packet lineage (`--trace-packets RATE`,
+trace.LineageDrain format) one more panel appears, skipped silently
+when spans.jsonl is absent:
+  spans.png        -- span waterfall: one horizontal lane per traced
+                      packet from first to last hop, hop stages marked,
+                      dropped packets drawn in red with the reason of
+                      the fatal hop
+
 Rate columns are step-held per host between its rows, so hosts on
 different per-host heartbeat cadences aggregate without sawtooth
 artifacts; delta columns (packets, drops) are summed at the timestamps
@@ -72,6 +80,12 @@ def load_links(data_dir: str):
     """Flowscope link rows from links.jsonl, or None when the run
     sampled no links."""
     return _load_jsonl(os.path.join(data_dir, "links.jsonl"))
+
+
+def load_spans(data_dir: str):
+    """Packet-lineage span rows from spans.jsonl (trace.LineageDrain
+    format), or None when the run traced no packets."""
+    return _load_jsonl(os.path.join(data_dir, "spans.jsonl"))
 
 
 def _load_jsonl(path: str):
@@ -275,6 +289,49 @@ def main(data_dir: str, out_dir: str | None = None) -> list:
         ax.set_ylabel("host")
         f.colorbar(im, ax=ax, label="utilization")
         p = os.path.join(out_dir, "links.png")
+        f.savefig(p, dpi=110, bbox_inches="tight")
+        plt.close(f)
+        written.append(p)
+
+    srows = load_spans(data_dir)
+    if srows:
+        # Span waterfall: one lane per traced packet, first-hop to
+        # last-hop, hop stages marked along the lane.  Lanes are sorted
+        # by first-hop time (the pid-3 track in trace.json uses the
+        # same ordering); dropped packets draw in red, annotated with
+        # the reason of the fatal hop.  Lane count is capped so busy
+        # traces stay readable -- the longest-lived packets win the
+        # cut, since those are the stories worth staring at.
+        by_id = defaultdict(list)
+        for r in srows:
+            by_id[r["id"]].append(r)
+        for hops in by_id.values():
+            hops.sort(key=lambda r: r["t"])
+        cap = 48
+        ids = sorted(by_id, key=lambda i: by_id[i][-1]["t"]
+                     - by_id[i][0]["t"], reverse=True)[:cap]
+        ids.sort(key=lambda i: by_id[i][0]["t"])
+        f, ax = plt.subplots(figsize=(8, max(3.0, 0.16 * len(ids) + 1)))
+        for lane, pid in enumerate(ids):
+            hops = by_id[pid]
+            fatal = next((r["reason"] for r in hops
+                          if r.get("reason", "none") != "none"), None)
+            color = "tab:red" if fatal else "tab:blue"
+            t = [r["t"] / 1e9 for r in hops]
+            ax.plot([t[0], t[-1]], [lane, lane], color=color,
+                    linewidth=1.2, alpha=0.7)
+            ax.plot(t, [lane] * len(t), ".", color=color, markersize=3)
+            if fatal:
+                ax.annotate(fatal, (t[-1], lane), fontsize=6,
+                            color=color, xytext=(3, 0),
+                            textcoords="offset points", va="center")
+        ax.set_title(f"Packet-span waterfall "
+                     f"({len(ids)} of {len(by_id)} traced packets)")
+        ax.set_xlabel("simulated time (s)")
+        ax.set_ylabel("traced packet")
+        ax.set_yticks([])
+        ax.invert_yaxis()
+        p = os.path.join(out_dir, "spans.png")
         f.savefig(p, dpi=110, bbox_inches="tight")
         plt.close(f)
         written.append(p)

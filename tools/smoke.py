@@ -1,0 +1,55 @@
+"""Run the tier-0 smoke subset: one bitwise pin per subsystem, <5 min.
+
+The full tier-1 sweep (`pytest tests/ -m 'not slow'`) takes ~40 minutes
+on CI hardware -- far too slow for an edit-compile-check loop.  Almost
+every regression that matters in this repo is a DETERMINISM break:
+a change that perturbs the bitwise trajectory of a pinned world.  The
+`tier0` marker (registered in tests/conftest.py) tags exactly one such
+pin per subsystem:
+
+  - engine       test_engine_phold.py  phold across window batching
+  - tcp          test_tcp.py           bitwise-identical lossy bulk runs
+  - netem        test_netem.py         neutral overlay block identity
+  - parallel     test_parallel.py      8-device mesh vs single device
+  - replay       test_replay.py        checkpoint replay verifies bitwise
+  - megakernel   test_megakernel.py    fused vs reference trajectories
+  - lineage      test_lineage.py       traced vs untraced trajectories
+
+Together they run in well under five minutes on the virtual 8-device
+CPU mesh, giving a fast did-I-break-determinism signal before paying
+for the full sweep.  A green tier-0 does NOT replace tier-1; it gates
+whether tier-1 is worth starting.
+
+Usage (from anywhere; the script pins cwd to the repo root):
+
+    python tools/smoke.py            # run the subset
+    python tools/smoke.py -x -q      # extra pytest args pass through
+
+Exit code is pytest's exit code.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    env = dict(os.environ)
+    # Tests must never touch the real TPU tunnel; conftest.py enforces
+    # the same, but set it here too so collection itself is safe.
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [
+        sys.executable, "-m", "pytest", "tests/", "-q", "-m", "tier0",
+        "-p", "no:cacheprovider", "-p", "no:randomly",
+    ] + argv
+    print("[smoke] " + " ".join(cmd), flush=True)
+    return subprocess.call(cmd, cwd=REPO, env=env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
