@@ -227,6 +227,11 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
             # writes to the traced graph, so benchdiff refuses a
             # traced-vs-untraced compare too.  bench.py never traces.
             "lineage": None,
+            # Statescope stamp: per-window digests add checksum
+            # reductions to the traced graph, so digested-vs-bare (or
+            # different cadences) measure different programs -- the
+            # lineage rule.  bench.py never digests.
+            "digest": None,
             # Checkpoint stamp: cadenced saves add launch boundaries and
             # host-side npz wall time, so benchdiff refuses a cadence
             # mismatch; bench.py never checkpoints.
@@ -408,6 +413,7 @@ def main_multichip(n_devices: int, gate_against: str | None = None) -> int:
             "flight": top.get("flight"),
             "scope": None,
             "lineage": None,
+            "digest": None,
             "checkpoint_every": None,
             "sentinel": False,
             "supervise": False,

@@ -56,6 +56,14 @@ def world_manifest(state, params, **extra) -> dict:
         "window": int(state.n_windows),
         "t_ns": int(state.now),
     }
+    if getattr(state, "dg", None) is not None:
+        # Statescope stamp: `shadow1-tpu diff` refuses to compare runs
+        # whose digest cadence or field-group schema differ, by name
+        # (shadow1_tpu/diff.py), instead of mis-aligning streams.
+        from .core.state import DIGEST_SCHEMA
+        m["digest"] = {"every": int(state.dg.every),
+                       "schema": DIGEST_SCHEMA,
+                       "shards": int(state.dg.n_shards)}
     m.update(extra)
     return m
 

@@ -54,7 +54,7 @@ _WORLD_ARGS = (
     "cpu_precision", "pcap", "pcap_ring", "netem", "churn",
     "churn_downtime", "log_level", "log_ring", "profile", "bucket",
     "devices", "scope", "trace_packets", "flight_rows",
-    "checkpoint_every")
+    "digest_every", "digest_rows", "checkpoint_every")
 
 
 def world_args(args) -> dict:
@@ -188,6 +188,23 @@ def _parser():
                         "died) to spans.jsonl in the data directory.  "
                         "Tracing never perturbs the trajectory; see "
                         "docs/observability.md 'Packet lineage'")
+    r.add_argument("--digest-every", type=int, default=None, metavar="N",
+                   help="statescope: fold every state field-group "
+                        "(pool, inbox, socks, hosts, rng, netem, app) "
+                        "into a 64-bit per-shard checksum at the close "
+                        "of every N-th window, drained to "
+                        "digests.jsonl in the data directory.  Digests "
+                        "are deterministic and trajectory-neutral; two "
+                        "digest-recorded runs feed `shadow1-tpu diff`, "
+                        "which names the first divergent (window, "
+                        "field group, shard) and -- for checkpointed "
+                        "runs -- the first differing state element "
+                        "(docs/observability.md 'Statescope')")
+    r.add_argument("--digest-rows", type=int, default=4096, metavar="C",
+                   help="digest ring capacity in rows (default 4096): "
+                        "size it above windows-per-drain-interval / N "
+                        "to keep digests.jsonl gap-free (wrapped rows "
+                        "are counted and reported)")
     r.add_argument("--flight-rows", type=int, default=None, metavar="N",
                    help="flight-recorder ring capacity in windows "
                         "(default 4096): size it above the number of "
@@ -288,6 +305,36 @@ def _parser():
                     help="skip the bitwise cross-check against the "
                          "recorded windows.jsonl")
     rp.add_argument("--quiet", action="store_true")
+
+    df = sub.add_parser(
+        "diff",
+        help="statescope first-divergence localization: align two "
+             "digest-recorded runs' digests.jsonl streams, name the "
+             "first divergent (window, field group, shard), then "
+             "restore each run's last agreeing checkpoint, re-execute "
+             "the offending window, and name the first differing state "
+             "element -- field, host, index, expected/got values, "
+             "abs/ulp delta for floats (docs/observability.md "
+             "'Statescope')")
+    df.add_argument("run_a", help="first run's data directory "
+                                  "(digests.jsonl, optionally ckpt/)")
+    df.add_argument("run_b", help="second run's data directory")
+    df.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout instead of "
+                         "the human-readable one")
+    df.add_argument("--no-localize", action="store_true",
+                    help="stop at the digest-stream comparison: report "
+                         "the first divergent (window, group, shard) "
+                         "without the checkpoint-anchored re-execution")
+    df.add_argument("--devices", type=int, default=None, metavar="N",
+                    help="execution override for the re-execution, "
+                         "same contract as replay --devices: each "
+                         "run's original mesh size (default) or 1 to "
+                         "gather a mesh checkpoint onto one device")
+    df.add_argument("--max-elements", type=int, default=8, metavar="N",
+                    help="report at most N differing elements per "
+                         "field (default 8)")
+    df.add_argument("--quiet", action="store_true")
 
     w = sub.add_parser(
         "warm",
@@ -538,6 +585,22 @@ def build_world(args, *, quiet: bool = False, want_mesh: bool = True,
             print(f"[shadow1-tpu] lineage: sampling {rate:g} of "
                   f"emissions", file=sys.stderr)
 
+    if getattr(args, "digest_every", None):
+        # Statescope digest block (same AFTER-mesh-padding rule: one
+        # checksum column per shard, so the shard count is baked into
+        # the ring shape).
+        from . import trace as _trace_mod3
+        try:
+            state = _trace_mod3.ensure_digests(
+                state, every=args.digest_every,
+                capacity=getattr(args, "digest_rows", None) or 4096,
+                shards=n_dev)
+        except ValueError as e:
+            raise CliError(str(e))
+        if not quiet:
+            print(f"[shadow1-tpu] digest: every {args.digest_every} "
+                  f"window(s)", file=sys.stderr)
+
     return types.SimpleNamespace(
         asm=asm, state=state, params=params, app=app, stop=int(stop),
         n_dev=n_dev, mesh=mesh, substrate=substrate,
@@ -578,6 +641,16 @@ def run_config(args) -> int:
             trace.parse_lineage_rate(args.trace_packets)
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
+            return RC_USAGE
+
+    if getattr(args, "digest_every", None):
+        if args.digest_every < 1:
+            print("error: --digest-every must be a positive window "
+                  "count", file=sys.stderr)
+            return RC_USAGE
+        if not args.data_directory:
+            print("error: --digest-every requires --data-directory",
+                  file=sys.stderr)
             return RC_USAGE
 
     ck_every_ns = None
@@ -700,6 +773,11 @@ def run_config(args) -> int:
         spans = trace.LineageDrain(
             os.path.join(args.data_directory, "spans.jsonl"))
 
+    digests = None
+    if state.dg is not None and args.data_directory:
+        digests = trace.DigestDrain(
+            os.path.join(args.data_directory, "digests.jsonl"))
+
     ck = None
     if ck_every_ns:
         from . import replay as replay_mod
@@ -717,6 +795,10 @@ def run_config(args) -> int:
                 "scope": args.scope, "profile": bool(args.profile),
                 "flight_rows": int(state.fr.steps.shape[0]),
                 "lineage": getattr(args, "trace_packets", None),
+                "digest": (int(state.dg.every)
+                           if state.dg is not None else None),
+                "digest_rows": (int(state.dg.capacity)
+                                if state.dg is not None else None),
                 "sentinel": supervise_on, "supervise": supervise_on})
             ck.save(state, params)  # win_0: a replay anchor always exists
         if not args.quiet:
@@ -748,7 +830,14 @@ def run_config(args) -> int:
             if flight is not None else None)
     hb_ns = tracker.sample_interval_ns if tracker else None
     t = int(state.now)
-    hb_next = 0
+    # Every synchronous host-side drain behind one call (sim.Drains):
+    # heartbeat, event log, counters, flight / scope / lineage / digest
+    # rings -- the checkpointed sim.run loop drains through the same
+    # helper, so a new ring slots into both loops in one place.
+    from .sim import Drains
+    drains = Drains(tracker=tracker, log=drain, flight=flight,
+                    scope=scope, spans=spans, digests=digests,
+                    profiler=profiler)
     try:
         while t < stop:
             # Advance to the next launch boundary on the memoryless
@@ -770,25 +859,13 @@ def run_config(args) -> int:
             else:
                 state = engine.run_chunked(state, params, app, t_next)
             t = t_next
-            if tracker is not None and t >= hb_next:
-                tracker.heartbeat(state, t)
-                hb_next = t + tracker.sample_interval_ns
-            if drain is not None:
-                drain.drain(state)
-            if profiler is not None:
-                trace.fetch_counters(state, profiler)
-            if flight is not None:
-                flight.drain(state, profiler)
-            if scope is not None:
-                scope.drain(state, profiler)
-            if spans is not None:
-                spans.drain(state, profiler)
+            drains.drain_all(state, t)
             if ck is not None:
                 ck.maybe(state, params, t)
             if progress is not None:
                 progress.update(state, t)
     except UnrecoveredFailure as e:
-        for closer in (flight, drain, spans):
+        for closer in (flight, drain, spans, digests):
             if closer is not None:
                 try:
                     closer.close()
@@ -869,6 +946,12 @@ def run_config(args) -> int:
         summary["lineage"] = spans.summary()
         if profiler is not None:
             profiler.set_lineage(spans.rows, summary["lineage"])
+    if digests is not None:
+        digests.drain(state, profiler)
+        digests.close()
+        summary["digest"] = digests.summary()
+        if profiler is not None:
+            profiler.set_digest(summary["digest"])
     if tracker is not None:
         tracker.summary(summary, state)
     if substrate is not None:
@@ -961,6 +1044,35 @@ def replay_cmd(args) -> int:
     return RC_OK
 
 
+def diff_cmd(args) -> int:
+    """`shadow1-tpu diff`: align two runs' digest streams, localize the
+    first divergence.  Exit codes (supervise.py's unified table): 0 the
+    runs agree over every compared window, 1 they diverge (the report
+    names where), 2 usage errors -- a directory that is not a
+    digest-recorded run, or incomparable digest configs (cadence /
+    schema / --devices mismatch, named in the message)."""
+    from . import diff as diff_mod
+    try:
+        report = diff_mod.diff_runs(
+            args.run_a, args.run_b, localize=not args.no_localize,
+            devices=args.devices, max_elements=args.max_elements,
+            quiet=args.quiet)
+    except CliError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return e.rc
+    except diff_mod.DiffUsageError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return RC_USAGE
+    except (FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return RC_USAGE
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(diff_mod.format_report(report))
+    return RC_INVARIANT if report.get("divergence") else RC_OK
+
+
 def warm_cmd(args) -> int:
     from . import shapes
     log = None
@@ -982,6 +1094,8 @@ def main(argv=None) -> int:
         return run_config(args)
     if args.cmd == "replay":
         return replay_cmd(args)
+    if args.cmd == "diff":
+        return diff_cmd(args)
     if args.cmd == "warm":
         return warm_cmd(args)
     return RC_USAGE

@@ -80,6 +80,7 @@ from .state import (ERR_POOL_OVERFLOW, I32, I64, U32, PROTO_TCP, PROTO_UDP,
                     LREASON_HOST_DOWN, LREASON_ACK_SHED, LREASON_POOL,
                     SENTINEL_CONSERVATION, SENTINEL_TIME, SENTINEL_BOUNDS,
                     SENTINEL_NONFINITE, SENTINEL_TIMER_MAX_NS,
+                    DIGEST_GROUPS,
                     enc_lo, enc_hi, dec_i64, SimState, host_ids)
 # Fault/dynamics overlay operators (netem/apply.py).  Every call site
 # guards on `state.nm is None` (a trace-time pytree check), so worlds
@@ -1023,6 +1024,163 @@ def _sentinel_check(state: SimState, snap, ws, we) -> SimState:
         resid_low=resid_low,
         resid_high=resid_high,
         nonfinite=bad))
+
+
+# ---------------------------------------------------------------------------
+# Statescope digests: per-window state checksums (state.DigestBlock)
+# ---------------------------------------------------------------------------
+
+
+def _mix64(x):
+    """murmur3 fmix64 in i64 (XLA integer arithmetic wraps two's
+    complement and logical shifts act on the bit pattern, so this is
+    bit-identical to the canonical u64 finalizer)."""
+    s = jnp.asarray(33, I64)
+    x = x ^ jax.lax.shift_right_logical(x, s)
+    x = x * (-49064778989728563)       # 0xFF51AFD7ED558CCD
+    x = x ^ jax.lax.shift_right_logical(x, s)
+    x = x * (-4265267296055464877)     # 0xC4CEB9FE1A85EC53
+    return x ^ jax.lax.shift_right_logical(x, s)
+
+
+def _dg_bits(x):
+    """Bit-normalize a state leaf to i64: floats by bitcast (so the
+    digest sees f32 islands bitwise, not approximately), narrower ints
+    by extension.  Deterministic on both the mesh and off-mesh paths."""
+    x = jnp.asarray(x)
+    if x.dtype == jnp.float32:
+        return jax.lax.bitcast_convert_type(x, I32).astype(I64)
+    if x.dtype == jnp.float64:
+        return jax.lax.bitcast_convert_type(x, I64)
+    return x.astype(I64)
+
+
+_M64 = (1 << 64) - 1
+
+
+def _dg_tag(group: int, leaf_idx: int) -> int:
+    """Distinct i64 constant per (group, leaf): the element hash keys on
+    it, so equal values at equal indices in different leaves still
+    contribute different terms.  Host-side fmix64 (python ints)."""
+    x = ((group << 32) ^ leaf_idx ^ 0x5851F42D4C957F2D) & _M64
+    x ^= x >> 33
+    x = (x * 0xFF51AFD7ED558CCD) & _M64
+    x ^= x >> 33
+    x = (x * 0xC4CEB9FE1A85EC53) & _M64
+    x ^= x >> 33
+    return x - (1 << 64) if x >= (1 << 63) else x
+
+
+def _digest_group_leaves(state: SimState) -> dict:
+    """DIGEST_GROUPS name -> the state leaves that group covers.  The
+    RNG counters get their own column (divergence there means the
+    *sampling* went different ways, the first thing to rule out), so
+    the hosts group excludes them by identity.
+
+    The netem group drops `nm.killed`: under a mesh each shard holds a
+    per-shard PARTIAL of that counter (parallel/mesh.py finalizes it by
+    psum only at launch end), so mid-run it cannot be digested
+    shard-invariantly; kills still surface through the pool/inbox state
+    they mutate."""
+    h = state.hosts
+    rng_leaves = [h.rng_ctr, h.send_ctr]
+    nm_leaves = ([l for l in jax.tree_util.tree_leaves(state.nm)
+                  if l is not state.nm.killed]
+                 if state.nm is not None else [])
+    return {
+        "pool": jax.tree_util.tree_leaves(state.pool),
+        "inbox": jax.tree_util.tree_leaves(state.inbox),
+        "socks": jax.tree_util.tree_leaves(state.socks),
+        "hosts": [l for l in jax.tree_util.tree_leaves(h)
+                  if not any(l is r for r in rng_leaves)],
+        "rng": rng_leaves,
+        "netem": nm_leaves,
+        "app": jax.tree_util.tree_leaves(state.app),
+    }
+
+
+def _digest_sums(state: SimState) -> jnp.ndarray:
+    """[G, D] i64 checksum matrix of the current state: per DIGEST_GROUPS
+    row, per logical-host-shard column.
+
+    Each element contributes `_mix64(bits + _mix64(global_index + tag))`
+    (keyed on the GLOBAL flat index, so a permutation of equal values
+    still diverges) and a group checksum is the WRAPPING i64 SUM of its
+    contributions.  Summation is commutative and element ownership is
+    exact, so the [G, D] matrix is bitwise identical between a D-shard
+    mesh run and a single-device run installed with shards=D -- and
+    summing columns over D reproduces the shards=1 digest.  Replicated
+    leaves (netem overlay, scalars) contribute once, into column 0.
+
+    Under a mesh each shard computes its local column and one
+    all_gather assembles the identical full matrix on every shard (the
+    flight-recorder replication rule)."""
+    dg = state.dg
+    D = dg.n_shards
+    mesh = _on_mesh(state)
+    h = state.hosts.num_hosts
+    row_axes = (h, state.pool.capacity, state.inbox.capacity)
+    groups = _digest_group_leaves(state)
+    cols, repl = [], []
+    for g, name in enumerate(DIGEST_GROUPS):
+        col = jnp.zeros((1 if mesh else D,), I64)
+        rep = jnp.asarray(0, I64)
+        for i, leaf in enumerate(groups[name]):
+            v = _dg_bits(leaf).reshape(-1)
+            tag = _dg_tag(g, i)
+            # The netem overlay is REPLICATED under a mesh (every shard
+            # holds the full arrays), so its leaves must not take the
+            # leading-axis shard rule even off-mesh -- group-level
+            # classification keeps the two paths identical.
+            sharded = (name != "netem" and jnp.ndim(leaf) >= 1
+                       and leaf.shape[0] in row_axes)
+            if sharded:
+                if mesh:
+                    # Global flat offset of this shard's element 0: the
+                    # leading axis is a multiple of the host axis, so
+                    # rows stay contiguous chunks under flattening.
+                    off = state.hoff.astype(I64) * (v.shape[0] // h)
+                else:
+                    off = jnp.asarray(0, I64)
+                idx = jnp.arange(v.shape[0], dtype=I64) + off
+                contrib = _mix64(v + _mix64(idx + tag))
+                if mesh:
+                    col = col + jnp.sum(contrib, dtype=I64)[None]
+                else:
+                    col = col + contrib.reshape(D, -1).sum(
+                        axis=1, dtype=I64)
+            else:
+                idx = jnp.arange(v.shape[0], dtype=I64)
+                rep = rep + jnp.sum(_mix64(v + _mix64(idx + tag)),
+                                    dtype=I64)
+        cols.append(col)
+        repl.append(rep)
+    col_m = jnp.stack(cols)  # [G, 1] local under mesh; [G, D] off-mesh
+    if mesh:
+        col_m = jax.lax.all_gather(col_m[:, 0], MESH_AXIS).T  # [G, D]
+    return col_m.at[:, 0].add(jnp.stack(repl))
+
+
+def _digest_record(state: SimState, we) -> SimState:
+    """Append one digest row when the just-closed window lands on the
+    cadence.  `n_windows` is replicated (uniform window predicates), so
+    every shard takes the same branch -- the all_gather inside the
+    taken branch is collective-safe, the `_exchange` cond rule."""
+    dg = state.dg
+    win = state.n_windows - 1  # the just-closed window's global index
+    due = (win % dg.every) == 0
+
+    def rec(s):
+        d = s.dg
+        sums = _digest_sums(s)
+        idx = (d.total % d.capacity).astype(I32)
+        return s.replace(dg=d.replace(
+            win=d.win.at[idx].set(win),
+            t_end=d.t_end.at[idx].set(jnp.asarray(we, I64)),
+            sums=d.sums.at[idx].set(sums),
+            total=d.total + 1))
+
+    return jax.lax.cond(due, rec, lambda s: s, state)
 
 
 # ---------------------------------------------------------------------------
@@ -2275,6 +2433,11 @@ def run_until_impl(state: SimState, params, app, t_target):
             st = _scope_sample(st, ctx, we)
         if st.sentinel is not None:
             st = _sentinel_check(st, sn_snap, ws, we)
+        if st.dg is not None:
+            # Digest at window close: the cadence predicate is a
+            # function of the replicated window counter, so every shard
+            # takes the same branch around the gather inside.
+            st = _digest_record(st, we)
         return st, t_h, gmin, outbox_pending(st)
 
     t_h0, gmin0 = scan(state)

@@ -1191,6 +1191,71 @@ def make_sentinel() -> SentinelBlock:
 
 
 # ---------------------------------------------------------------------------
+# Statescope digests (per-window state checksums; trace.DigestDrain)
+# ---------------------------------------------------------------------------
+
+
+# Field groups a digest row covers, in column order.  The grouping is
+# the diff vocabulary ("the pool diverged at window 41"), so changing
+# membership or order is a schema change: bump DIGEST_SCHEMA and diff
+# refuses to compare across versions by name instead of mis-aligning
+# columns.
+DIGEST_GROUPS = ("pool", "inbox", "socks", "hosts", "rng", "netem", "app")
+DIGEST_SCHEMA = 1
+
+
+@struct.dataclass
+class DigestBlock:
+    """Per-window state checksums -- the divergence tripwire.  Present
+    in SimState only when installed (trace.ensure_digests), so
+    digest-less runs trace byte-identical graphs: the same
+    present-or-None contract as cap/log/tr/fr/scope/nm.
+
+    engine._digest_record runs at window close (cadence `every`
+    windows): each SimState leaf is bit-normalized to i64, every
+    element hashed against its GLOBAL flat index, and the hashes
+    wrapping-summed per DIGEST_GROUPS column and per logical host
+    shard.  Summation is commutative, so per-shard columns summed over
+    D reproduce the shards=1 digest bitwise -- which is what lets
+    `shadow1-tpu diff` compare a mesh run against a single-device run
+    column-reduced, and is the property tests/test_statescope.py pins.
+
+    The row ring (`win`/`t_end`/`sums`) is REPLICATED under a mesh:
+    each shard computes its local column and one all_gather assembles
+    the identical [G, D] row everywhere (the flight-recorder rule).
+    `every` is replicated and the cadence predicate is a function of
+    the replicated window counter, so every shard takes the same
+    branch.  `total` counts lifetime rows (the drain's wrap
+    accounting); the block only ever reads trajectory state, so
+    installing it is bitwise trajectory-neutral."""
+
+    every: jnp.ndarray  # i64 scalar: digest cadence in windows
+    win: jnp.ndarray    # [C] i64 global window index of the row
+    t_end: jnp.ndarray  # [C] i64 window end (sim ns)
+    sums: jnp.ndarray   # [C, G, D] i64 per-group / per-shard checksums
+    total: jnp.ndarray  # i64 scalar: lifetime rows written
+
+    @property
+    def capacity(self) -> int:
+        return self.win.shape[0]
+
+    @property
+    def n_shards(self) -> int:
+        return self.sums.shape[2]
+
+
+def make_digest(capacity: int = 4096, shards: int = 1,
+                every: int = 1) -> DigestBlock:
+    return DigestBlock(
+        every=jnp.asarray(max(1, int(every)), I64),
+        win=_zeros((capacity,), I64),
+        t_end=_zeros((capacity,), I64),
+        sums=_zeros((capacity, len(DIGEST_GROUPS), shards), I64),
+        total=jnp.asarray(0, I64),
+    )
+
+
+# ---------------------------------------------------------------------------
 # Trace counter block (runtime profiling; trace.py)
 # ---------------------------------------------------------------------------
 
@@ -1272,6 +1337,11 @@ class SimState:
     # Sharded under a mesh (per-shard span-ring segments + cursor slices,
     # the cap/log layout); pool_id/inbox_id shard with their pools.
     lineage: any = struct.field(pytree_node=True, default=None)  # LineageBlock | None
+    # Per-window state digests (trace.ensure_digests): present only when
+    # installed, so digest-less runs trace byte-identical graphs.
+    # Replicated (never sharded) under a mesh -- every shard assembles
+    # identical rows from all_gather'd per-shard checksum columns.
+    dg: any = struct.field(pytree_node=True, default=None)  # DigestBlock | None
     # Telemetry (reference scheduler built-in timers, scheduler.c:266-268):
     n_steps: jnp.ndarray = struct.field(default=None)    # i64 micro-steps
     n_windows: jnp.ndarray = struct.field(default=None)  # i64 windows run

@@ -67,11 +67,12 @@ def _state_specs(state):
 
     def spec(path, leaf):
         name = getattr(path[0], "name", "")
-        if name in ("nm", "fr", "sentinel"):
+        if name in ("nm", "fr", "sentinel", "dg"):
             # Replicated blocks: netem gathers by global ids; the flight
-            # recorder and the invariant sentinel compute identical
-            # values on every shard from psum/pmin/pmax-reduced inputs
-            # (engine._fr_record / engine._sentinel_check).
+            # recorder, the invariant sentinel, and the digest ring
+            # compute identical values on every shard from psum/pmin/
+            # all_gather-reduced inputs (engine._fr_record /
+            # engine._sentinel_check / engine._digest_record).
             return P()
         if name in ("log", "cap", "scope", "lineage"):
             # Sharded observability rings (make_log_ring/make_capture_ring
@@ -206,6 +207,12 @@ def mesh_run_until(state, params, app, t_target, mesh=None):
             f"{state.lineage.n_shards} shard(s) but the mesh has {d} "
             f"devices; install it with trace.ensure_lineage(state, "
             f"shards={d}) so every shard gets its own span-ring segment")
+    if state.dg is not None and state.dg.n_shards != d:
+        raise ValueError(
+            f"mesh_run_until: digest block built for "
+            f"{state.dg.n_shards} shard(s) but the mesh has {d} devices; "
+            f"install it with trace.ensure_digests(state, shards={d}) so "
+            f"the per-shard checksum columns match the mesh")
     h = state.hosts.num_hosts
     hp = params.host_vertex.shape[0]
     if hp != h:

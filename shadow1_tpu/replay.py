@@ -325,6 +325,10 @@ def _rebuild_builder(info: dict, want_mesh: bool = True):
         state = trace.ensure_lineage(
             state, rate=trace.parse_lineage_rate(info["lineage"]),
             shards=n)
+    if info.get("digest"):
+        state = trace.ensure_digests(
+            state, every=int(info["digest"]),
+            capacity=int(info.get("digest_rows") or 4096), shards=n)
     if info.get("profile"):
         state = trace.ensure_counters(state)
     # Honor the recorded ring size (--flight-rows): the restored
@@ -356,7 +360,10 @@ def _reset_instrumentation(state):
     pool/inbox side arrays -- packets in flight at the checkpoint carry
     their trace IDs into the replayed span, exactly as they did in the
     original run.  The flight recorder is NOT reset -- its cursor is
-    the global window index FlightDrain(start=K0) needs."""
+    the global window index FlightDrain(start=K0) needs.  The digest
+    block is likewise left alone: its lifetime row counter is the
+    cursor DigestDrain(start=...) resumes from, and replayed rows land
+    at the same ring slots with the same values as the original's."""
     from .core.state import (make_capture_ring, make_flowscope,
                              make_log_ring)
     reps = {}
@@ -564,6 +571,15 @@ def replay(data_dir: str, *, window: int | None = None,
     if state.lineage is not None:
         lineage_drain = trace_mod.LineageDrain(
             os.path.join(out, "spans.jsonl"))
+    digest_drain = None
+    if state.dg is not None:
+        # Resume the drain cursor at the checkpoint's lifetime row
+        # count so OUT/digests.jsonl holds only the replayed span's
+        # rows (which are bitwise the original run's rows for the same
+        # windows -- digests are deterministic).
+        digest_drain = trace_mod.DigestDrain(
+            os.path.join(out, "digests.jsonl"),
+            start=int(state.dg.total))
 
     hb_ns = info.get("hb_ns")
     every_ns = info.get("every_ns")
@@ -596,6 +612,8 @@ def replay(data_dir: str, *, window: int | None = None,
                 scope_drain.drain(state, profiler)
             if lineage_drain is not None:
                 lineage_drain.drain(state, profiler)
+            if digest_drain is not None:
+                digest_drain.drain(state, profiler)
             if prog is not None:
                 prog.update(state, t)
         if prog is not None:
@@ -652,6 +670,12 @@ def replay(data_dir: str, *, window: int | None = None,
         if profiler is not None:
             profiler.set_lineage(lineage_drain.rows,
                                  lineage_drain.summary())
+    if digest_drain is not None:
+        digest_drain.drain(state, profiler)
+        digest_drain.close()
+        summary["digest"] = digest_drain.summary()
+        if profiler is not None:
+            profiler.set_digest(digest_drain.summary())
     if profiler is not None:
         trace_mod.fetch_counters(state, profiler)
         profiler.set_flight(flight.rows,

@@ -56,7 +56,7 @@ VERTEX_LADDER = (16, 64, 256, 1024, 4096)
 # `scope` (the flowscope sampling block) includes its static
 # sample_flows/sample_links flags via leaf shapes + jit statics.
 _STATE_BLOCKS = ("nm", "cap", "log", "log_level", "tr", "fr", "scope",
-                 "sentinel", "lineage", "hoff")
+                 "sentinel", "lineage", "dg", "hoff")
 
 
 @dataclasses.dataclass(frozen=True)

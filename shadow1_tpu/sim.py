@@ -278,9 +278,53 @@ def add_churn(state, params, rate_per_s: float,
     return netem.install(state, params, tl)
 
 
+class Drains:
+    """The per-launch-boundary host-side drain set, behind one call.
+
+    The run loops (sim._run_checkpointed, cli.run_config) all do the
+    same thing after every bounded device launch: heartbeat if due,
+    drain the event log, fetch the device counters, then drain the
+    flight-recorder / flowscope / lineage / digest rings.  One object
+    holds whichever of those the run installed so a new ring (the
+    statescope digests were the sixth) slots into every loop by being
+    constructed here, not by a new `if x is not None: x.drain(...)`
+    copied into each loop.  Order is load-bearing only for the
+    heartbeat (cheapest first) and counters (the ring drains attribute
+    their transfer bytes to the already-installed profiler phases).
+    """
+
+    def __init__(self, *, tracker=None, log=None, flight=None, scope=None,
+                 spans=None, digests=None, profiler=None):
+        self.tracker = tracker
+        self.log = log
+        self.flight = flight
+        self.scope = scope
+        self.spans = spans
+        self.digests = digests
+        self.profiler = profiler
+        self._hb_next = 0
+
+    def drain_all(self, state, t=None) -> None:
+        """Run every installed drain against `state`; `t` (sim ns)
+        gates the heartbeat on its sample interval."""
+        if self.tracker is not None and t is not None \
+                and t >= self._hb_next:
+            self.tracker.heartbeat(state, t)
+            self._hb_next = t + self.tracker.sample_interval_ns
+        if self.log is not None:
+            self.log.drain(state)
+        if self.profiler is not None:
+            from . import trace
+            trace.fetch_counters(state, self.profiler)
+        for ring in (self.flight, self.scope, self.spans, self.digests):
+            if ring is not None:
+                ring.drain(state, self.profiler)
+
+
 def run(state, params, app, until=None, profiler=None, devices=None,
-        bucket=False, scope=None, lineage=None, checkpoint_every=None,
-        checkpoint_dir=None, checkpoint_world=None, supervise=None):
+        bucket=False, scope=None, lineage=None, digest=None,
+        checkpoint_every=None, checkpoint_dir=None, checkpoint_world=None,
+        supervise=None):
     """Run to `until` (default: params.stop_time).
 
     With `profiler` (a trace.Profiler), the run is profiled: the
@@ -324,6 +368,19 @@ def run(state, params, app, until=None, profiler=None, devices=None,
     match `devices`.  Under checkpointing the spans drain to
     `checkpoint_dir`/spans.jsonl automatically.
 
+    With `digest` (True, or an integer window cadence N) a statescope
+    digest block rides the state: at the close of every N-th window the
+    device folds each state field-group (pool, inbox, socks, hosts,
+    rng, netem, app) into a 64-bit checksum per host-shard
+    (docs/observability.md "Statescope").  Digests are bitwise
+    trajectory-neutral and deterministic: two runs of the same world
+    produce identical digest streams, and a mesh run's per-shard
+    columns equal the single-device run's.  Read the rows back with
+    trace.DigestDrain; under checkpointing they drain to
+    `checkpoint_dir`/digests.jsonl automatically, and `shadow1-tpu
+    diff` localizes the first divergence between two digest-recorded
+    runs.  Installed after all padding, sharded to match `devices`.
+
     With `checkpoint_every` (a sim-time cadence in ns) the run becomes
     replayable (replay.py, docs/observability.md "Time-travel replay"):
     snapshots land in `checkpoint_dir`/ckpt/win_<K>.npz at existing
@@ -363,9 +420,9 @@ def run(state, params, app, until=None, profiler=None, devices=None,
         return _run_checkpointed(
             state, params, app, int(t), profiler=profiler,
             devices=devices, bucket=bucket, scope=scope, lineage=lineage,
-            every_ns=int(checkpoint_every), ckdir=checkpoint_dir,
-            world=checkpoint_world, hosts_real=h_real,
-            supervise=supervise)
+            digest=digest, every_ns=int(checkpoint_every),
+            ckdir=checkpoint_dir, world=checkpoint_world,
+            hosts_real=h_real, supervise=supervise)
     if supervise:
         raise ValueError(
             "sim.run: supervise requires checkpoint_every and "
@@ -384,6 +441,13 @@ def run(state, params, app, until=None, profiler=None, devices=None,
         from . import trace
         return trace.ensure_lineage(
             st, rate=trace.parse_lineage_rate(lineage), shards=shards)
+
+    def _install_digest(st, shards):
+        if digest is None or digest is False or st.dg is not None:
+            return st
+        from . import trace
+        return trace.ensure_digests(
+            st, every=1 if digest is True else int(digest), shards=shards)
     if devices is not None and int(devices) > 1:
         import jax as _jax
 
@@ -397,6 +461,7 @@ def run(state, params, app, until=None, profiler=None, devices=None,
         state, params = parallel.pad_world_to_mesh(state, params, n)
         state = _install_scope(state, n)
         state = _install_lineage(state, n)
+        state = _install_digest(state, n)
         if profiler is None:
             return parallel.mesh_run_chunked(state, params, app, int(t),
                                              mesh=mesh)
@@ -412,6 +477,7 @@ def run(state, params, app, until=None, profiler=None, devices=None,
             trace.install(None)
     state = _install_scope(state, 1)
     state = _install_lineage(state, 1)
+    state = _install_digest(state, 1)
     if profiler is None:
         return engine.run_until(state, params, app, t)
     from . import trace
@@ -427,7 +493,7 @@ def run(state, params, app, until=None, profiler=None, devices=None,
 
 def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
                       scope, every_ns, ckdir, world, hosts_real,
-                      lineage=None, supervise=None):
+                      lineage=None, digest=None, supervise=None):
     """run()'s checkpointing path: same block installs as the plain
     paths (mesh pad, then scope/counters -- replay._rebuild_builder
     mirrors this order exactly), plus a flight recorder, a windows.jsonl
@@ -456,6 +522,9 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
     if lineage is not None and state.lineage is None:
         state = trace.ensure_lineage(
             state, rate=trace.parse_lineage_rate(lineage), shards=n)
+    if digest is not None and digest is not False and state.dg is None:
+        state = trace.ensure_digests(
+            state, every=1 if digest is True else int(digest), shards=n)
     if profiler is not None:
         trace.install(profiler)
         state = trace.ensure_counters(state)
@@ -468,6 +537,9 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
     spans = None
     if state.lineage is not None:
         spans = trace.LineageDrain(os.path.join(ckdir, "spans.jsonl"))
+    digests = None
+    if state.dg is not None:
+        digests = trace.DigestDrain(os.path.join(ckdir, "digests.jsonl"))
     ck = replay_mod.Checkpointer(ckdir, every_ns, devices=n,
                                  bucket=bucket, hosts_real=hosts_real)
     if world is not None and not isinstance(world, dict):
@@ -482,6 +554,9 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
         "scope": scope, "profile": profiler is not None,
         "flight_rows": int(state.fr.steps.shape[0]),
         "lineage": (str(lineage) if lineage is not None else None),
+        "digest": (int(state.dg.every) if state.dg is not None else None),
+        "digest_rows": (int(state.dg.capacity)
+                        if state.dg is not None else None),
         "sentinel": bool(supervise), "supervise": bool(supervise)})
     sup = None
     if supervise:
@@ -490,6 +565,8 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
         sup = sup_mod.Supervisor(
             ckdir, app, mesh=mesh, chunk_ns=engine.CHUNK_NS,
             on_violation=lambda st: flight.drain(st, profiler), **opts)
+    drains = Drains(flight=flight, spans=spans, digests=digests,
+                    profiler=profiler)
     try:
         ck.save(state, params)          # win_0: a replay anchor always exists
         tt = int(state.now)
@@ -503,11 +580,7 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
                                                   mesh=mesh)
             else:
                 state = engine.run_chunked(state, params, app, tt)
-            if profiler is not None:
-                trace.fetch_counters(state, profiler)
-            flight.drain(state, profiler)
-            if spans is not None:
-                spans.drain(state, profiler)
+            drains.drain_all(state)
             ck.maybe(state, params, tt)
         return state
     finally:
@@ -516,6 +589,10 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
             spans.close()
             if profiler is not None:
                 profiler.set_lineage(spans.rows, spans.summary())
+        if digests is not None:
+            digests.close()
+            if profiler is not None:
+                profiler.set_digest(digests.summary())
         if profiler is not None:
             trace.install(None)
 

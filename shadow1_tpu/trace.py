@@ -171,6 +171,7 @@ class Profiler:
         self.scope_summary = None   # aggregate `net` section|None
         self.lineage_rows = []      # drained LineageDrain span rows
         self.lineage_summary = None  # aggregate `lineage` section|None
+        self.digest_summary = None  # aggregate `digest` section|None
 
     # -- recording hooks ----------------------------------------------------
 
@@ -228,6 +229,13 @@ class Profiler:
         time (pid 2)."""
         self.lineage_rows = list(rows)
         self.lineage_summary = summary
+
+    def set_digest(self, summary: dict | None):
+        """Attach the statescope digest aggregate (DigestDrain.summary):
+        row/wrap counts and the cadence.  Becomes the `digest` section
+        of metrics() -- machine-bound for benchdiff (reported, never
+        gated)."""
+        self.digest_summary = summary
 
     def set_metric(self, name: str, value):
         """Attach one named scalar metric (e.g. a measured phase cost
@@ -293,6 +301,8 @@ class Profiler:
             out["net"] = self.scope_summary
         if self.lineage_summary is not None:
             out["lineage"] = self.lineage_summary
+        if self.digest_summary is not None:
+            out["digest"] = self.digest_summary
         out.update(self.extra_metrics)
         return out
 
@@ -456,7 +466,7 @@ def _pct(sorted_vals, q):
 # metric (the async-window-pipeline yardstick in ROADMAP.md).
 _HOST_DRAIN_PHASES = frozenset(
     ("heartbeat", "log_drain", "flight_drain", "scope_drain",
-     "lineage_drain", "progress"))
+     "lineage_drain", "digest_drain", "progress"))
 
 # Most traced packets rendered as pid-3 waterfall spans in trace.json
 # (ordered by first hop); the full span set always lands in spans.jsonl.
@@ -860,6 +870,126 @@ class SentinelDrain:
         if row is not None and row["violations"]:
             raise SentinelViolation(row)
         return row
+
+
+# ---------------------------------------------------------------------------
+# Statescope digests (the DigestBlock on SimState; core/state.py)
+# ---------------------------------------------------------------------------
+
+
+def ensure_digests(state, every: int = 1, capacity: int = 4096,
+                   shards: int = 1):
+    """Return `state` with a per-window DigestBlock installed
+    (idempotent).  `every` is the cadence in windows (digest every Nth
+    window close); `shards` sizes the per-logical-shard checksum
+    columns and must match the device count of a mesh run (1 for
+    single-device); the host count, pool capacity, and inbox capacity
+    must divide it so element ownership is well defined.
+
+    Rows stamp the GLOBAL window index (taken from `state.n_windows` at
+    record time), so a mid-run install digests under the same indices
+    an always-on block would use -- diff aligns streams by that index."""
+    if state.dg is not None:
+        return state
+    from .core.state import make_digest
+    every = int(every)
+    if every < 1:
+        raise ValueError(
+            f"ensure_digests: cadence must be a positive window count, "
+            f"got {every}")
+    if capacity < 1:
+        raise ValueError(
+            f"ensure_digests: ring capacity must be positive, "
+            f"got {capacity}")
+    h = int(state.hosts.num_hosts)
+    if shards < 1 or h % shards or int(state.pool.capacity) % shards \
+            or int(state.inbox.capacity) % shards:
+        raise ValueError(
+            f"ensure_digests: shards={shards} must divide the host "
+            f"count ({h}), pool capacity ({int(state.pool.capacity)}), "
+            f"and inbox capacity ({int(state.inbox.capacity)}); pad the "
+            f"world to the mesh first (parallel.pad_world_to_mesh)")
+    return state.replace(dg=make_digest(capacity, shards, every))
+
+
+class DigestDrain:
+    """Host-side drain of the digest ring: one cursor probe per drain, a
+    bulk fetch only when new rows exist (the FlightDrain recipe), each
+    row appended to ``digests.jsonl``:
+
+        {"window": 41, "t_end": 120000000,
+         "sums": {"pool": [..D ints..], ..per DIGEST_GROUPS..}}
+
+    Ring wrap between drains loses the oldest rows (`rows_lost`); size
+    the ring or the cadence so the gap between drains stays under
+    capacity when a complete record matters (the FlightDrain caveat)."""
+
+    def __init__(self, path: str | None = None, start: int = 0,
+                 mode: str = "w"):
+        self.path = path
+        self.rows = []
+        self.rows_lost = 0
+        self.shards = None
+        self.capacity = None
+        self.every = None
+        self._last = int(start)
+        self._f = open(path, mode) if path else None
+
+    def drain(self, state, profiler=None) -> int:
+        """Fetch rows appended since the last drain; returns how many."""
+        dg = getattr(state, "dg", None)
+        if dg is None:
+            return 0
+        import jax
+        from .core.state import DIGEST_GROUPS
+        p = profiler if profiler is not None else _active
+        with p.span("digest_drain"):
+            total = int(jax.device_get(dg.total))
+            p.transfer(8, count=1)
+            new = total - self._last
+            if new <= 0:
+                return 0
+            self.shards = dg.n_shards
+            self.capacity = c = dg.capacity
+            win, t_end, sums, every = jax.device_get(
+                (dg.win, dg.t_end, dg.sums, dg.every))
+            self.every = int(every)
+            p.transfer(win.nbytes + t_end.nbytes + sums.nbytes, count=1)
+            if new > c:
+                self.rows_lost += new - c
+                start = total - c
+            else:
+                start = self._last
+            for r in range(start, total):
+                k = r % c
+                row = {"window": int(win[k]), "t_end": int(t_end[k]),
+                       "sums": {g: sums[k, gi].tolist()
+                                for gi, g in enumerate(DIGEST_GROUPS)}}
+                self.rows.append(row)
+                if self._f is not None:
+                    self._f.write(json.dumps(row) + "\n")
+            if self._f is not None:
+                self._f.flush()
+            self._last = total
+            return new
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def summary(self) -> dict:
+        """Aggregate for the `digest` metrics section."""
+        out = {
+            "rows": len(self.rows),
+            "rows_lost": self.rows_lost,
+            "every": self.every,
+            "shards": self.shards or 1,
+        }
+        if self.rows:
+            out["first_window"] = self.rows[0]["window"]
+            out["last_window"] = self.rows[-1]["window"]
+        return out
 
 
 # ---------------------------------------------------------------------------

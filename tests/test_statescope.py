@@ -1,0 +1,350 @@
+"""Statescope: windowed state digests and first-divergence localization.
+
+docs/observability.md ("Statescope") promises for the digest block
+(trace.ensure_digests, engine._digest_record, shadow1_tpu.diff):
+
+* Structural zero cost when absent: a world that never had digests and
+  one that had them attached then detached lower to byte-identical HLO
+  (dg=None is a trace-time static), so undigested runs pay zero
+  compiled ops and a zero kernelcount delta.
+* Bitwise trajectory neutrality when present: the block only READS
+  trajectory state; every non-dg leaf of the final state is bitwise
+  identical to an undigested run.
+* Determinism: the same world digests to the identical row stream on
+  every run -- the property `shadow1-tpu diff` rests on.
+* Mesh invariance: the [G, D] checksum matrix is bitwise identical
+  between an 8-shard mesh run and a single-device run installed with
+  shards=8, and summing the D columns reproduces the shards=1 digest
+  (what lets diff compare a mesh run against a single-device run).
+* Localization: a run whose state is perturbed mid-run is localized by
+  diff_runs to the exact first divergent window, field group, field,
+  host, and element index via checkpoint-anchored re-execution.
+
+Plus the protocol checks: ensure_digests shard validation, the named
+diff refusals (non-run, undigested run, cadence mismatch), and the
+checkpoint-manifest digest stamp.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from shadow1_tpu import diff as diff_mod
+from shadow1_tpu import netem, replay, shapes, sim, trace
+from shadow1_tpu.core import engine, simtime
+from shadow1_tpu.core.state import DIGEST_GROUPS, DIGEST_SCHEMA, STAGE_FREE
+from shadow1_tpu.parallel import make_mesh, mesh_run_chunked
+
+MS = simtime.SIMTIME_ONE_MILLISECOND
+SEC = simtime.SIMTIME_ONE_SECOND
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _phold(**over):
+    kw = dict(num_hosts=16, msgs_per_host=2, mean_delay_ns=10 * MS,
+              stop_time=2 * SEC, pool_capacity=16 * 8, seed=7)
+    kw.update(over)
+    return sim.build_phold(**kw)
+
+
+def _netem_phold():
+    state, params, app = _phold(seed=4)
+    tl = netem.timeline()
+    tl.link_down(1, 9, at=50 * MS).link_up(1, 9, at=150 * MS)
+    tl.host_flap(3, down_at=80 * MS, up_at=220 * MS)
+    state, params = netem.install(state, params, tl)
+    return state, params, app
+
+
+def _rows(state):
+    """Drain the digest ring to row dicts (no file)."""
+    dd = trace.DigestDrain()
+    dd.drain(state)
+    return dd.rows
+
+
+class TestDeterminism:
+    @pytest.mark.tier0
+    def test_same_world_digests_identically(self):
+        # The tripwire itself must not wobble: two runs of the same
+        # world produce the identical row stream, bit for bit.
+        streams = []
+        for _ in range(2):
+            state, params, app = _phold(stop_time=SEC)
+            out = engine.run_chunked(
+                trace.ensure_digests(state), params, app, SEC)
+            streams.append(_rows(out))
+        assert streams[0], "no digest rows recorded"
+        assert streams[0] == streams[1]
+
+    def test_cadence_skips_windows(self):
+        state, params, app = _phold(stop_time=SEC)
+        out = engine.run_chunked(
+            trace.ensure_digests(state, every=4), params, app, SEC)
+        rows = _rows(out)
+        assert rows
+        wins = [r["window"] for r in rows]
+        assert all(w % 4 == 0 for w in wins)
+        assert wins == sorted(wins)
+
+
+class TestMeshInvariance:
+    @pytest.mark.tier0
+    def test_mesh_rows_equal_sharded_single(self):
+        # [G, D] bitwise identity: the 8-device mesh assembles (via
+        # all_gather) exactly the matrix a single device computes when
+        # installed with shards=8.  The netem world exercises the
+        # replicated-overlay column rule and the killed exclusion.
+        for build in (_phold, _netem_phold):
+            state, params, app = build()
+            t = SEC
+            single = engine.run_chunked(
+                trace.ensure_digests(state, shards=8), params, app, t)
+            mesh = make_mesh(jax.devices()[:8])
+            meshed = mesh_run_chunked(
+                trace.ensure_digests(state, shards=8), params, app, t,
+                mesh=mesh)
+            ra, rb = _rows(single), _rows(jax.device_get(meshed))
+            assert ra, f"{build.__name__}: no digest rows"
+            assert ra == rb, f"{build.__name__}: mesh digest diverged"
+
+    def test_column_sums_reduce_to_single_shard(self):
+        # Summing the D columns (wrapping i64) reproduces the shards=1
+        # digest -- the reduction diff applies when comparing a mesh
+        # run against a single-device run.
+        state, params, app = _phold(stop_time=SEC)
+        r1 = _rows(engine.run_chunked(
+            trace.ensure_digests(state), params, app, SEC))
+        r8 = _rows(engine.run_chunked(
+            trace.ensure_digests(state, shards=8), params, app, SEC))
+        assert len(r1) == len(r8)
+        for a, b in zip(r1, r8):
+            assert a["window"] == b["window"]
+            for g in DIGEST_GROUPS:
+                assert a["sums"][g] == [diff_mod._wrap_sum(b["sums"][g])]
+
+
+class TestStructuralCost:
+    def test_digest_absent_graph_identical_and_zero_kernel_delta(self):
+        # dg=None is a trace-time static: attach-then-detach lowers to
+        # byte-identical HLO, so the kernelcount delta is exactly 0.
+        state, params, app = _phold()
+        txt = engine.run_until.lower(state, params, app, SEC).as_text()
+        rt = trace.ensure_digests(state).replace(dg=None)
+        txt_rt = engine.run_until.lower(rt, params, app, SEC).as_text()
+        assert txt == txt_rt
+        kc = _load_tool("kernelcount")
+        assert kc.hlo_counts(txt) == kc.hlo_counts(txt_rt)
+        dg = trace.ensure_digests(state)
+        txt_dg = engine.run_until.lower(dg, params, app, SEC).as_text()
+        assert txt_dg != txt  # the digest phase really compiles in
+
+    def test_shape_key_discriminates_digests(self):
+        state, params, app = _phold()
+        k0 = shapes.shape_key(state, params)
+        k1 = shapes.shape_key(trace.ensure_digests(state), params)
+        assert k0 != k1
+        # ...but the key does NOT fragment on the cadence (every is
+        # traced data, not a shape).
+        k2 = shapes.shape_key(
+            trace.ensure_digests(state, every=4), params)
+        assert k1 == k2
+
+
+class TestTrajectoryNeutrality:
+    @pytest.mark.tier0
+    def test_phold_bitwise_neutral(self):
+        state, params, app = _phold()
+        bare = engine.run_chunked(state, params, app, 2 * SEC)
+        dig = engine.run_chunked(
+            trace.ensure_digests(state), params, app, 2 * SEC)
+        assert dig.dg is not None and int(dig.dg.total) > 0
+        la, ta = jax.tree_util.tree_flatten(bare)
+        lb, tb = jax.tree_util.tree_flatten(dig.replace(dg=None))
+        assert ta == tb
+        for x, y in zip(la, lb):
+            assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+KW = dict(num_hosts=16, msgs_per_host=2, mean_delay_ns=10 * MS,
+          stop_time=2 * SEC, pool_capacity=16 * 8, seed=7)
+EVERY = SEC // 2
+
+
+def _checkpointed_run(d, perturb_at=None, perturb=None):
+    """sim._run_checkpointed in miniature (single device, digest=1),
+    with a host-side perturbation hook between a launch and its
+    checkpoint save -- the fault-injection seam the localization test
+    drives.  Returns the final state."""
+    os.makedirs(d, exist_ok=True)
+    state, params, app = sim.build_phold(**KW)
+    state = trace.ensure_digests(state)
+    state = trace.ensure_flight_recorder(state)
+    flight = trace.FlightDrain(os.path.join(d, "windows.jsonl"))
+    digests = trace.DigestDrain(os.path.join(d, "digests.jsonl"))
+    ck = replay.Checkpointer(d, EVERY, devices=1, bucket=False,
+                             hosts_real=KW["num_hosts"])
+    replay.write_run_json(d, {
+        "world": {"kind": "builder", "name": "phold",
+                  "kwargs": dict(KW)},
+        "hb_ns": None, "every_ns": int(EVERY),
+        "stop_ns": int(KW["stop_time"]), "chunk_ns": engine.CHUNK_NS,
+        "devices": 1, "bucket": False,
+        "hosts_real": KW["num_hosts"], "scope": None, "profile": False,
+        "flight_rows": int(state.fr.steps.shape[0]), "lineage": None,
+        "digest": 1, "digest_rows": int(state.dg.capacity),
+        "sentinel": False, "supervise": False})
+    try:
+        ck.save(state, params)
+        tt, stop = 0, int(KW["stop_time"])
+        while tt < stop:
+            tt = replay.next_sync(tt, stop, every_ns=EVERY)
+            state = engine.run_chunked(state, params, app, tt)
+            if perturb_at is not None and tt == perturb_at:
+                state = perturb(state)
+            flight.drain(state)
+            digests.drain(state)
+            ck.maybe(state, params, tt)
+        return state
+    finally:
+        flight.close()
+        digests.close()
+
+
+class TestLocalization:
+    @pytest.mark.tier0
+    def test_fault_injection_localizes_window_group_host_element(
+            self, tmp_path):
+        # Seeded fault injection: flip one pool.time element at a slot
+        # that stays STAGE_FREE for the whole run, right before the
+        # mid-run checkpoint saves (so the snapshot carries the fault,
+        # exactly like real corruption would).  The digests must name
+        # the first divergent window, and the checkpoint-anchored
+        # re-execution must localize the exact field, host, and index.
+        a = str(tmp_path / "a")
+        final_a = _checkpointed_run(a)
+
+        # A slot untouched for the whole clean run: free at the end
+        # with its initial timestamp -- perturbing it cannot alter the
+        # trajectory, only the digest.
+        s0 = sim.build_phold(**KW)[0]
+        free = np.flatnonzero(
+            (np.asarray(final_a.pool.stage) == STAGE_FREE)
+            & (np.asarray(final_a.pool.time)
+               == np.asarray(s0.pool.time)))
+        assert free.size, "no never-allocated pool slot to perturb"
+        idx = int(free[-1])
+
+        def flip(st):
+            # Free slots park at T_NEVER (i64 max): subtract so the
+            # flip stays in range instead of wrapping.
+            return st.replace(pool=st.pool.replace(
+                time=st.pool.time.at[idx].add(-12345)))
+
+        b = str(tmp_path / "b")
+        final_b = _checkpointed_run(b, perturb_at=SEC, perturb=flip)
+
+        # The perturbation was trajectory-neutral: every non-dg leaf
+        # matches except the flipped element itself.
+        assert int(final_b.pool.time[idx]) == int(final_a.pool.time[idx]) \
+            - 12345
+        la = jax.tree_util.tree_flatten(final_a.replace(dg=None))[0]
+        lb = jax.tree_util.tree_flatten(final_b.replace(dg=None))[0]
+        fixed = np.asarray(final_b.pool.time).copy()
+        fixed[idx] += 12345
+        for x, y in zip(la, lb):
+            y = np.asarray(y)
+            if y.shape == fixed.shape and np.array_equal(
+                    y, np.asarray(final_b.pool.time)) \
+                    and not np.array_equal(np.asarray(x), y):
+                y = fixed
+            assert np.array_equal(np.asarray(x), y)
+
+        report = diff_mod.diff_runs(a, b)
+        div = report["divergence"]
+        assert div is not None and div["group"] == "pool"
+        # First divergent window: the first row recorded after the
+        # perturbation sync (rows at or before it were digested on
+        # device from clean state).
+        rows_a = diff_mod.load_digests(a)["rows"]
+        expect_w = min(r["window"] for r in rows_a
+                       if r["t_end"] > SEC)
+        assert div["window"] == expect_w
+
+        loc = report["localization"]
+        assert loc["groups_differing"] == ["pool"]
+        (field,) = loc["fields"]
+        assert field["field"] == "pool.time"
+        assert field["elements_differing"] == 1
+        el = field["first"][0]
+        per_host = int(s0.pool.capacity) // KW["num_hosts"]
+        assert el["flat_index"] == idx
+        assert el["host"] == idx // per_host
+        assert el["expected"] - el["got"] == 12345
+
+    def test_same_world_twice_agrees(self, tmp_path):
+        a = str(tmp_path / "a")
+        b = str(tmp_path / "b")
+        _checkpointed_run(a)
+        _checkpointed_run(b)
+        report = diff_mod.diff_runs(a, b)
+        assert report["divergence"] is None
+        assert report["windows_compared"] > 0
+
+
+class TestValidation:
+    def test_ensure_digests_validates_shards(self):
+        state, params, app = _phold()  # 16 hosts
+        s1 = trace.ensure_digests(state)
+        assert trace.ensure_digests(s1) is s1  # idempotent
+        with pytest.raises(ValueError, match="pad_world_to_mesh"):
+            trace.ensure_digests(state, shards=5)  # 16 % 5 != 0
+
+    def test_diff_refuses_non_run_dir(self):
+        with pytest.raises(diff_mod.DiffUsageError,
+                           match="not a run data directory"):
+            diff_mod.diff_runs("/nonexistent/a", "/nonexistent/b")
+
+    def test_diff_refuses_undigested_run(self, tmp_path):
+        a = str(tmp_path / "a")
+        os.makedirs(a)
+        with pytest.raises(diff_mod.DiffUsageError,
+                           match="--digest-every"):
+            diff_mod.diff_runs(a, a)
+
+    def test_diff_refuses_cadence_mismatch(self, tmp_path):
+        def fake(d, step):
+            os.makedirs(d)
+            with open(os.path.join(d, "digests.jsonl"), "w") as f:
+                for w in range(0, 4 * step, step):
+                    row = {"window": w, "t_end": (w + 1) * 1000,
+                           "sums": {g: [0] for g in DIGEST_GROUPS}}
+                    f.write(json.dumps(row) + "\n")
+            return d
+        a = fake(str(tmp_path / "a"), 1)
+        b = fake(str(tmp_path / "b"), 2)
+        with pytest.raises(diff_mod.DiffUsageError,
+                           match="cadence mismatch"):
+            diff_mod.diff_runs(a, b)
+
+    def test_manifest_stamps_digest_config(self, tmp_path):
+        d = str(tmp_path / "run")
+        state, params, app = _phold(stop_time=SEC)
+        sim.run(state, params, app, digest=2, checkpoint_every=EVERY,
+                checkpoint_dir=d, checkpoint_world=("phold", KW))
+        _, man = replay.find_checkpoint(d, None)
+        assert man["digest"] == {"every": 2, "schema": DIGEST_SCHEMA,
+                                 "shards": 1}

@@ -54,7 +54,8 @@ _MACHINE_BOUND = ("events_per_sec", "value", "vs_baseline", "wall_sec",
 # time never flags across environments.  The flowscope drain costs
 # (profile.scope.*) are host-side fetch/merge wall times, same class.
 _MACHINE_BOUND_PREFIXES = ("profile.flight.", "profile.scope.",
-                           "profile.lineage.", "mesh.")
+                           "profile.lineage.", "profile.digest.",
+                           "mesh.")
 
 
 def _machine_bound(name: str) -> bool:
@@ -163,6 +164,22 @@ def _lineage_config(d: dict):
         return cfg["lineage"]
     if isinstance(d.get("lineage"), dict):
         return d["lineage"].get("rate")
+    return _UNSTAMPED
+
+
+def _digest_config(d: dict):
+    """Normalized statescope config of a run: the config.digest stamp
+    (a cadence in windows, None when digests were off), or _UNSTAMPED
+    for files written before bench.py stamped it.  The digest phase
+    compiles checksum reductions into the window loop, so digested vs
+    bare runs (or different cadences) measure different programs --
+    the lineage rule.  A metrics.json's `digest` summary section also
+    marks a digested run (its `every` field is the cadence)."""
+    cfg = d.get("config")
+    if isinstance(cfg, dict) and "digest" in cfg:
+        return cfg["digest"]
+    if isinstance(d.get("digest"), dict):
+        return d["digest"].get("every")
     return _UNSTAMPED
 
 
@@ -376,6 +393,18 @@ def main(argv=None) -> int:
               f"packet-lineage configs (old lineage={ln_old!r}, "
               f"new lineage={ln_new!r}); re-record with matching "
               f"--trace-packets settings", file=sys.stderr)
+        return 2
+    dg_old, dg_new = _digest_config(old), _digest_config(new)
+    if dg_old is not _UNSTAMPED and dg_new is not _UNSTAMPED \
+            and dg_old != dg_new:
+        # Statescope digests compile checksum reductions into the
+        # window loop, so digested vs bare runs (or different cadences)
+        # measure different programs -- the lineage rule.  Unstamped
+        # legacy files pass.
+        print(f"benchdiff: refusing to compare runs with different "
+              f"statescope digest configs (old digest={dg_old!r}, "
+              f"new digest={dg_new!r}); re-record with matching "
+              f"--digest-every settings", file=sys.stderr)
         return 2
     mk_old, mk_new = _megakernel_config(old), _megakernel_config(new)
     if mk_old is not None and mk_new is not None and mk_old != mk_new:

@@ -1,0 +1,214 @@
+"""Three-axis divergence harness for Statescope digests.
+
+Drives `shadow1_tpu.diff` along the three comparison axes the digest
+layer promises (docs/observability.md "Statescope"):
+
+* run-vs-run     -- the same world at the same seed twice must agree
+                    bitwise; two seeds must DIVERGE, and the diff must
+                    localize the first divergent (window, field group)
+                    down to elements via checkpoint re-execution.
+* mesh-vs-single -- an 8-virtual-device run's digest stream must agree
+                    with the single-device run of the same world after
+                    shard reduction (wrap-sum over columns), for the
+                    phold, bulk-TCP, and netem worlds.
+* backend-vs-backend -- the fused (params.megakernel) and reference
+                    window loops must produce identical digest streams.
+
+Usage:
+
+    python tools/divergediff.py [--axis run|mesh|backend|all]
+
+Exits nonzero on any unexpected divergence (mesh/backend axes, the
+same-seed pair) or unexpected agreement (the cross-seed pair).  Runs
+on CPU with 8 virtual devices; no TPU required.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+
+# Virtual 8-device CPU mesh -- must be set before jax imports.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from shadow1_tpu import diff as diff_mod  # noqa: E402
+from shadow1_tpu import netem, sim, trace  # noqa: E402
+from shadow1_tpu.core import simtime  # noqa: E402
+
+MS = simtime.SIMTIME_ONE_MILLISECOND
+SEC = simtime.SIMTIME_ONE_SECOND
+
+# Host counts divisible by 8 so pad_world_to_mesh is an identity and
+# the single-device world is bitwise the same one the mesh runs.
+def _phold(seed=7):
+    return sim.build_phold(num_hosts=16, msgs_per_host=2,
+                           mean_delay_ns=10 * MS, stop_time=SEC,
+                           pool_capacity=16 * 8, seed=seed)
+
+
+def _bulk():
+    return sim.build_bulk(num_hosts=8, bytes_per_client=1 << 14,
+                          reliability=0.9, stop_time=2 * SEC)
+
+
+def _netem():
+    state, params, app = sim.build_phold(
+        num_hosts=16, msgs_per_host=2, mean_delay_ns=10 * MS,
+        stop_time=SEC, pool_capacity=16 * 8, seed=4)
+    tl = netem.timeline()
+    tl.link_down(1, 9, at=50 * MS).link_up(1, 9, at=150 * MS)
+    tl.host_flap(3, down_at=80 * MS, up_at=220 * MS)
+    state, params = netem.install(state, params, tl)
+    return state, params, app
+
+
+WORLDS = {"phold": _phold, "bulk": _bulk, "netem": _netem}
+
+
+def _record(out, build, *, devices=None, megakernel=None,
+            checkpoint=False, world_name=None, world_kw=None):
+    """Run a world with digest=1 and leave digests.jsonl under `out`.
+
+    Checkpointed runs drain through sim.run itself (ckpt/ + run.json,
+    so diff can re-execute); bare runs drain the ring once at the end
+    -- the ring capacity (4096) far exceeds these short runs' windows.
+    """
+    os.makedirs(out, exist_ok=True)
+    state, params, app = build()
+    if megakernel is not None:
+        params = params.replace(megakernel=megakernel)
+    if checkpoint:
+        sim.run(state, params, app, devices=devices, digest=1,
+                checkpoint_every=SEC // 2, checkpoint_dir=out,
+                checkpoint_world=(world_name, world_kw))
+        return out
+    final = sim.run(state, params, app, devices=devices, digest=1)
+    dd = trace.DigestDrain(os.path.join(out, "digests.jsonl"))
+    dd.drain(final)
+    dd.close()
+    return out
+
+
+def _expect_agree(label, dir_a, dir_b, **kw):
+    report = diff_mod.diff_runs(dir_a, dir_b, localize=False, **kw)
+    if report["divergence"]:
+        d = report["divergence"]
+        print(f"FAIL {label}: unexpected divergence at window "
+              f"{d['window']} (group {d['group']!r})")
+        return False
+    print(f"ok   {label}: {report['windows_compared']} window(s) agree")
+    return True
+
+
+def axis_run() -> bool:
+    """run-vs-run: same seed agrees; cross-seed diverges AND localizes."""
+    ok = True
+    base = tempfile.mkdtemp(prefix="divergediff_run_")
+    try:
+        kw = dict(num_hosts=16, msgs_per_host=2, mean_delay_ns=10 * MS,
+                  stop_time=SEC, pool_capacity=16 * 8, seed=7)
+        a = _record(os.path.join(base, "a"), lambda: _phold(7),
+                    checkpoint=True, world_name="phold", world_kw=kw)
+        a2 = _record(os.path.join(base, "a2"), lambda: _phold(7),
+                     checkpoint=True, world_name="phold", world_kw=kw)
+        ok &= _expect_agree("run-vs-run same seed", a, a2)
+
+        kw8 = dict(kw, seed=8)
+        b = _record(os.path.join(base, "b"), lambda: _phold(8),
+                    checkpoint=True, world_name="phold", world_kw=kw8)
+        report = diff_mod.diff_runs(a, b, localize=True)
+        d = report.get("divergence")
+        if not d:
+            print("FAIL run-vs-run cross seed: expected divergence, "
+                  "streams agree")
+            ok = False
+        else:
+            loc = report.get("localization") or {}
+            fields = loc.get("fields") or []
+            if not fields:
+                print(f"FAIL run-vs-run cross seed: diverged at window "
+                      f"{d['window']} but localization named no fields")
+                ok = False
+            else:
+                print(f"ok   run-vs-run cross seed: diverged at window "
+                      f"{d['window']} group {d['group']!r}, "
+                      f"{len(fields)} field(s) localized "
+                      f"(first: {fields[0]['field']})")
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return ok
+
+
+def axis_mesh() -> bool:
+    """mesh-vs-single: 8-shard digest streams reduce to the 1-device
+    stream for every world."""
+    import jax
+    if len(jax.devices()) < 8:
+        print(f"FAIL mesh-vs-single: only {len(jax.devices())} "
+              f"device(s) visible (XLA_FLAGS was set too late?)")
+        return False
+    ok = True
+    base = tempfile.mkdtemp(prefix="divergediff_mesh_")
+    try:
+        for name, build in WORLDS.items():
+            one = _record(os.path.join(base, f"{name}_1"), build)
+            eight = _record(os.path.join(base, f"{name}_8"), build,
+                            devices=8)
+            ok &= _expect_agree(f"mesh-vs-single {name}", one, eight)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return ok
+
+
+def axis_backend() -> bool:
+    """backend-vs-backend: fused and reference window loops digest
+    identically."""
+    ok = True
+    base = tempfile.mkdtemp(prefix="divergediff_backend_")
+    try:
+        for name, build in WORLDS.items():
+            fused = _record(os.path.join(base, f"{name}_mk"), build,
+                            megakernel=True)
+            ref = _record(os.path.join(base, f"{name}_ref"), build,
+                          megakernel=False)
+            ok &= _expect_agree(f"backend-vs-backend {name}", fused, ref)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return ok
+
+
+AXES = {"run": axis_run, "mesh": axis_mesh, "backend": axis_backend}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="statescope divergence harness: run-vs-run, "
+                    "mesh-vs-single, backend-vs-backend")
+    ap.add_argument("--axis", choices=sorted(AXES) + ["all"],
+                    default="all")
+    args = ap.parse_args(argv)
+    axes = sorted(AXES) if args.axis == "all" else [args.axis]
+    ok = True
+    for name in axes:
+        print(f"[divergediff] axis: {name}")
+        ok &= AXES[name]()
+    if not ok:
+        print("divergediff: FAILED", file=sys.stderr)
+        return 1
+    print("divergediff: all axes passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,8 +21,17 @@ exchange-matrix cells that differ -- the triage tool the
 trace.ReplayDivergence error points at (docs/observability.md
 "Time-travel replay").
 
+`digests` digests a digests.jsonl statescope record (trace.DigestDrain
+format, from --digest-every runs) into a change-activity timeline: per
+field-group, how many recorded windows changed that group's checksum
+(settled groups stop changing -- e.g. the netem group goes quiet after
+its last event), the windows where each group last changed, and the
+stream's span/cadence/shard layout (docs/observability.md
+"Statescope").  For comparing two streams use `shadow1-tpu diff`.
+
 Usage: tools/parse.py <data-directory> [--json out.json] [--top N]
        tools/parse.py spans <data-dir-or-spans.jsonl> [--top N]
+       tools/parse.py digests <data-dir-or-digests.jsonl> [--top N]
        tools/parse.py replaydiff <a/windows.jsonl> <b/windows.jsonl>
 """
 
@@ -69,6 +78,11 @@ def parse_dir(data_dir: str, top: int = 10) -> dict:
         if os.path.exists(os.path.join(data_dir, "spans.jsonl")) else None
     if spans is not None:
         out["lineage"] = spans
+    digests = parse_digests(data_dir, top=top) \
+        if os.path.exists(os.path.join(data_dir, "digests.jsonl")) \
+        else None
+    if digests is not None:
+        out["digests"] = digests
     return out
 
 
@@ -222,6 +236,49 @@ def parse_spans(path: str, top: int = 10) -> dict | None:
     }
 
 
+def parse_digests(path: str, top: int = 10) -> dict | None:
+    """Digest digests.jsonl (trace.DigestDrain format) into a
+    change-activity timeline: per field-group, how many recorded
+    windows changed that group's checksum vs the previous row, and the
+    window where it last changed.  A group whose state has settled
+    (netem after its last event, app after every stream completes)
+    stops changing -- the timeline shows when.  Accepts a data
+    directory or the jsonl path."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "digests.jsonl")
+    rows = _load_jsonl(path)
+    if rows is None:
+        return None
+    if not rows:
+        return {"rows": 0}
+    groups = list(rows[0]["sums"])
+    changed = {g: 0 for g in groups}
+    last_change = {g: None for g in groups}
+    prev = None
+    for r in rows:
+        if prev is not None:
+            for g in groups:
+                if r["sums"][g] != prev["sums"][g]:
+                    changed[g] += 1
+                    last_change[g] = r["window"]
+        prev = r
+    windows = [r["window"] for r in rows]
+    cadence = windows[1] - windows[0] if len(rows) > 1 else None
+    active = sorted(groups, key=lambda g: -changed[g])
+    return {
+        "rows": len(rows),
+        "window_span": [windows[0], windows[-1]],
+        "t_end_span": [rows[0]["t_end"], rows[-1]["t_end"]],
+        "cadence_windows": cadence,
+        "shards": len(rows[0]["sums"][groups[0]]),
+        "groups": groups,
+        "windows_changed": changed,
+        "last_change_window": last_change,
+        "most_active_groups": active[:top],
+        "quiet_groups": [g for g in groups if changed[g] == 0],
+    }
+
+
 def _load_windows(path: str) -> dict:
     """windows.jsonl rows keyed by global window index.  Accepts a data
     directory or the jsonl path itself."""
@@ -313,6 +370,25 @@ def main(argv=None) -> int:
         if digest is None:
             print(f"error: {args.path}: no spans.jsonl record",
                   file=sys.stderr)
+            return 2
+        text = json.dumps(digest, indent=2, sort_keys=True)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(text + "\n")
+        print(text)
+        return 0
+    if argv and argv[0] == "digests":
+        ap = argparse.ArgumentParser(prog="parse.py digests")
+        ap.add_argument("path", help="digests.jsonl (or its data dir)")
+        ap.add_argument("--json", default=None,
+                        help="also write to this file")
+        ap.add_argument("--top", type=int, default=10,
+                        help="most-active-groups list length")
+        args = ap.parse_args(argv[1:])
+        digest = parse_digests(args.path, top=args.top)
+        if digest is None:
+            print(f"error: {args.path}: no digests.jsonl record "
+                  f"(re-run with --digest-every)", file=sys.stderr)
             return 2
         text = json.dumps(digest, indent=2, sort_keys=True)
         if args.json:

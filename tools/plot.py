@@ -33,6 +33,17 @@ when spans.jsonl is absent:
                       dropped packets drawn in red with the reason of
                       the fatal hop
 
+When the run recorded statescope digests (`--digest-every N`,
+trace.DigestDrain format) one more panel appears, skipped silently
+when digests.jsonl is absent:
+  digests.png      -- change-activity raster: one row per state
+                      field-group, one cell per recorded window,
+                      filled where that window changed the group's
+                      checksum -- settled groups (netem after its last
+                      event, app after the last stream) go visibly
+                      quiet, and comparing two runs' rasters shows
+                      where their trajectories part
+
 Rate columns are step-held per host between its rows, so hosts on
 different per-host heartbeat cadences aggregate without sawtooth
 artifacts; delta columns (packets, drops) are summed at the timestamps
@@ -86,6 +97,12 @@ def load_spans(data_dir: str):
     """Packet-lineage span rows from spans.jsonl (trace.LineageDrain
     format), or None when the run traced no packets."""
     return _load_jsonl(os.path.join(data_dir, "spans.jsonl"))
+
+
+def load_digests(data_dir: str):
+    """Statescope digest rows from digests.jsonl (trace.DigestDrain
+    format), or None when the run recorded no digests."""
+    return _load_jsonl(os.path.join(data_dir, "digests.jsonl"))
 
 
 def _load_jsonl(path: str):
@@ -335,6 +352,36 @@ def main(data_dir: str, out_dir: str | None = None) -> list:
         f.savefig(p, dpi=110, bbox_inches="tight")
         plt.close(f)
         written.append(p)
+
+    drows = load_digests(data_dir)
+    if drows:
+        # Change-activity raster: row = field group, column = recorded
+        # window, cell filled where the window changed the group's
+        # checksum vs the previous row.  The all-or-nothing view of the
+        # same data `shadow1-tpu diff` compares: a healthy steady-state
+        # run shows solid stripes for the hot groups (pool, hosts) and
+        # early-settling ones going dark (netem after its last event).
+        groups = list(drows[0]["sums"])
+        grid = [[0.0] * (len(drows) - 1) for _ in groups]
+        for c in range(1, len(drows)):
+            for gi, g in enumerate(groups):
+                if drows[c]["sums"][g] != drows[c - 1]["sums"][g]:
+                    grid[gi][c - 1] = 1.0
+        if grid and grid[0]:
+            w0 = drows[1]["window"]
+            w1 = drows[-1]["window"]
+            f, ax = plt.subplots(figsize=(8, 0.45 * len(groups) + 1.2))
+            ax.imshow(grid, cmap="Blues", aspect="auto", vmin=0.0,
+                      vmax=1.0, extent=(w0 - 0.5, w1 + 0.5,
+                                        len(groups) - 0.5, -0.5))
+            ax.set_title("State-digest change activity per field group")
+            ax.set_xlabel("window")
+            ax.set_yticks(range(len(groups)))
+            ax.set_yticklabels(groups)
+            p = os.path.join(out_dir, "digests.png")
+            f.savefig(p, dpi=110, bbox_inches="tight")
+            plt.close(f)
+            written.append(p)
 
     for p in written:
         print(p)

@@ -14,6 +14,8 @@ pin per subsystem:
   - replay       test_replay.py        checkpoint replay verifies bitwise
   - megakernel   test_megakernel.py    fused vs reference trajectories
   - lineage      test_lineage.py       traced vs untraced trajectories
+  - statescope   test_statescope.py    digest determinism, mesh digest
+                                       identity, fault localization
 
 Together they run in well under five minutes on the virtual 8-device
 CPU mesh, giving a fast did-I-break-determinism signal before paying
