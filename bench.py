@@ -242,6 +242,12 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
             # both-stamped mismatch on either.  bench.py runs bare.
             "sentinel": False,
             "supervise": False,
+            # Serve stamp: a run executed inside the resident run
+            # server (shadow1_tpu/server.py) shares its process with
+            # other tenants and its compile cache with prior requests,
+            # so its wall-clock is not comparable to a solo run's.
+            # bench.py always runs solo.
+            "serve": False,
         },
         # Wall-clock numbers are only comparable between runs on the
         # same backend and core count; benchdiff downgrades machine-
@@ -417,6 +423,7 @@ def main_multichip(n_devices: int, gate_against: str | None = None) -> int:
             "checkpoint_every": None,
             "sentinel": False,
             "supervise": False,
+            "serve": False,
         },
         "env": {
             "backend": top["backend"],

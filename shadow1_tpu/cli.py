@@ -69,14 +69,18 @@ def world_args(args) -> dict:
     return d
 
 
-def _parser():
-    p = argparse.ArgumentParser(
-        prog="shadow1-tpu",
-        description="TPU-native discrete-event network simulator "
-                    "(shadow.config.xml compatible)")
-    sub = p.add_subparsers(dest="cmd", required=True)
-    r = sub.add_parser("run", help="run a simulation config")
-    r.add_argument("config", help="shadow.config.xml path")
+def _add_run_flags(r, *, config_required: bool = True):
+    """The full run-flag surface, shared verbatim by `run` and
+    `submit`: a submit spec is exactly a run invocation shipped over
+    the serve socket, so the two surfaces can never drift apart.
+    `config_required=False` makes the config positional optional
+    (submit also accepts --world / --replay request kinds)."""
+    if config_required:
+        r.add_argument("config", help="shadow.config.xml path")
+    else:
+        r.add_argument("config", nargs="?", default=None,
+                       help="shadow.config.xml path (or pass --world / "
+                            "--replay instead)")
     r.add_argument("--seed", type=int, default=1,
                    help="root RNG seed (reference --seed)")
     r.add_argument("--stop-time", type=int, default=None,
@@ -243,7 +247,30 @@ def _parser():
                         "device launch; a launch that exceeds it is "
                         "classified 'hung' and the run surrenders with "
                         "crash.json (in-process recovery is unsafe while "
-                        "a launch thread may hold the device)")
+                        "a launch thread may hold the device).  Armed "
+                        "only after the first launch completes: a cold "
+                        "graph's compile time never counts against the "
+                        "deadline (docs/robustness.md)")
+
+
+def _add_client_flags(p):
+    """Socket discovery shared by submit/status/cancel."""
+    p.add_argument("--server", metavar="DIR", default=None,
+                   help="the server's --data-directory; the socket is "
+                        "found at DIR/server/sock")
+    p.add_argument("--socket", metavar="PATH", default=None,
+                   help="explicit serve socket path (overrides "
+                        "--server)")
+
+
+def _parser():
+    p = argparse.ArgumentParser(
+        prog="shadow1-tpu",
+        description="TPU-native discrete-event network simulator "
+                    "(shadow.config.xml compatible)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    r = sub.add_parser("run", help="run a simulation config")
+    _add_run_flags(r, config_required=True)
 
     rp = sub.add_parser(
         "replay",
@@ -352,6 +379,102 @@ def _parser():
                         "worlds, bulk-scope the --scope-sampled variant "
                         "so flowscope runs hit the warm cache too)")
     w.add_argument("--quiet", action="store_true")
+
+    sv = sub.add_parser(
+        "serve",
+        help="resident run server (docs/robustness.md 'Run server'): "
+             "warm the standard buckets once, then accept submit/"
+             "status/cancel requests over DATA_DIR/server/sock, "
+             "running each under per-request supervision with a "
+             "crash-safe write-ahead journal; SIGTERM drains "
+             "(checkpoint + park in-flight runs, exit 0)")
+    sv.add_argument("--data-directory", required=True,
+                    help="server root: server/ (socket + journal) and "
+                         "runs/<id>/ per-request data directories")
+    sv.add_argument("--queue-limit", type=int, default=8, metavar="N",
+                    help="max WAITING requests (default 8); a submit "
+                         "past the limit is refused loudly with rc 2 "
+                         "naming the depth and this knob (0 refuses "
+                         "every submit -- useful for drills)")
+    sv.add_argument("--workers", type=int, default=1, metavar="N",
+                    help="concurrent request executors (default 1: "
+                         "strict warm-graph affinity; raise it when "
+                         "the accelerator has memory for concurrent "
+                         "worlds)")
+    sv.add_argument("--checkpoint-every", type=float, default=2.0,
+                    metavar="SECONDS",
+                    help="default checkpoint cadence applied to "
+                         "requests that set none (every request runs "
+                         "checkpointed -- crash-safety requires an "
+                         "anchor; default 2.0)")
+    sv.add_argument("--watchdog", type=float, default=None,
+                    metavar="SECONDS",
+                    help="default per-launch watchdog applied to "
+                         "requests that set none")
+    sv.add_argument("--auto-resume", action="store_true",
+                    help="re-admit journaled queued/running/parked "
+                         "requests from a previous server life; each "
+                         "in-flight run resumes from its newest "
+                         "checkpoint, bitwise identical to an "
+                         "uninterrupted run")
+    sv.add_argument("--no-warm", action="store_true",
+                    help="skip the background AOT bucket warm")
+    sv.add_argument("--warm-apps", nargs="+", default=("phold", "bulk"),
+                    choices=("phold", "bulk", "tgen", "onion", "gossip",
+                             "bulk-scope"),
+                    help="world flavors to warm (default phold + bulk)")
+    sv.add_argument("--warm-buckets", type=int, nargs="+", default=None,
+                    metavar="H",
+                    help="bucket sizes to warm (default: the standard "
+                         "set)")
+    sv.add_argument("--quiet", action="store_true")
+
+    sb = sub.add_parser(
+        "submit",
+        help="submit a scenario to a running `serve` instance and (by "
+             "default) stream its progress until done, exiting with "
+             "the run's rc -- the same unified exit-code table as "
+             "`run`")
+    _add_run_flags(sb, config_required=False)
+    _add_client_flags(sb)
+    sb.add_argument("--world", metavar="NAME", default=None,
+                    help="builder request: run sim.build_NAME(...) "
+                         "server-side instead of a config file (e.g. "
+                         "phold, bulk, tgen, gossip, onion)")
+    sb.add_argument("--world-kwargs", metavar="JSON", default=None,
+                    help="JSON kwargs for --world (e.g. "
+                         "'{\"num_hosts\": 64, \"seed\": 3}')")
+    sb.add_argument("--replay", metavar="RUN", default=None,
+                    help="replay request: time-travel replay of RUN (a "
+                         "server run id, or a checkpointed data "
+                         "directory) as a service request")
+    sb.add_argument("--window", type=int, default=None, metavar="K",
+                    help="with --replay: target global window index "
+                         "(default: the last recorded window)")
+    sb.add_argument("--timeout", type=float, default=None,
+                    metavar="SECONDS",
+                    help="per-request wall-clock budget, queued time "
+                         "included; an expired request is refused / "
+                         "stopped with rc 2 naming this knob")
+    sb.add_argument("--no-wait", action="store_true",
+                    help="print the request id and return immediately "
+                         "instead of streaming to completion")
+
+    st = sub.add_parser(
+        "status",
+        help="one run's record (state, rc, trail, crash path) or the "
+             "whole server snapshot")
+    st.add_argument("id", nargs="?", default=None,
+                    help="request id (omit for the full snapshot)")
+    _add_client_flags(st)
+    st.add_argument("--wait", action="store_true",
+                    help="with an id: block until the run settles and "
+                         "exit with its rc")
+
+    cn = sub.add_parser("cancel", help="cancel a queued or running "
+                                       "request (rc 3 on its record)")
+    cn.add_argument("id", help="request id")
+    _add_client_flags(cn)
     return p
 
 
@@ -607,7 +730,30 @@ def build_world(args, *, quiet: bool = False, want_mesh: bool = True,
         want_pcap=want_pcap, host_lvls=host_lvls)
 
 
-def run_config(args) -> int:
+class _EmitStream:
+    """A write/flush file-object shim that forwards Progress's status
+    lines as {"event": "progress"} records to an emit callback -- the
+    run server relays them to the submitting client."""
+
+    def __init__(self, emit):
+        self._emit = emit
+
+    def write(self, s):
+        if s and s.strip():
+            self._emit({"event": "progress", "line": s})
+
+    def flush(self):
+        pass
+
+
+def run_config(args, *, control=None, emit=None) -> int:
+    """Execute a `run` invocation.  `control` / `emit` are the run
+    server's hooks (server.RunControl + an event callback): the loop
+    polls `control` at every launch boundary -- "park" checkpoints and
+    stops (control.outcome="parked", rc 0), "cancel" stops (rc 3),
+    "timeout" stops with a refusal naming --timeout (rc 2) -- and
+    `emit` receives progress/summary/crash events for relay.  Both
+    default to None: the batch CLI path is unchanged."""
     import os
 
     from . import trace
@@ -784,7 +930,24 @@ def run_config(args) -> int:
         ck = replay_mod.Checkpointer(
             args.data_directory, ck_every_ns, devices=n_dev,
             bucket=args.bucket, hosts_real=len(asm.hostnames))
-        if resumed_from is None:
+        write_recipe = resumed_from is None
+        if resumed_from is not None:
+            # Torn-file hardening parity (docs/robustness.md): a torn
+            # run.json -- the process died inside a legacy non-atomic
+            # write, or the file was damaged externally -- must not
+            # strand an otherwise resumable run.  The recipe is a pure
+            # function of the current flags, so rewrite it from them.
+            try:
+                replay_mod.load_run(args.data_directory)
+            except (FileNotFoundError, ValueError,
+                    json.JSONDecodeError) as e:
+                import warnings
+                warnings.warn(
+                    f"auto-resume: ckpt/run.json is unreadable ({e}); "
+                    f"rewriting the replay recipe from the current "
+                    f"flags", RuntimeWarning, stacklevel=1)
+                write_recipe = True
+        if write_recipe:
             replay_mod.write_run_json(args.data_directory, {
                 "world": {"kind": "config", "args": world_args(args)},
                 "hb_ns": tracker.sample_interval_ns if tracker else None,
@@ -800,6 +963,7 @@ def run_config(args) -> int:
                 "digest_rows": (int(state.dg.capacity)
                                 if state.dg is not None else None),
                 "sentinel": supervise_on, "supervise": supervise_on})
+        if resumed_from is None:
             ck.save(state, params)  # win_0: a replay anchor always exists
         if not args.quiet:
             print(f"[shadow1-tpu] checkpoints: every "
@@ -809,7 +973,9 @@ def run_config(args) -> int:
     progress = None
     if args.progress:
         from .observe import Progress
-        progress = Progress(int(stop))
+        progress = Progress(int(stop),
+                            out=_EmitStream(emit) if emit is not None
+                            else None)
 
     from .replay import next_sync
     if mesh is not None:
@@ -838,8 +1004,39 @@ def run_config(args) -> int:
     drains = Drains(tracker=tracker, log=drain, flight=flight,
                     scope=scope, spans=spans, digests=digests,
                     profiler=profiler)
+    def _close_drains():
+        for closer in (flight, drain, spans, digests, scope):
+            if closer is not None:
+                try:
+                    closer.close()
+                except Exception:
+                    pass
+
     try:
         while t < stop:
+            act = control.poll() if control is not None else None
+            if act is not None:
+                # The run server asked this request to stop at a launch
+                # boundary: park (checkpoint now, resume on the next
+                # --auto-resume life), cancel, or a --timeout expiry.
+                if act == "park":
+                    if ck is not None:
+                        ck.save(state, params)
+                    control.outcome = "parked"
+                    _close_drains()
+                    if emit is not None:
+                        emit({"event": "parked", "t_ns": int(t),
+                              "window": int(state.n_windows)})
+                    return RC_OK
+                if act == "cancel":
+                    control.outcome = "cancelled"
+                    _close_drains()
+                    return RC_FAILED
+                control.outcome = "timed_out"
+                _close_drains()
+                print(f"error: run stopped at t={t / SEC:g}s: "
+                      f"--timeout expired", file=sys.stderr)
+                return RC_USAGE
             # Advance to the next launch boundary on the memoryless
             # union grid of heartbeat and checkpoint multiples
             # (replay.next_sync): the tracker samples between bounded
@@ -865,14 +1062,12 @@ def run_config(args) -> int:
             if progress is not None:
                 progress.update(state, t)
     except UnrecoveredFailure as e:
-        for closer in (flight, drain, spans, digests):
-            if closer is not None:
-                try:
-                    closer.close()
-                except Exception:
-                    pass
+        _close_drains()
         print(f"error: {e}", file=sys.stderr)
         print(json.dumps({"crash": e.crash}))
+        if emit is not None:
+            emit({"event": "crash", "rc": e.rc, "crash": e.crash,
+                  "path": e.path})
         return e.rc
     if progress is not None:
         progress.update(state, t, force=True)
@@ -996,6 +1191,8 @@ def run_config(args) -> int:
             print(profiler.summary_table(), file=sys.stderr)
         trace.install(None)
     print(json.dumps(summary))
+    if emit is not None:
+        emit({"event": "summary", "summary": summary})
     if substrate is not None and summary["processes_failed"]:
         return RC_FAILED
     # A set err bitmask means the simulation violated its own capacity
@@ -1098,6 +1295,18 @@ def main(argv=None) -> int:
         return diff_cmd(args)
     if args.cmd == "warm":
         return warm_cmd(args)
+    if args.cmd == "serve":
+        from .server import serve
+        return serve(args)
+    if args.cmd == "submit":
+        from .client import submit_cmd
+        return submit_cmd(args)
+    if args.cmd == "status":
+        from .client import status_cmd
+        return status_cmd(args)
+    if args.cmd == "cancel":
+        from .client import cancel_cmd
+        return cancel_cmd(args)
     return RC_USAGE
 
 

@@ -1,0 +1,230 @@
+"""Client half of the run server: `shadow1-tpu submit/status/cancel`.
+
+Thin and synchronous: each command opens one connection to the serve
+socket (protocol.py), sends one request, and -- for `submit --wait` /
+`status --wait` -- relays the streamed progress/summary events until
+the terminal `done`, exiting with the RUN'S rc.  The unified exit-code
+table (supervise.py) therefore holds across the service boundary: the
+rc a scenario would exit the batch CLI with is the rc the submitting
+client exits with, and every refusal (queue full, bad spec, timeout,
+draining server) is rc 2 with the responsible knob named in the
+message.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from . import protocol
+from .supervise import RC_FAILED, RC_OK, RC_USAGE
+
+
+def _socket_path(args) -> str | None:
+    """Resolve the serve socket from --socket / --server; None (after
+    printing the usage error) when neither is given."""
+    if getattr(args, "socket", None):
+        return args.socket
+    if getattr(args, "server", None):
+        return protocol.default_socket(args.server)
+    print("error: pass --server DIR (the serve --data-directory) or "
+          "--socket PATH to locate the run server", file=sys.stderr)
+    return None
+
+
+def _build_submit(args):
+    """(kind, spec) from the submit flags, or (None, error-message).
+    Exactly one of CONFIG / --world / --replay selects the request
+    kind; the spec is what the server's worker needs to reconstruct
+    the run on its side."""
+    from .cli import world_args
+    modes = [bool(args.config), bool(args.world), bool(args.replay)]
+    if sum(modes) != 1:
+        return None, ("pass exactly one of CONFIG (a shadow.config.xml "
+                      "path), --world NAME, or --replay RUN")
+    if args.config:
+        spec = world_args(args)
+        for k in ("heartbeat_frequency", "quiet", "watchdog"):
+            spec[k] = getattr(args, k, None)
+        spec["progress"] = bool(args.progress)
+        return ("config", spec), None
+    if args.world:
+        try:
+            kwargs = json.loads(args.world_kwargs) \
+                if args.world_kwargs else {}
+        except json.JSONDecodeError as e:
+            return None, f"--world-kwargs is not valid JSON: {e}"
+        if not isinstance(kwargs, dict):
+            return None, "--world-kwargs must be a JSON object"
+        spec = {"name": args.world, "kwargs": kwargs,
+                "checkpoint_every": args.checkpoint_every,
+                "watchdog": args.watchdog,
+                "devices": args.devices if args.devices > 1 else None,
+                "bucket": bool(args.bucket), "scope": args.scope,
+                "trace_packets": args.trace_packets,
+                "digest_every": args.digest_every}
+        return ("builder", spec), None
+    spec = {"run": args.replay, "window": args.window}
+    return ("replay", spec), None
+
+
+def _stream_until_done(path, msg, quiet=False) -> int:
+    """Drive a streamed request to its terminal event; returns the
+    run's rc.  A connection that dies mid-stream is rc 3 -- the run
+    itself is still journaled server-side (`status` finds it)."""
+    rid = None
+    try:
+        for ev in protocol.stream(path, msg):
+            if "event" not in ev:  # the acknowledgement
+                if not ev.get("ok"):
+                    print(f"error: {ev.get('error')}", file=sys.stderr)
+                    return int(ev.get("rc", RC_USAGE))
+                rid = ev.get("id")
+                if rid and not quiet:
+                    print(f"[shadow1-tpu] submitted {rid}",
+                          file=sys.stderr)
+                continue
+            e = ev.get("event")
+            if e == "progress":
+                line = ev.get("line")
+                if line and not quiet:
+                    sys.stderr.write(line)
+                    sys.stderr.flush()
+            elif e == "state" and not quiet:
+                print(f"[shadow1-tpu] {ev.get('id')}: "
+                      f"{ev.get('state')}", file=sys.stderr)
+            elif e == "parked":
+                print(f"error: run {ev.get('id') or rid} was "
+                      f"checkpointed and parked by a server drain; "
+                      f"restart the server with `serve --auto-resume` "
+                      f"to finish it", file=sys.stderr)
+                return RC_FAILED
+            elif e == "done":
+                if ev.get("error"):
+                    print(f"error: {ev['error']}", file=sys.stderr)
+                if ev.get("crash"):
+                    print(f"crash report: "
+                          f"{(ev['crash'] or {}).get('path')}",
+                          file=sys.stderr)
+                    print(json.dumps({"crash": ev["crash"]}))
+                if ev.get("summary") is not None:
+                    print(json.dumps(ev["summary"]))
+                return int(ev.get("rc", RC_FAILED))
+    except protocol.ServerUnavailable as e:
+        print(f"error: {e}", file=sys.stderr)
+        return RC_USAGE
+    except (ConnectionError, OSError) as e:
+        print(f"error: lost the run server connection: {e}",
+              file=sys.stderr)
+        return RC_FAILED
+    print(f"error: the run server closed the connection before "
+          f"{rid or 'the request'} finished (server killed?  a "
+          f"restarted `serve --auto-resume` re-admits it; check "
+          f"`shadow1-tpu status`)", file=sys.stderr)
+    return RC_FAILED
+
+
+def submit_cmd(args) -> int:
+    path = _socket_path(args)
+    if path is None:
+        return RC_USAGE
+    built, err = _build_submit(args)
+    if err is not None:
+        print(f"error: {err}", file=sys.stderr)
+        return RC_USAGE
+    kind, spec = built
+    msg = {"op": "submit", "kind": kind, "spec": spec,
+           "timeout": args.timeout, "wait": not args.no_wait,
+           "progress": bool(args.progress)}
+    if args.no_wait:
+        try:
+            resp = protocol.request(path, msg)
+        except protocol.ServerUnavailable as e:
+            print(f"error: {e}", file=sys.stderr)
+            return RC_USAGE
+        except (ConnectionError, OSError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return RC_FAILED
+        if not resp.get("ok"):
+            print(f"error: {resp.get('error')}", file=sys.stderr)
+            return int(resp.get("rc", RC_USAGE))
+        print(json.dumps({"id": resp["id"]}))
+        return RC_OK
+    return _stream_until_done(path, msg, quiet=args.quiet)
+
+
+def status_cmd(args) -> int:
+    path = _socket_path(args)
+    if path is None:
+        return RC_USAGE
+    msg = {"op": "status", "id": args.id, "wait": bool(args.wait)}
+    try:
+        if args.id and args.wait:
+            rc = _wait_status(path, msg)
+            return rc
+        resp = protocol.request(path, msg)
+    except protocol.ServerUnavailable as e:
+        print(f"error: {e}", file=sys.stderr)
+        return RC_USAGE
+    except (ConnectionError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return RC_FAILED
+    if not resp.get("ok"):
+        print(f"error: {resp.get('error')}", file=sys.stderr)
+        return int(resp.get("rc", RC_USAGE))
+    print(json.dumps(resp.get("run") or
+                     {"server": resp.get("server"),
+                      "runs": resp.get("runs")},
+                     indent=1, sort_keys=True))
+    return RC_OK
+
+
+def _wait_status(path, msg) -> int:
+    """`status ID --wait`: block until the run settles, print its final
+    record, exit with its rc (rc 3 for a drain-park)."""
+    rc = None
+    for ev in protocol.stream(path, msg):
+        if "event" not in ev:
+            if not ev.get("ok"):
+                print(f"error: {ev.get('error')}", file=sys.stderr)
+                return int(ev.get("rc", RC_USAGE))
+            continue
+        if ev.get("event") == "done":
+            rc = int(ev.get("rc", RC_FAILED))
+            break
+        if ev.get("event") == "parked":
+            print(f"run {msg['id']} is parked (server drain); restart "
+                  f"the server with `serve --auto-resume` to finish "
+                  f"it", file=sys.stderr)
+            rc = RC_FAILED
+            break
+    if rc is None:
+        print("error: the run server closed the connection before the "
+              "run settled", file=sys.stderr)
+        return RC_FAILED
+    try:
+        final = protocol.request(path, {"op": "status", "id": msg["id"]})
+        if final.get("ok"):
+            print(json.dumps(final.get("run"), indent=1, sort_keys=True))
+    except (ConnectionError, OSError):
+        pass  # server exited right after the drain-park event
+    return rc
+
+
+def cancel_cmd(args) -> int:
+    path = _socket_path(args)
+    if path is None:
+        return RC_USAGE
+    try:
+        resp = protocol.request(path, {"op": "cancel", "id": args.id})
+    except protocol.ServerUnavailable as e:
+        print(f"error: {e}", file=sys.stderr)
+        return RC_USAGE
+    except (ConnectionError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return RC_FAILED
+    if not resp.get("ok"):
+        print(f"error: {resp.get('error')}", file=sys.stderr)
+        return int(resp.get("rc", RC_USAGE))
+    print(json.dumps(resp))
+    return RC_OK

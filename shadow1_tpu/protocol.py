@@ -1,0 +1,127 @@
+"""Wire protocol of the resident run server (docs/robustness.md
+"Run server").
+
+Transport is a local Unix-domain stream socket; messages are one JSON
+object per line (newline-delimited, UTF-8).  A client sends exactly one
+request object carrying an ``op``; the server answers with one
+``{"ok": true/false, ...}`` acknowledgement and then -- for streaming
+ops (``submit`` with wait, ``status`` with wait) -- a sequence of
+``{"event": ...}`` objects ending with a terminal
+``{"event": "done", "rc": N, ...}``.  One request per connection: the
+connection closes after the terminal message, so a torn stream is
+always distinguishable from a finished one.
+
+Ops (client -> server):
+
+    ping      liveness probe; the ack carries the server's version,
+              queue depth, and draining flag
+    submit    enqueue a request: {"kind": "config"|"builder"|"replay",
+              "spec": {...}, "timeout": seconds|None,
+              "wait": bool, "progress": bool}
+    status    {"id": run-id|None, "wait": bool}: a run record, or the
+              whole server snapshot
+    cancel    {"id": run-id}
+    shutdown  {"drain": bool}: park in-flight runs (drain) or stop at
+              the next boundary, journal, and exit
+
+Request lifecycle states (server.py journals every transition to
+``server/journal.jsonl`` and mirrors the full record to
+``runs/<id>/request.json`` atomically):
+
+    queued -> running -> done | failed | parked | cancelled
+                         (parked runs re-enter queued on a
+                          ``serve --auto-resume`` restart)
+
+Exit codes ride the unified table (supervise.RC_*): the terminal
+``done`` event's ``rc`` is what ``submit --wait`` / ``status --wait``
+exit with, so a refusal (queue full, bad spec, timeout) is rc 2 at the
+client exactly as it would be at the CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+
+PROTOCOL_VERSION = 1
+
+# Lifecycle states (journal "state" fields and status output).
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"          # rc 0
+FAILED = "failed"      # rc 1/2/3 recorded on the request
+PARKED = "parked"      # checkpointed and stopped by a drain; resumable
+CANCELLED = "cancelled"
+
+TERMINAL = frozenset({DONE, FAILED, CANCELLED})
+
+
+def default_socket(data_dir: str) -> str:
+    """The server's socket path under its data directory."""
+    return os.path.join(data_dir, "server", "sock")
+
+
+def send(f, obj: dict) -> None:
+    """Write one message (a JSON object) to a socket file."""
+    f.write(json.dumps(obj, sort_keys=True) + "\n")
+    f.flush()
+
+
+def recv(f) -> dict | None:
+    """Read one message; None when the peer closed the stream."""
+    line = f.readline()
+    if not line:
+        return None
+    return json.loads(line)
+
+
+class ServerUnavailable(ConnectionError):
+    """No server is listening on the socket path (named in args)."""
+
+
+def connect(path: str, timeout: float | None = 30.0):
+    """Open a client connection; returns (socket, rfile, wfile)."""
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    if timeout is not None:
+        s.settimeout(timeout)
+    try:
+        s.connect(path)
+    except (FileNotFoundError, ConnectionRefusedError) as e:
+        s.close()
+        raise ServerUnavailable(
+            f"no run server is listening on {path} (start one with "
+            f"`shadow1-tpu serve --data-directory DIR`): {e}") from e
+    return s, s.makefile("r", encoding="utf-8"), \
+        s.makefile("w", encoding="utf-8")
+
+
+def request(path: str, obj: dict, timeout: float | None = 30.0) -> dict:
+    """One-shot request/ack exchange (ping, cancel, plain status)."""
+    s, rf, wf = connect(path, timeout)
+    try:
+        send(wf, obj)
+        resp = recv(rf)
+        if resp is None:
+            raise ConnectionError(
+                f"run server on {path} closed the connection without "
+                f"answering")
+        return resp
+    finally:
+        s.close()
+
+
+def stream(path: str, obj: dict, timeout: float | None = None):
+    """Send a request and yield the ack plus every streamed event until
+    the server closes the connection.  `timeout=None` waits forever --
+    a submitted run may take hours."""
+    s, rf, wf = connect(path, timeout)
+    try:
+        send(wf, obj)
+        while True:
+            msg = recv(rf)
+            if msg is None:
+                return
+            yield msg
+    finally:
+        s.close()

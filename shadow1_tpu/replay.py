@@ -123,10 +123,9 @@ class Checkpointer:
                 import warnings
                 warnings.warn(
                     f"{idx}: unreadable checkpoint index ({e}); "
-                    f"starting a fresh one (existing win_*.npz files "
-                    f"are still discoverable by filename)",
+                    f"rebuilding it from the win_*.npz manifests",
                     RuntimeWarning, stacklevel=2)
-                self.saved = []
+                self.saved = rebuild_index(data_dir)
 
     def _extra(self, state, params) -> dict:
         h = int(state.hosts.num_hosts)
@@ -175,9 +174,43 @@ def write_run_json(data_dir: str, info: dict) -> str:
     d.update(info)
     path = os.path.join(data_dir, "ckpt", "run.json")
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
+    # Atomic like the checkpoints: a crash mid-write must leave either
+    # the old recipe or the new one, never a torn file (resume rewrites
+    # a torn recipe from flags, but only the CLI has flags to do it).
+    with open(path + ".tmp", "w") as f:
         json.dump(d, f, indent=1, sort_keys=True)
+    os.replace(path + ".tmp", path)
     return path
+
+
+def rebuild_index(data_dir: str) -> list:
+    """Rebuild ckpt/index.json from the win_*.npz manifests and rewrite
+    it atomically; returns the entries.  A torn or deleted index must
+    never abort a resume -- the npz files are the ground truth (each
+    carries its window and sim time in its manifest), the index is only
+    a cache of them.  Unreadable snapshots are skipped, mirroring
+    find_checkpoint."""
+    entries = []
+    for p in glob.glob(os.path.join(data_dir, "ckpt", "win_*.npz")):
+        name = os.path.basename(p)
+        try:
+            int(name[4:-4])
+        except ValueError:
+            continue
+        try:
+            man = checkpoint.read_manifest(p)
+        except Exception:
+            continue  # torn npz: find_checkpoint warns when it matters
+        if man is None:
+            continue
+        entries.append({"window": int(man["window"]),
+                        "t_ns": int(man["t_ns"]), "file": name})
+    entries.sort(key=lambda e: e["window"])
+    idx = os.path.join(data_dir, "ckpt", "index.json")
+    with open(idx + ".tmp", "w") as f:
+        json.dump({"checkpoints": entries}, f, indent=1)
+    os.replace(idx + ".tmp", idx)
+    return entries
 
 
 def load_run(data_dir: str) -> dict:

@@ -324,7 +324,7 @@ class Drains:
 def run(state, params, app, until=None, profiler=None, devices=None,
         bucket=False, scope=None, lineage=None, digest=None,
         checkpoint_every=None, checkpoint_dir=None, checkpoint_world=None,
-        supervise=None):
+        supervise=None, control=None, emit=None, resume=False):
     """Run to `until` (default: params.stop_time).
 
     With `profiler` (a trace.Profiler), the run is profiled: the
@@ -406,6 +406,18 @@ def run(state, params, app, until=None, profiler=None, devices=None,
     recovery is checkpoint-anchored.  The supervised trajectory is
     bitwise identical to an unsupervised one (the sentinel and every
     ladder rung are bitwise-neutral).
+
+    `control` / `emit` / `resume` are the run server's hooks
+    (server.py), valid only on the checkpointed path.  `control` (a
+    server.RunControl-shaped object) is polled at every launch
+    boundary: "park" checkpoints and returns early
+    (control.outcome="parked"), "cancel"/"timeout" return early with
+    the outcome recorded -- the returned state is wherever the run
+    stopped.  `emit` receives {"event": ...} progress records.
+    `resume=True` restores the newest readable checkpoint under
+    `checkpoint_dir` (if any) before running, trimming windows.jsonl
+    to the resume window and appending from there -- the same bitwise
+    trim-and-append contract as the CLI's --auto-resume.
     """
     h_real = int(state.hosts.num_hosts)
     if bucket:
@@ -422,11 +434,17 @@ def run(state, params, app, until=None, profiler=None, devices=None,
             devices=devices, bucket=bucket, scope=scope, lineage=lineage,
             digest=digest, every_ns=int(checkpoint_every),
             ckdir=checkpoint_dir, world=checkpoint_world,
-            hosts_real=h_real, supervise=supervise)
+            hosts_real=h_real, supervise=supervise, control=control,
+            emit=emit, resume=resume)
     if supervise:
         raise ValueError(
             "sim.run: supervise requires checkpoint_every and "
             "checkpoint_dir (recovery is checkpoint-anchored)")
+    if control is not None or resume:
+        raise ValueError(
+            "sim.run: control/resume require checkpoint_every and "
+            "checkpoint_dir (parking and resuming are "
+            "checkpoint-anchored)")
 
     def _install_scope(st, shards):
         if scope is None or st.scope is not None:
@@ -493,12 +511,17 @@ def run(state, params, app, until=None, profiler=None, devices=None,
 
 def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
                       scope, every_ns, ckdir, world, hosts_real,
-                      lineage=None, digest=None, supervise=None):
+                      lineage=None, digest=None, supervise=None,
+                      control=None, emit=None, resume=False):
     """run()'s checkpointing path: same block installs as the plain
     paths (mesh pad, then scope/counters -- replay._rebuild_builder
     mirrors this order exactly), plus a flight recorder, a windows.jsonl
     drain, and Checkpointer saves on the memoryless launch grid
-    (replay.next_sync with hb_ns=None)."""
+    (replay.next_sync with hb_ns=None).  `resume` restores the newest
+    readable checkpoint first (fully-built template, then load, then
+    trim-and-append); `control`/`emit` are the run server's park/
+    cancel/timeout and progress-relay hooks (see run's docstring)."""
+    import json
     import os
 
     from . import replay as replay_mod
@@ -533,7 +556,36 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
         state = trace.ensure_sentinel(state)
 
     os.makedirs(ckdir, exist_ok=True)
-    flight = trace.FlightDrain(os.path.join(ckdir, "windows.jsonl"))
+
+    # Auto-resume (the run server's crash-safety contract, same as the
+    # CLI's --auto-resume): with the template fully built above, restore
+    # the newest readable checkpoint, trim windows.jsonl to the resume
+    # window, and append the re-recorded (bitwise-identical) rows.
+    resumed = None
+    if resume:
+        import glob as _glob
+        if _glob.glob(os.path.join(ckdir, "ckpt", "win_*.npz")):
+            try:
+                path, man = replay_mod.find_checkpoint(ckdir, None)
+            except FileNotFoundError:
+                path = None  # all torn: start the run over
+            if path is not None:
+                from . import checkpoint as _ckpt
+                from . import supervise as _sup_mod
+                state, params = _ckpt.load(path, state, params)
+                resumed = {"file": os.path.basename(path),
+                           "window": int(man["window"]),
+                           "t_ns": int(man["t_ns"])}
+                _sup_mod.trim_windows(
+                    os.path.join(ckdir, "windows.jsonl"),
+                    resumed["window"])
+                if emit is not None:
+                    emit({"event": "resumed", **resumed})
+
+    flight = trace.FlightDrain(
+        os.path.join(ckdir, "windows.jsonl"),
+        start=resumed["window"] if resumed else 0,
+        mode="a" if resumed else "w")
     spans = None
     if state.lineage is not None:
         spans = trace.LineageDrain(os.path.join(ckdir, "spans.jsonl"))
@@ -545,19 +597,31 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
     if world is not None and not isinstance(world, dict):
         name, kwargs = world
         world = {"name": name, "kwargs": dict(kwargs or {})}
-    replay_mod.write_run_json(ckdir, {
-        "world": ({"kind": "builder", **world}
-                  if world is not None else None),
-        "hb_ns": None, "every_ns": int(every_ns), "stop_ns": int(t),
-        "chunk_ns": engine.CHUNK_NS, "devices": n,
-        "bucket": bool(bucket), "hosts_real": int(hosts_real),
-        "scope": scope, "profile": profiler is not None,
-        "flight_rows": int(state.fr.steps.shape[0]),
-        "lineage": (str(lineage) if lineage is not None else None),
-        "digest": (int(state.dg.every) if state.dg is not None else None),
-        "digest_rows": (int(state.dg.capacity)
-                        if state.dg is not None else None),
-        "sentinel": bool(supervise), "supervise": bool(supervise)})
+    write_recipe = resumed is None
+    if resumed is not None:
+        # Torn-file hardening parity (docs/robustness.md): a damaged
+        # run.json must not strand a resumable run -- the recipe is a
+        # pure function of the current arguments, so rewrite it.
+        try:
+            replay_mod.load_run(ckdir)
+            write_recipe = False
+        except (FileNotFoundError, ValueError, json.JSONDecodeError):
+            write_recipe = True
+    if write_recipe:
+        replay_mod.write_run_json(ckdir, {
+            "world": ({"kind": "builder", **world}
+                      if world is not None else None),
+            "hb_ns": None, "every_ns": int(every_ns), "stop_ns": int(t),
+            "chunk_ns": engine.CHUNK_NS, "devices": n,
+            "bucket": bool(bucket), "hosts_real": int(hosts_real),
+            "scope": scope, "profile": profiler is not None,
+            "flight_rows": int(state.fr.steps.shape[0]),
+            "lineage": (str(lineage) if lineage is not None else None),
+            "digest": (int(state.dg.every)
+                       if state.dg is not None else None),
+            "digest_rows": (int(state.dg.capacity)
+                            if state.dg is not None else None),
+            "sentinel": bool(supervise), "supervise": bool(supervise)})
     sup = None
     if supervise:
         from . import supervise as sup_mod
@@ -568,9 +632,27 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
     drains = Drains(flight=flight, spans=spans, digests=digests,
                     profiler=profiler)
     try:
-        ck.save(state, params)          # win_0: a replay anchor always exists
+        if resumed is None:
+            ck.save(state, params)      # win_0: a replay anchor always exists
         tt = int(state.now)
         while tt < int(t):
+            act = control.poll() if control is not None else None
+            if act is not None:
+                # The run server asked this run to stop at a launch
+                # boundary (server.RunControl): park checkpoints here
+                # and resumes on the next --auto-resume life; cancel
+                # and timeout just stop (the worker maps the outcome
+                # to its rc).
+                if act == "park":
+                    ck.save(state, params)
+                    control.outcome = "parked"
+                    if emit is not None:
+                        emit({"event": "parked", "t_ns": int(tt),
+                              "window": int(state.n_windows)})
+                else:
+                    control.outcome = ("cancelled" if act == "cancel"
+                                       else "timed_out")
+                return state
             tt = replay_mod.next_sync(tt, int(t), every_ns=every_ns)
             if sup is not None:
                 state = sup.launch(state, params, tt)
@@ -582,6 +664,11 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
                 state = engine.run_chunked(state, params, app, tt)
             drains.drain_all(state)
             ck.maybe(state, params, tt)
+            if emit is not None:
+                emit({"event": "progress", "t_ns": int(tt),
+                      "stop_ns": int(t),
+                      "line": f"[shadow1-tpu] {tt / simtime.SIMTIME_ONE_SECOND:g}"
+                              f"/{int(t) / simtime.SIMTIME_ONE_SECOND:g}s\n"})
         return state
     finally:
         flight.close()

@@ -233,6 +233,7 @@ class Supervisor:
         self.ladder = []       # crash.json trail: rungs taken/skipped
         self.recoveries = 0    # rungs actually taken
         self._rung = 0         # next RUNGS index to consider
+        self._warm = False     # a launch of the current graph completed
 
     # -- public ----------------------------------------------------------
 
@@ -284,8 +285,16 @@ class Supervisor:
             return engine.run_chunked(state, exec_params, self.app,
                                       t_next, chunk_ns=self.chunk_ns)
 
-        if not self.watchdog_s:
-            return go()
+        if not self.watchdog_s or not self._warm:
+            # The watchdog is armed only after the first launch of the
+            # current graph completes: a cold launch pays XLA
+            # compilation, whose wall-clock says nothing about a wedged
+            # device, so it never counts against the deadline.  Rungs
+            # that change the graph (megakernel_off, gather_single)
+            # re-open the grace window.
+            out = go()
+            self._warm = True
+            return out
         box = {}
 
         def work():
@@ -322,10 +331,12 @@ class Supervisor:
                 continue
             if rung == "megakernel_off":
                 self.megakernel_off = True
+                self._warm = False  # new graph: compile grace re-opens
             elif rung == "halve_chunk":
                 self.chunk_ns = max(self.chunk_ns // 2, MIN_CHUNK_NS)
             elif rung == "gather_single":
                 self.mesh = None
+                self._warm = False  # new graph: compile grace re-opens
             try:
                 state, ck = self._reload(state, params)
             except (FileNotFoundError, ValueError, OSError) as e:

@@ -1,0 +1,469 @@
+"""Resident run server: crash safety, supervision, and the rc table
+over the service boundary (shadow1_tpu/server.py, protocol.py,
+client.py; docs/robustness.md "Run server").
+
+The contract under test:
+
+* A submitted run is bitwise the run `sim.run` would have produced
+  directly: same windows.jsonl, same trajectory (the tier-0 pin).
+* Every lifecycle transition is journaled write-ahead and mirrored to
+  runs/<id>/request.json, so a server stop loses nothing: a drain
+  parks in-flight runs at a checkpoint, and a `serve --auto-resume`
+  restart re-admits them and finishes them bitwise-identically.
+* The unified exit-code table (supervise.py) holds end to end: rc 0
+  clean, rc 1 deterministic simulation failure (with a crash.json
+  path), rc 2 refusals naming the responsible knob (--queue-limit,
+  --timeout), rc 3 exhausted ladder / cancellation.
+
+tools/faultdrill.py's `server` drill covers the real-SIGKILL version
+of the recovery story through subprocesses; these tests stay
+in-process (the drain/park path exercises the same journal fold).
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from shadow1_tpu import protocol, server, sim
+from shadow1_tpu.core import engine, simtime
+from shadow1_tpu.supervise import RC_FAILED, RC_INVARIANT, RC_OK, RC_USAGE
+
+SEC = simtime.SIMTIME_ONE_SECOND
+
+# One small phold world for the whole module: every server test reuses
+# the compiled graph after the first run.
+PHOLD_KW = dict(num_hosts=16, msgs_per_host=2, seed=7,
+                stop_time=6 * SEC)
+CK_S = 2.0
+
+
+def _direct_ref(out_dir, kw=None):
+    """The solo reference: sim.run with exactly the flags the server
+    applies to a builder request (server._run_builder_kind)."""
+    kw = dict(kw or PHOLD_KW)
+    state, params, app = sim.build_phold(**kw)
+    return sim.run(state, params, app,
+                   checkpoint_every=int(CK_S * SEC),
+                   checkpoint_dir=str(out_dir),
+                   checkpoint_world=("phold", kw),
+                   supervise={"watchdog_s": None, "quiet": True},
+                   resume=True)
+
+
+def _start(data_dir, **kw):
+    kw.setdefault("queue_limit", 4)
+    kw.setdefault("quiet", True)
+    return server.Server(str(data_dir), **kw).start()
+
+
+def _spec(kw=None, **over):
+    spec = {"name": "phold", "kwargs": dict(kw or PHOLD_KW),
+            "checkpoint_every": CK_S}
+    spec.update(over)
+    return spec
+
+
+def _submit_wait(sock, spec, timeout=None, progress=True):
+    """Drive one submit to its terminal event; (rc, events)."""
+    evs = []
+    for ev in protocol.stream(sock, {"op": "submit", "kind": "builder",
+                                     "spec": spec, "timeout": timeout,
+                                     "wait": True,
+                                     "progress": progress}):
+        evs.append(ev)
+        if not ev.get("ok", True) or ev.get("event") in ("done",
+                                                         "parked"):
+            break
+    return evs
+
+
+def _windows(path):
+    with open(os.path.join(str(path), "windows.jsonl"), "rb") as f:
+        return f.read()
+
+
+def _slow_launch(monkeypatch, delay=0.2):
+    """Wrap engine.run_chunked with a wall-clock delay (trajectory
+    untouched) so tests can land control actions mid-run."""
+    real = engine.run_chunked
+
+    def slow(*a, **kw):
+        time.sleep(delay)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(engine, "run_chunked", slow)
+
+
+@pytest.mark.tier0
+class TestRoundTripPin:
+    def test_submitted_run_matches_direct_sim_run_bitwise(self,
+                                                          tmp_path):
+        # The tier-0 server pin (tools/smoke.py): serve -> submit a
+        # tiny phold -> the run's windows.jsonl is byte-identical to a
+        # direct sim.run with the same flags -> clean shutdown.
+        _direct_ref(tmp_path / "ref")
+        data = tmp_path / "data"
+        srv = _start(data)
+        sock = protocol.default_socket(str(data))
+        try:
+            ping = protocol.request(sock, {"op": "ping"})
+            assert ping["ok"] and ping["version"] == \
+                protocol.PROTOCOL_VERSION
+
+            evs = _submit_wait(sock, _spec())
+            ack, done = evs[0], evs[-1]
+            assert ack["ok"]
+            assert done["event"] == "done" and done["rc"] == RC_OK
+            assert done["summary"]["err_flags"] == 0
+            assert any(e.get("event") == "progress" for e in evs)
+
+            rid = ack["id"]
+            assert _windows(data / "runs" / rid) == \
+                _windows(tmp_path / "ref")
+
+            st = protocol.request(sock, {"op": "status", "id": rid})
+            rec = st["run"]
+            assert rec["state"] == protocol.DONE and rec["rc"] == RC_OK
+            assert rec["trail"] == ["submitted", "started",
+                                    "finished rc 0"]
+            # The atomic mirror matches the live record.
+            with open(os.path.join(rec["dir"], "request.json")) as f:
+                assert json.load(f)["state"] == protocol.DONE
+
+            resp = protocol.request(sock, {"op": "shutdown",
+                                           "drain": True})
+            assert resp["ok"]
+            srv.wait()
+            assert not os.path.exists(sock)
+            # Every transition is journaled: submit, start, finish.
+            with open(data / "server" / "journal.jsonl") as f:
+                evs = [json.loads(s)["ev"] for s in f if s.strip()]
+            assert evs[:3] == ["submit", "start", "finish"]
+        finally:
+            srv.shutdown()
+
+
+class TestReplayRequest:
+    def test_replay_as_a_request(self, tmp_path):
+        data = tmp_path / "data"
+        srv = _start(data)
+        sock = protocol.default_socket(str(data))
+        try:
+            rid = _submit_wait(sock, _spec())[0]["id"]
+            evs = []
+            for ev in protocol.stream(sock, {
+                    "op": "submit", "kind": "replay",
+                    "spec": {"run": rid, "window": 1}, "wait": True}):
+                evs.append(ev)
+                if ev.get("event") == "done":
+                    break
+            done = evs[-1]
+            assert done["rc"] == RC_OK, done
+            rep = done["summary"]["replay"]
+            assert rep["target_window"] == 1
+            assert rep["windows_verified"] >= 1
+        finally:
+            srv.shutdown()
+
+
+class TestAdmission:
+    def test_queue_full_refusal_names_queue_limit(self, tmp_path):
+        # --queue-limit 0 refuses every admission: rc 2 naming the
+        # current depth and the knob.
+        srv = _start(tmp_path, queue_limit=0)
+        sock = protocol.default_socket(str(tmp_path))
+        try:
+            resp = protocol.request(sock, {"op": "submit",
+                                           "kind": "builder",
+                                           "spec": _spec()})
+            assert not resp["ok"] and resp["rc"] == RC_USAGE
+            assert "--queue-limit 0" in resp["error"]
+            assert "0 queued" in resp["error"]
+            snap = protocol.request(sock, {"op": "status"})
+            assert snap["server"]["queue_limit"] == 0
+        finally:
+            srv.shutdown()
+
+    def test_refusals_name_the_knob(self, tmp_path):
+        srv = _start(tmp_path)
+        sock = protocol.default_socket(str(tmp_path))
+        try:
+            # Unknown builder / kind / op / id: rc 2, never a crash.
+            resp = protocol.request(sock, {
+                "op": "submit", "kind": "builder",
+                "spec": {"name": "nope"}})
+            assert not resp["ok"] and resp["rc"] == RC_USAGE
+            assert "unknown world builder" in resp["error"]
+            resp = protocol.request(sock, {"op": "submit",
+                                           "kind": "what", "spec": {}})
+            assert not resp["ok"] and "unknown request kind" \
+                in resp["error"]
+            resp = protocol.request(sock, {"op": "frobnicate"})
+            assert not resp["ok"] and resp["rc"] == RC_USAGE
+            resp = protocol.request(sock, {"op": "status",
+                                           "id": "r9999"})
+            assert not resp["ok"] and resp["rc"] == RC_USAGE
+
+            # A draining server refuses new admissions loudly.
+            srv._draining = True
+            resp = protocol.request(sock, {"op": "submit",
+                                           "kind": "builder",
+                                           "spec": _spec()})
+            srv._draining = False
+            assert not resp["ok"] and "draining" in resp["error"]
+        finally:
+            srv.shutdown()
+
+
+class TestTimeout:
+    def test_timeout_is_rc2_naming_the_knob(self, tmp_path,
+                                            monkeypatch):
+        _slow_launch(monkeypatch)
+        srv = _start(tmp_path)
+        sock = protocol.default_socket(str(tmp_path))
+        try:
+            evs = _submit_wait(sock, _spec(), timeout=0.05)
+            done = evs[-1]
+            assert done["event"] == "done"
+            assert done["rc"] == RC_USAGE
+            assert "--timeout" in done["error"]
+            assert done["state"] == protocol.FAILED
+        finally:
+            srv.shutdown()
+
+
+class TestCancel:
+    def test_cancel_queued_and_running(self, tmp_path, monkeypatch):
+        _slow_launch(monkeypatch)
+        srv = _start(tmp_path, workers=1)
+        sock = protocol.default_socket(str(tmp_path))
+        try:
+            ra = protocol.request(sock, {"op": "submit",
+                                         "kind": "builder",
+                                         "spec": _spec()})["id"]
+            rb = protocol.request(sock, {"op": "submit",
+                                         "kind": "builder",
+                                         "spec": _spec()})["id"]
+            # B is queued behind A on the single worker: cancelling it
+            # settles it immediately, rc 3.
+            resp = protocol.request(sock, {"op": "cancel", "id": rb})
+            assert resp["ok"] and resp["state"] == protocol.CANCELLED
+            rec = protocol.request(sock, {"op": "status",
+                                          "id": rb})["run"]
+            assert rec["state"] == protocol.CANCELLED
+            assert rec["rc"] == RC_FAILED
+
+            # A is (or is about to be) running: the cancel lands at its
+            # next launch boundary.
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                rec = protocol.request(sock, {"op": "status",
+                                              "id": ra})["run"]
+                if rec["state"] == protocol.RUNNING:
+                    break
+                assert rec["state"] == protocol.QUEUED, rec
+                time.sleep(0.05)
+            resp = protocol.request(sock, {"op": "cancel", "id": ra})
+            assert resp["ok"]
+            while True:
+                rec = protocol.request(sock, {"op": "status",
+                                              "id": ra})["run"]
+                if rec["state"] in protocol.TERMINAL:
+                    break
+                time.sleep(0.05)
+            assert rec["state"] == protocol.CANCELLED
+            assert rec["rc"] == RC_FAILED
+            assert time.time() < deadline, "cancel never landed"
+        finally:
+            srv.shutdown()
+
+
+class TestRcTableOverService:
+    def test_rc1_deterministic_failure_with_crash_path(self, tmp_path,
+                                                       monkeypatch):
+        # Every launch trips the nonfinite sentinel class: the ladder
+        # (bitwise-neutral rungs only) cannot dodge a deterministic
+        # failure, so the run surrenders rc 1 with a crash.json path in
+        # the terminal event.
+        from shadow1_tpu import trace
+        from shadow1_tpu.core.state import SENTINEL_NONFINITE
+
+        def poisoned(*a, **kw):
+            raise trace.SentinelViolation(
+                {"violations": SENTINEL_NONFINITE,
+                 "first_bad_window": 1, "first_bad_t": int(CK_S * SEC),
+                 "classes": ["nonfinite"]})
+
+        monkeypatch.setattr(engine, "run_chunked", poisoned)
+        srv = _start(tmp_path)
+        sock = protocol.default_socket(str(tmp_path))
+        try:
+            done = _submit_wait(sock, _spec())[-1]
+            assert done["rc"] == RC_INVARIANT
+            assert done["crash"]["class"] == "nan"
+            assert os.path.exists(done["crash"]["path"])
+            with open(done["crash"]["path"]) as f:
+                assert json.load(f)["failure"]["class"] == "nan"
+        finally:
+            srv.shutdown()
+
+    def test_rc3_exhausted_ladder(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(
+            engine, "run_chunked",
+            lambda *a, **kw: (_ for _ in ()).throw(
+                RuntimeError("synthetic infrastructure failure")))
+        srv = _start(tmp_path)
+        sock = protocol.default_socket(str(tmp_path))
+        try:
+            done = _submit_wait(sock, _spec())[-1]
+            assert done["rc"] == RC_FAILED
+            assert done["state"] == protocol.FAILED
+            assert done["crash"] and os.path.exists(
+                done["crash"]["path"])
+            with open(done["crash"]["path"]) as f:
+                crash = json.load(f)
+            assert crash["failure"]["class"] == "error"
+            assert any(r["action"] == "taken" for r in crash["ladder"])
+        finally:
+            srv.shutdown()
+
+
+class TestDrainParkResume:
+    def test_sigterm_drain_parks_then_auto_resume_is_bitwise(
+            self, tmp_path, monkeypatch):
+        # The in-process version of the faultdrill server drill: a
+        # drain parks the in-flight run at a checkpoint, the journal
+        # records it, and a --auto-resume restart re-admits and
+        # finishes it byte-identical to an uninterrupted reference.
+        _direct_ref(tmp_path / "ref")
+        _slow_launch(monkeypatch)
+        data = tmp_path / "data"
+        srv = _start(data, workers=1)
+        sock = protocol.default_socket(str(data))
+        rid = protocol.request(sock, {"op": "submit", "kind": "builder",
+                                      "spec": _spec()})["id"]
+        # Wait until the run is genuinely mid-flight (a win_>0
+        # checkpoint landed), then drain -- the SIGTERM handler path.
+        ckdir = data / "runs" / rid / "ckpt"
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if any(f.startswith("win_") and f != "win_0.npz"
+                   for f in (os.listdir(ckdir)
+                             if ckdir.exists() else [])):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("no mid-run checkpoint before the drain")
+        srv.shutdown(drain=True)
+        srv.wait()
+        rec = json.loads(
+            (data / "runs" / rid / "request.json").read_text())
+        assert rec["state"] == protocol.PARKED
+        assert "parked (server drain)" in rec["trail"]
+        with open(data / "server" / "journal.jsonl") as f:
+            evs = [json.loads(s)["ev"] for s in f if s.strip()]
+        assert "park" in evs and "drain" in evs
+
+        # Life 2: --auto-resume re-admits the parked run.
+        srv2 = _start(data, workers=1, auto_resume=True)
+        try:
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                rec = protocol.request(
+                    protocol.default_socket(str(data)),
+                    {"op": "status", "id": rid})["run"]
+                if rec["state"] in protocol.TERMINAL:
+                    break
+                time.sleep(0.1)
+            assert rec["state"] == protocol.DONE and rec["rc"] == RC_OK
+            assert rec["restarts"] == 1
+            assert any("readmitted" in t for t in rec["trail"])
+            assert _windows(data / "runs" / rid) == \
+                _windows(tmp_path / "ref")
+        finally:
+            srv2.shutdown()
+
+    def test_without_auto_resume_requests_strand_loudly(self, tmp_path):
+        # A journal with an un-finished submit and no --auto-resume:
+        # the request is parked in place with a trail note naming the
+        # flag, and a later --auto-resume life still finishes it.
+        data = tmp_path / "data"
+        sdir = data / "server"
+        sdir.mkdir(parents=True)
+        with open(sdir / "journal.jsonl", "w") as f:
+            f.write(json.dumps({"ev": "submit", "id": "r0001",
+                                "kind": "builder", "spec": _spec(),
+                                "timeout": None, "t": 0.0}) + "\n")
+            f.write('{"ev": "start", "id": "r0001", "tor')  # torn tail
+
+        srv = _start(data)  # auto_resume=False
+        sock = protocol.default_socket(str(data))
+        try:
+            rec = protocol.request(sock, {"op": "status",
+                                          "id": "r0001"})["run"]
+            assert rec["state"] == protocol.PARKED
+            assert any("--auto-resume" in t for t in rec["trail"])
+        finally:
+            srv.shutdown()
+        srv.wait()
+
+        srv2 = _start(data, auto_resume=True)
+        try:
+            deadline = time.time() + 300
+            rec = None
+            while time.time() < deadline:
+                rec = protocol.request(
+                    protocol.default_socket(str(data)),
+                    {"op": "status", "id": "r0001"})["run"]
+                if rec["state"] in protocol.TERMINAL:
+                    break
+                time.sleep(0.1)
+            assert rec["state"] == protocol.DONE and rec["rc"] == RC_OK
+            # The fresh-id counter resumed past the journaled id.
+            resp = protocol.request(
+                protocol.default_socket(str(data)),
+                {"op": "submit", "kind": "builder", "spec": _spec()})
+            assert resp["id"] == "r0002"
+        finally:
+            srv2.shutdown()
+
+
+class TestClientCli:
+    def test_client_commands_against_live_server(self, tmp_path,
+                                                 capsys):
+        from shadow1_tpu import cli
+        data = tmp_path / "data"
+        srv = _start(data)
+        try:
+            rc = cli.main(["status", "--server", str(data)])
+            assert rc == RC_OK
+            snap = json.loads(capsys.readouterr().out)
+            assert snap["server"]["queue_limit"] == 4
+
+            rc = cli.main(["submit", "--server", str(data), "--world",
+                           "phold", "--world-kwargs",
+                           json.dumps({k: v for k, v
+                                       in PHOLD_KW.items()}),
+                           "--checkpoint-every", f"{CK_S:g}",
+                           "--quiet"])
+            assert rc == RC_OK
+            out = capsys.readouterr().out.strip().splitlines()
+            assert json.loads(out[-1])["err_flags"] == 0
+
+            # Exactly one request kind per submit.
+            rc = cli.main(["submit", "--server", str(data)])
+            assert rc == RC_USAGE
+            assert "exactly one" in capsys.readouterr().err
+        finally:
+            srv.shutdown()
+
+    def test_no_server_is_rc2(self, tmp_path, capsys):
+        from shadow1_tpu import cli
+        rc = cli.main(["status", "--server", str(tmp_path)])
+        assert rc == RC_USAGE
+        assert "no run server" in capsys.readouterr().err
+        rc = cli.main(["cancel", "r0001"])
+        assert rc == RC_USAGE
+        assert "--server" in capsys.readouterr().err

@@ -273,6 +273,7 @@ class TestSupervisedRun:
         state, params, app = _bulk()
         sup = supervise.Supervisor(str(tmp_path), app, quiet=True,
                                    watchdog_s=0.2)
+        sup._warm = True  # past the compile grace: deadline is armed
         real = engine.run_chunked
         try:
             engine.run_chunked = \
@@ -285,6 +286,32 @@ class TestSupervisedRun:
         crash = json.loads((tmp_path / "crash.json").read_text())
         assert crash["failure"]["class"] == "hung"
         assert crash["ladder"] == []  # no in-process recovery attempted
+
+    def test_watchdog_compile_grace(self, tmp_path):
+        # Regression: the watchdog must be armed only after the first
+        # launch of the current graph completes.  A cold launch pays
+        # XLA compilation, which can dwarf any sane deadline -- before
+        # the fix a tight --watchdog rc-3-surrendered every cold run.
+        state, params, app = _bulk()
+        sup = supervise.Supervisor(str(tmp_path), app, quiet=True,
+                                   watchdog_s=0.2)
+        assert sup._warm is False
+        real = engine.run_chunked
+        try:
+            # "Compile" for 0.6s, far past the 0.2s deadline: the cold
+            # launch must complete anyway.
+            engine.run_chunked = lambda st, *a, **kw: (time.sleep(0.6),
+                                                       st)[1]
+            out = sup.launch(state, params, SEC)
+            assert out is state and sup._warm is True
+            # The SAME slow launch warm is a genuine hang: rc 3.
+            with pytest.raises(supervise.UnrecoveredFailure) as ei:
+                sup.launch(state, params, 2 * SEC)
+        finally:
+            engine.run_chunked = real
+        assert ei.value.rc == supervise.RC_FAILED
+        assert json.loads((tmp_path / "crash.json").read_text())[
+            "failure"]["class"] == "hung"
 
 
 class TestReplayReproduces:
@@ -299,6 +326,57 @@ class TestReplayReproduces:
         sn = res["sentinel"]
         assert "nonfinite" in sn["classes"]
         assert sn["first_bad_window"] == int(man["window"])
+
+
+class TestTornStateFiles:
+    """A crash can tear any host-side state file; none of them may
+    abort a resume.  Checkpoints themselves are atomic, so index.json
+    and run.json are rebuildable caches -- and are rebuilt."""
+
+    def test_torn_index_rebuilt_from_manifests(self, tmp_path):
+        d = str(tmp_path)
+        _ckrun(d, supervise_opt=True)
+        idx = tmp_path / "ckpt" / "index.json"
+        orig = json.loads(idx.read_text())["checkpoints"]
+        raw = idx.read_bytes()
+        idx.write_bytes(raw[:len(raw) // 2])  # torn mid-byte
+        with pytest.warns(RuntimeWarning, match="rebuilding"):
+            ck = replay.Checkpointer(d, SEC // 2)
+        assert ck.saved == sorted(orig, key=lambda e: e["window"])
+        # The rebuild also rewrote the file, atomically.
+        assert json.loads(idx.read_text())["checkpoints"] == ck.saved
+
+    def test_rebuild_index_skips_torn_npz(self, tmp_path):
+        d = str(tmp_path)
+        _ckrun(d, supervise_opt=True)
+        entries = replay.rebuild_index(d)
+        victim = os.path.join(d, "ckpt", entries[-1]["file"])
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.truncate(size // 2)
+        rebuilt = replay.rebuild_index(d)
+        assert [e["file"] for e in rebuilt] == \
+            [e["file"] for e in entries[:-1]]
+
+    def test_torn_run_json_does_not_abort_cli_resume(self, tmp_path,
+                                                     capsys):
+        config = os.path.join(REPO, "examples", "tgen-2host",
+                              "shadow.config.xml")
+        d = str(tmp_path / "run")
+        argv = ["run", config, "--checkpoint-every", "2",
+                "--stop-time", "4", "--data-directory", d,
+                "--auto-resume", "--quiet"]
+        assert cli.main(argv) == 0
+        capsys.readouterr()
+        rj = tmp_path / "run" / "ckpt" / "run.json"
+        raw = rj.read_bytes()
+        rj.write_bytes(raw[:len(raw) // 2])  # torn mid-byte
+        assert cli.main(argv) == 0
+        capsys.readouterr()
+        # The resume rewrote the recipe from its own flags.
+        info = json.loads(rj.read_text())
+        assert info["version"] == replay.RUN_JSON_VERSION
+        assert info["world"]["kind"] == "config"
 
 
 class TestCliUsage:

@@ -233,6 +233,19 @@ def _supervise_config(d: dict):
     return bool(cfg["supervise"])
 
 
+def _serve_config(d: dict):
+    """Whether a run executed inside the resident run server: the
+    config.serve stamp (bool), or _UNSTAMPED for pre-stamp files.  A
+    served run shares its process with other tenants and its compile
+    cache with prior requests, so its wall numbers are not comparable
+    to a solo run's; legacy files stay comparable (the checkpoint
+    rule)."""
+    cfg = d.get("config")
+    if not isinstance(cfg, dict) or "serve" not in cfg:
+        return _UNSTAMPED
+    return bool(cfg["serve"])
+
+
 def _kernel_world(d: dict):
     """The fixed-world config a kernelcount report was measured on:
     (backend, world dict) for a standalone tools/kernelcount.py JSON or
@@ -453,6 +466,17 @@ def main(argv=None) -> int:
               f"against a bare one (old supervise={sv_old!r}, "
               f"new supervise={sv_new!r}); re-record with matching "
               f"--auto-resume settings", file=sys.stderr)
+        return 2
+    se_old, se_new = _serve_config(old), _serve_config(new)
+    if se_old is not _UNSTAMPED and se_new is not _UNSTAMPED \
+            and se_old != se_new:
+        # A served run's wall-clock rides a multi-tenant process and a
+        # pre-warmed compile cache; solo runs pay everything themselves
+        # -- the supervise rule.
+        print(f"benchdiff: refusing to compare a served run against a "
+              f"solo one (old serve={se_old!r}, new serve={se_new!r}); "
+              f"re-record both solo (bench.py) or both through the run "
+              f"server", file=sys.stderr)
         return 2
     if args.kernels:
         wo, wn = _kernel_world(old), _kernel_world(new)

@@ -23,6 +23,13 @@ Drills (--drill, default "all"):
   Passes when the sentinel trips in the first resumed window (rc 1,
   crash.json with failure.class "nan" and a walked ladder) and
   `shadow1-tpu replay --window K` reproduces the violation (rc 1).
+* server -- SIGKILL a loaded run server (shadow1_tpu/server.py).
+  Three concurrent phold submissions (seeds 1..3) go in over the
+  socket; once every run has checkpointed past win_0 the server is
+  SIGKILLed, restarted with `serve --auto-resume`, and every run is
+  waited to completion.  Passes when each run exits rc 0 with its
+  windows.jsonl byte-identical to an uninterrupted solo reference of
+  the same world, and `status` reports the re-admission in the trail.
 
 Why NaN and not a counter poison: the conservation sentinel is
 delta-based (it snapshots counters at window open), so corruption
@@ -252,11 +259,188 @@ def drill_nan(config, wd, ref_dir, every, stop):
     return errs
 
 
+# --- the server drill -------------------------------------------------------
+
+SEC = 1_000_000_000  # simtime.SIMTIME_ONE_SECOND (kept import-free)
+
+# The drilled world: small enough to compile fast, long enough that
+# three concurrent runs are still in flight when the kill lands.
+_SERVER_HOSTS = 64
+_SERVER_SEEDS = (1, 2, 3)
+
+_REF_SNIPPET = """\
+import json, sys
+sys.path.insert(0, {repo!r})
+from shadow1_tpu import sim
+kw = json.loads({kw!r})
+state, params, app = sim.build_phold(**kw)
+sim.run(state, params, app,
+        checkpoint_every=int({every!r} * {sec!r}),
+        checkpoint_dir={out!r},
+        checkpoint_world=("phold", kw),
+        supervise={{"watchdog_s": None, "quiet": True}},
+        resume=True)
+"""
+
+
+def _server_kw(seed: int, stop: int) -> dict:
+    return {"num_hosts": _SERVER_HOSTS, "msgs_per_host": 4,
+            "seed": int(seed), "stop_time": int(stop) * SEC}
+
+
+def _solo_ref(wd: str, seed: int, every: float, stop: int) -> str:
+    """An uninterrupted sim.run of the drilled world with the exact
+    flags the server applies to a builder request (server.py
+    _run_builder_kind); its windows.jsonl is the byte-compare target."""
+    out = os.path.join(wd, f"ref_{seed}")
+    os.makedirs(out, exist_ok=True)
+    code = _REF_SNIPPET.format(repo=REPO,
+                               kw=json.dumps(_server_kw(seed, stop)),
+                               every=every, sec=SEC, out=out)
+    rc, _, err = _run([sys.executable, "-c", code])
+    if rc != 0:
+        raise RuntimeError(f"solo reference (seed {seed}) failed "
+                           f"rc {rc}\n{err}")
+    return out
+
+
+def _client(data_dir: str, *argv) -> tuple:
+    return _run([sys.executable, "-m", "shadow1_tpu", *argv,
+                 "--server", data_dir])
+
+
+def _wait_socket(data_dir: str, proc, timeout_s: float = 120.0):
+    sock = os.path.join(data_dir, "server", "sock")
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"serve exited rc {proc.returncode} before listening")
+        if os.path.exists(sock):
+            rc, out, _ = _client(data_dir, "status")
+            if rc == 0:
+                return
+        time.sleep(0.1)
+    raise RuntimeError(f"serve socket never appeared at {sock}")
+
+
+def _serve(data_dir: str, *, resume: bool):
+    argv = [sys.executable, "-m", "shadow1_tpu", "serve",
+            "--data-directory", data_dir, "--no-warm", "--quiet",
+            "--workers", str(len(_SERVER_SEEDS))]
+    if resume:
+        argv.append("--auto-resume")
+    p = subprocess.Popen(argv, cwd=REPO, stdout=subprocess.DEVNULL,
+                         stderr=subprocess.DEVNULL)
+    _wait_socket(data_dir, p)
+    return p
+
+
+def drill_server(wd, every, stop):
+    d = os.path.join(wd, "server")
+    data = os.path.join(d, "data")
+    os.makedirs(data, exist_ok=True)
+
+    print(f"  solo references (seeds {_SERVER_SEEDS}) ...")
+    refs = {s: _solo_ref(d, s, every, stop) for s in _SERVER_SEEDS}
+
+    srv = _serve(data, resume=False)
+    ids = {}
+    try:
+        for seed in _SERVER_SEEDS:
+            rc, out, err = _client(
+                data, "submit", "--world", "phold",
+                "--world-kwargs", json.dumps(_server_kw(seed, stop)),
+                "--checkpoint-every", f"{every:g}", "--no-wait")
+            if rc != 0:
+                return [f"server: submit (seed {seed}) refused rc "
+                        f"{rc}\n{err}"]
+            ids[json.loads(out.strip().splitlines()[-1])["id"]] = seed
+        print(f"  submitted {sorted(ids)}; waiting for mid-run "
+              f"checkpoints ...")
+
+        # Kill only once every run has checkpointed PAST win_0 (so the
+        # resume has real progress to anchor on) and none has finished
+        # (so the kill actually lands mid-request).
+        deadline = time.time() + 600.0
+        while True:
+            if time.time() > deadline:
+                return ["server: runs never all reached a win_>0 "
+                        "checkpoint; lower --checkpoint-every"]
+            states = {}
+            for rid in ids:
+                rj = os.path.join(data, "runs", rid, "request.json")
+                if os.path.exists(rj):
+                    with open(rj) as f:
+                        states[rid] = json.load(f).get("state")
+            if any(s in ("done", "failed", "cancelled")
+                   for s in states.values()):
+                return [f"server: a run finished before the kill "
+                        f"({states}); raise --stop-time so the kill "
+                        f"lands mid-request"]
+            if all(any(int(os.path.basename(p)[4:-4]) > 0 for p in
+                       glob.glob(os.path.join(data, "runs", rid,
+                                              "ckpt", "win_*.npz")))
+                   for rid in ids):
+                break
+            time.sleep(0.1)
+        srv.send_signal(signal.SIGKILL)
+        srv.wait()
+        print("  SIGKILLed the server mid-request; restarting with "
+              "--auto-resume ...")
+    finally:
+        if srv.poll() is None:
+            srv.kill()
+            srv.wait()
+
+    srv = _serve(data, resume=True)
+    errs = []
+    try:
+        for rid, seed in sorted(ids.items()):
+            rc, out, err = _client(data, "status", rid, "--wait")
+            if rc != 0:
+                errs.append(f"server: {rid} (seed {seed}) settled rc "
+                            f"{rc}, expected 0\n{err}")
+                continue
+            rec = json.loads(out)
+            if not any("readmitted" in t for t in rec.get("trail", [])):
+                errs.append(f"server: {rid} trail records no "
+                            f"re-admission: {rec.get('trail')}")
+            if not rec.get("restarts"):
+                errs.append(f"server: {rid} restarts == 0 after a kill")
+            with open(os.path.join(refs[seed], "windows.jsonl"),
+                      "rb") as f:
+                want = f.read()
+            with open(os.path.join(data, "runs", rid,
+                                   "windows.jsonl"), "rb") as f:
+                got = f.read()
+            if want != got:
+                errs.append(f"server: {rid} windows.jsonl is not "
+                            f"byte-identical to the seed-{seed} solo "
+                            f"reference ({len(want)} vs {len(got)} "
+                            f"bytes)")
+            else:
+                print(f"  {rid}: rc 0, windows.jsonl byte-identical "
+                      f"to solo reference (restarts="
+                      f"{rec.get('restarts')})")
+        srv.terminate()  # SIGTERM: drain (nothing left in flight)
+        if srv.wait(timeout=60) != 0:
+            errs.append(f"server: drained serve exited rc "
+                        f"{srv.returncode}, expected 0")
+    finally:
+        if srv.poll() is None:
+            srv.kill()
+            srv.wait()
+    return errs
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="fault-injection drills for supervised runs")
-    ap.add_argument("config", help="shadow.config.xml to drill with")
-    ap.add_argument("--drill", choices=("all", "kill", "torn", "nan"),
+    ap.add_argument("config", help="shadow.config.xml to drill with "
+                    "(the server drill uses a built-in phold world)")
+    ap.add_argument("--drill",
+                    choices=("all", "kill", "torn", "nan", "server"),
                     default="all")
     ap.add_argument("--checkpoint-every", type=float, default=2.0,
                     metavar="SECONDS")
@@ -271,25 +455,28 @@ def main(argv=None) -> int:
     config = os.path.abspath(args.config)
     wd = args.workdir or tempfile.mkdtemp(prefix="faultdrill_")
     os.makedirs(wd, exist_ok=True)
-    drills = (("kill", "torn", "nan") if args.drill == "all"
+    drills = (("kill", "torn", "nan", "server") if args.drill == "all"
               else (args.drill,))
 
-    print(f"faultdrill: reference run ({args.stop_time}s sim, "
-          f"checkpoint every {args.checkpoint_every:g}s) ...")
+    ref_sum = None
     ref_dir = os.path.join(wd, "ref")
-    # A stale ref from an earlier --keep run would auto-resume (and
-    # trim its own windows.jsonl) instead of re-recording; start clean.
-    shutil.rmtree(ref_dir, ignore_errors=True)
     for name in drills:
         shutil.rmtree(os.path.join(wd, name), ignore_errors=True)
-    rc, out, err = _run(_cmd(config, ref_dir,
-                             every=args.checkpoint_every,
-                             stop=args.stop_time, resume=True))
-    if rc != 0:
-        print(f"faultdrill: reference run failed rc {rc}\n{err}",
-              file=sys.stderr)
-        return 1
-    ref_sum = _summary(out)
+    if set(drills) - {"server"}:
+        print(f"faultdrill: reference run ({args.stop_time}s sim, "
+              f"checkpoint every {args.checkpoint_every:g}s) ...")
+        # A stale ref from an earlier --keep run would auto-resume (and
+        # trim its own windows.jsonl) instead of re-recording; start
+        # clean.
+        shutil.rmtree(ref_dir, ignore_errors=True)
+        rc, out, err = _run(_cmd(config, ref_dir,
+                                 every=args.checkpoint_every,
+                                 stop=args.stop_time, resume=True))
+        if rc != 0:
+            print(f"faultdrill: reference run failed rc {rc}\n{err}",
+                  file=sys.stderr)
+            return 1
+        ref_sum = _summary(out)
 
     failures = []
     for name in drills:
@@ -301,6 +488,12 @@ def main(argv=None) -> int:
             errs = drill_kill(config, wd, ref_dir, ref_sum,
                               args.checkpoint_every, args.stop_time,
                               torn=True)
+        elif name == "server":
+            try:
+                errs = drill_server(wd, args.checkpoint_every,
+                                    args.stop_time)
+            except RuntimeError as e:
+                errs = [f"server: {e}"]
         else:
             errs = drill_nan(config, wd, ref_dir,
                              args.checkpoint_every, args.stop_time)

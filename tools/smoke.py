@@ -16,6 +16,9 @@ pin per subsystem:
   - lineage      test_lineage.py       traced vs untraced trajectories
   - statescope   test_statescope.py    digest determinism, mesh digest
                                        identity, fault localization
+  - server       test_server.py        serve round-trip: a submitted
+                                       run matches direct sim.run
+                                       bitwise, clean shutdown
 
 Together they run in well under five minutes on the virtual 8-device
 CPU mesh, giving a fast did-I-break-determinism signal before paying
