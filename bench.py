@@ -280,6 +280,129 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
     return 0
 
 
+# SERVED rung (--serve K): the Servescope observability probe.  K
+# identical phold builder requests go through a live resident run
+# server (one worker, so requests queue and the affinity path is
+# exercised); the aggregate queue-wait, affinity hit rate, and
+# requests/s land in a "server" block built from each run's
+# request_metrics.json.  A much smaller world than the solo probe --
+# the rung measures the scheduler, not the engine.
+SERVE_HOSTS = 1024
+SERVE_SIM_SECONDS = 1
+
+
+def main_served(k: int, queue_limit: int,
+                gate_against: str | None = None) -> int:
+    import tempfile
+    import threading
+
+    from shadow1_tpu import protocol, server
+
+    kw = dict(num_hosts=SERVE_HOSTS, msgs_per_host=MSGS_PER_HOST,
+              seed=11,
+              stop_time=(SERVE_SIM_SECONDS + 1)
+              * simtime.SIMTIME_ONE_SECOND)
+    spec = {"name": "phold", "kwargs": kw, "checkpoint_every": 2.0}
+    results = [None] * k
+
+    def _submit(i):
+        rid, rc = None, None
+        for ev in protocol.stream(
+                protocol.default_socket(data_dir),
+                {"op": "submit", "kind": "builder", "spec": spec,
+                 "wait": True, "progress": False}):
+            if rid is None and ev.get("id"):
+                rid = ev["id"]
+            if not ev.get("ok", True):
+                rc = ev.get("rc")
+                break
+            if ev.get("event") == "done":
+                rc = ev.get("rc")
+                break
+        results[i] = (rid, rc)
+
+    with tempfile.TemporaryDirectory(prefix="shadow1-serve-bench-") \
+            as data_dir:
+        srv = server.Server(data_dir, workers=1,
+                            queue_limit=max(queue_limit, k),
+                            quiet=True).start()
+        try:
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=_submit, args=(i,))
+                       for i in range(k)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            span = time.perf_counter() - t0
+        finally:
+            srv.shutdown()
+        if any(r is None or r[0] is None or r[1] != 0 for r in results):
+            print(f"bench --serve: not all {k} requests finished rc 0: "
+                  f"{results}", file=sys.stderr)
+            return 1
+        per_req = []
+        for rid, _rc in results:
+            with open(os.path.join(data_dir, "runs", rid,
+                                   "request_metrics.json")) as f:
+                per_req.append(json.load(f))
+
+    waits = [m["queue_wait_s"] for m in per_req]
+    hits = sum(1 for m in per_req if m.get("affinity_hit"))
+    events = sum(m["events"] for m in per_req
+                 if m.get("events") is not None)
+    result = {
+        "metric": "phold_events_per_sec",
+        "value": round(events / span, 2),
+        "unit": "events/sec",
+        "wall_sec": round(span, 2),
+        "config": {
+            "num_hosts": SERVE_HOSTS,
+            "msgs_per_host": MSGS_PER_HOST,
+            "sim_seconds": SERVE_SIM_SECONDS,
+            "megakernel": True,
+            "netem": None,
+            "scope": None,
+            "lineage": None,
+            "digest": None,
+            # Served runs checkpoint on the server's cadence (the
+            # crash-safety contract), unlike the solo probe.
+            "checkpoint_every": 2.0,
+            "sentinel": False,
+            "supervise": True,
+            "serve": True,
+            # Queue waits scale with the admission bound, so benchdiff
+            # buckets served rounds by it (the n_devices rule).
+            "queue_limit": max(queue_limit, k),
+            "requests": k,
+        },
+        "env": {
+            "backend": jax.default_backend(),
+            "cpu_count": os.cpu_count(),
+            "n_devices": 1,
+        },
+        # server.* is machine-bound in benchdiff (scheduler wall times):
+        # informational across environments, gated within one.
+        "server": {
+            "requests": k,
+            "workers": 1,
+            "requests_per_sec": round(k / span, 4),
+            "queue_wait_total_s": round(sum(waits), 4),
+            "queue_wait_mean_s": round(sum(waits) / k, 4),
+            "queue_wait_max_s": round(max(waits), 4),
+            "affinity_hits": hits,
+            "affinity_hit_rate": round(hits / k, 4),
+            "compiles_total": sum(m.get("compiles") or 0
+                                  for m in per_req),
+            "events": events,
+        },
+    }
+    print(json.dumps(result))
+    if gate_against:
+        return _gate(gate_against, result)
+    return 0
+
+
 # MULTICHIP scaling rung (--devices N): a smaller fixed world than the
 # single-chip probe, because every rung of the ladder (1, 2, 4, .., N
 # devices) runs it to completion and the 1-device rung bounds the wall
@@ -485,11 +608,24 @@ if __name__ == "__main__":
                          "count; virtual CPU devices when the backend "
                          "lacks real ones) and print one JSON line with "
                          "the scaling block")
+    ap.add_argument("--serve", type=int, default=None, metavar="K",
+                    help="SERVED rung: submit K identical phold "
+                         "requests through a live resident run server "
+                         "(one worker) and record aggregate queue-wait, "
+                         "affinity hit rate, and requests/s in a "
+                         "'server' block (Servescope, "
+                         "docs/observability.md)")
+    ap.add_argument("--queue-limit", type=int, default=8, metavar="N",
+                    help="admission-queue bound for --serve (raised to "
+                         "K when smaller; stamped in the config block "
+                         "so benchdiff buckets served rounds by it)")
     ap.add_argument("--mesh-child", type=int, default=None,
                     help=argparse.SUPPRESS)
     ns = ap.parse_args()
     if ns.mesh_child:
         sys.exit(_mesh_child(ns.mesh_child))
+    if ns.serve:
+        sys.exit(main_served(ns.serve, ns.queue_limit, ns.gate_against))
     if ns.devices:
         sys.exit(main_multichip(ns.devices, ns.gate_against))
     # The TPU tunnel's compile service occasionally drops a request

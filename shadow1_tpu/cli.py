@@ -471,6 +471,17 @@ def _parser():
                     help="with an id: block until the run settles and "
                          "exit with its rc")
 
+    sx = sub.add_parser(
+        "stats",
+        help="live fleet view of a run server (Servescope): queue, "
+             "workers, affinity hit rate, recent completions")
+    _add_client_flags(sx)
+    sx.add_argument("--watch", type=float, default=None, metavar="N",
+                    help="redraw every N seconds until interrupted")
+    sx.add_argument("--json", action="store_true",
+                    help="print the raw stats JSON instead of the "
+                         "rendered screen")
+
     cn = sub.add_parser("cancel", help="cancel a queued or running "
                                        "request (rc 3 on its record)")
     cn.add_argument("id", help="request id")
@@ -746,25 +757,29 @@ class _EmitStream:
         pass
 
 
-def run_config(args, *, control=None, emit=None) -> int:
+def run_config(args, *, control=None, emit=None, profiler=None) -> int:
     """Execute a `run` invocation.  `control` / `emit` are the run
     server's hooks (server.RunControl + an event callback): the loop
     polls `control` at every launch boundary -- "park" checkpoints and
     stops (control.outcome="parked", rc 0), "cancel" stops (rc 3),
     "timeout" stops with a refusal naming --timeout (rc 2) -- and
-    `emit` receives progress/summary/crash events for relay.  Both
-    default to None: the batch CLI path is unchanged."""
+    `emit` receives progress/summary/crash events for relay.  All
+    default to None: the batch CLI path is unchanged.  `profiler` is
+    the server's per-request accounting Profiler (counters=False, so
+    the state pytree stays untouched); --profile overrides it with the
+    CLI's own sync+counters one."""
     import os
 
     from . import trace
 
-    profiler = None
     if args.profile:
         if not args.data_directory:
             print("error: --profile requires --data-directory",
                   file=sys.stderr)
             return RC_USAGE
         profiler = trace.install(trace.Profiler(sync=True))
+    elif profiler is not None:
+        profiler = trace.install(profiler)
 
     scope_kw = None
     if args.scope:
@@ -881,6 +896,8 @@ def run_config(args, *, control=None, emit=None) -> int:
                           f"(t={resumed_from['t_ns'] / SEC:g}s) from "
                           f"{resumed_from['file']}; trimmed {dropped} "
                           f"superseded row(s)", file=sys.stderr)
+                if emit is not None:
+                    emit({"event": "resumed", **resumed_from})
 
     tracker = None
     if args.data_directory and args.heartbeat_frequency > 0:
@@ -993,7 +1010,8 @@ def run_config(args, *, control=None, emit=None) -> int:
                         f"--checkpoint-every {args.checkpoint_every:g} "
                         f"--data-directory {args.data_directory}"),
             on_violation=(lambda st: flight.drain(st, profiler))
-            if flight is not None else None)
+            if flight is not None else None,
+            emit=emit)
     hb_ns = tracker.sample_interval_ns if tracker else None
     t = int(state.now)
     # Every synchronous host-side drain behind one call (sim.Drains):
@@ -1304,6 +1322,9 @@ def main(argv=None) -> int:
     if args.cmd == "status":
         from .client import status_cmd
         return status_cmd(args)
+    if args.cmd == "stats":
+        from .client import stats_cmd
+        return stats_cmd(args)
     if args.cmd == "cancel":
         from .client import cancel_cmd
         return cancel_cmd(args)

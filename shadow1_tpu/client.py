@@ -1,4 +1,5 @@
-"""Client half of the run server: `shadow1-tpu submit/status/cancel`.
+"""Client half of the run server: `shadow1-tpu
+submit/status/stats/cancel`.
 
 Thin and synchronous: each command opens one connection to the serve
 socket (protocol.py), sends one request, and -- for `submit --wait` /
@@ -208,7 +209,99 @@ def _wait_status(path, msg) -> int:
             print(json.dumps(final.get("run"), indent=1, sort_keys=True))
     except (ConnectionError, OSError):
         pass  # server exited right after the drain-park event
+    print(f"[shadow1-tpu] {msg['id']}: exit rc {rc}", file=sys.stderr)
     return rc
+
+
+def _render_stats(st: dict) -> str:
+    """One-screen fleet view (`top` for simulations) from a stats
+    snapshot: queue, workers, affinity, journal, recent completions."""
+    lines = []
+    q = st.get("queue") or {}
+    rq = st.get("requests") or {}
+    af = st.get("affinity") or {}
+    jn = st.get("journal") or {}
+    rec = st.get("recovery") or {}
+    lines.append(
+        f"shadow1-tpu server pid {st.get('pid')}  "
+        f"up {st.get('uptime_s', 0):.0f}s  "
+        f"{'DRAINING' if st.get('draining') else 'serving'}  "
+        f"warm buckets {(st.get('warm') or {}).get('buckets', 0)}")
+    states = st.get("states") or {}
+    parts = " ".join(f"{k}={v}" for k, v in sorted(states.items()))
+    lines.append(f"requests: {rq.get('submitted', 0)} submitted"
+                 + (f" | {parts}" if parts else ""))
+    hr = af.get("hit_rate")
+    lines.append(
+        f"queue: {q.get('depth', 0)}/{q.get('limit', '?')} "
+        f"(high-water {q.get('high_water', 0)})  affinity "
+        f"{af.get('hits', 0)} hit / {af.get('misses', 0)} miss"
+        + (f" ({100 * hr:.0f}%)" if hr is not None else ""))
+    for w in q.get("queued") or []:
+        lines.append(f"  q[{w['position']}] {w['id']} "
+                     f"waiting {w['queue_wait_s']:.1f}s")
+    for w in st.get("workers") or []:
+        cur = w.get("current")
+        busy = f"running {cur} for {w.get('busy_for_s'):.1f}s" \
+            if cur else "idle"
+        lines.append(f"worker {w['id']}: {busy}  "
+                     f"(lifetime busy {w.get('busy_s', 0):.1f}s, "
+                     f"{w.get('runs', 0)} run(s))")
+    fm = jn.get("fsync_ms_mean")
+    lines.append(
+        f"journal: {jn.get('events', 0)} event(s), "
+        f"{jn.get('fsyncs', 0)} fsync(s)"
+        + (f" ({fm:.2f} ms mean)" if fm is not None else "")
+        + f"  recovery: {rec.get('readmitted', 0)} readmitted, "
+          f"{rec.get('parked', 0)} parked, {rec.get('resumes', 0)} "
+          f"resume(s), {rec.get('recoveries', 0)} ladder rung(s)")
+    recent = st.get("recent") or []
+    if recent:
+        lines.append("recent:")
+        for r in recent[-8:]:
+            wall = r.get("wall_s")
+            lines.append(
+                f"  {r['id']} {r.get('kind')}: {r.get('state')} "
+                f"rc {r.get('rc')}  wall "
+                + (f"{wall:.1f}s" if wall is not None else "-")
+                + f"  queued {r.get('queue_wait_s', 0):.1f}s  "
+                + ("hit" if r.get("affinity_hit") else "miss"))
+    return "\n".join(lines)
+
+
+def stats_cmd(args) -> int:
+    """`shadow1-tpu stats [--watch N] [--json]`: fleet snapshot(s) from
+    a live server's `stats` op."""
+    import time as time_mod
+    path = _socket_path(args)
+    if path is None:
+        return RC_USAGE
+    while True:
+        try:
+            resp = protocol.request(path, {"op": "stats"})
+        except protocol.ServerUnavailable as e:
+            print(f"error: {e}", file=sys.stderr)
+            return RC_USAGE
+        except (ConnectionError, OSError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return RC_FAILED
+        if not resp.get("ok"):
+            print(f"error: {resp.get('error')}", file=sys.stderr)
+            return int(resp.get("rc", RC_USAGE))
+        st = resp.get("stats") or {}
+        if args.json:
+            print(json.dumps(st, indent=1, sort_keys=True))
+        else:
+            if args.watch:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(_render_stats(st))
+            sys.stdout.flush()
+        if not args.watch:
+            return RC_OK
+        try:
+            time_mod.sleep(args.watch)
+        except KeyboardInterrupt:
+            return RC_OK
 
 
 def cancel_cmd(args) -> int:

@@ -20,6 +20,10 @@ Ops (client -> server):
               "wait": bool, "progress": bool}
     status    {"id": run-id|None, "wait": bool}: a run record, or the
               whole server snapshot
+    stats     fleet snapshot (Servescope): queue depth/high-water,
+              per-worker busy time, affinity hit rate, requests by
+              state/kind/rc, journal fsync latency, recent
+              completions -- the same JSON server/metrics.json holds
     cancel    {"id": run-id}
     shutdown  {"drain": bool}: park in-flight runs (drain) or stop at
               the next boundary, journal, and exit

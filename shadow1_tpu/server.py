@@ -33,11 +33,27 @@ start.  Exit codes ride supervise.py's unified table end-to-end: the
 rc a run would exit the CLI with is the rc `submit --wait` /
 `status --wait` exits with.
 
+Observability (Servescope; docs/observability.md "Servescope"): every
+request finishes with ``runs/<id>/request_metrics.json`` (queue-wait,
+affinity hit/miss, compile count + wall, device-step and host-drain
+wall, ``host_drain_overlap_pct``, events/s, park/resume/recovery
+counts) assembled from a per-request host-side Profiler
+(``sync=False, counters=False`` -- the state pytree is untouched, so a
+served run stays byte-identical to an unobserved one); a server-wide
+counter registry (`ServerMetrics`) is snapshotted atomically to
+``server/metrics.json`` on a cadence and served live by the ``stats``
+protocol op; and every lifecycle transition appends one span row to
+``server/schedule.jsonl``, which is REGENERATED from the journal on
+every start -- the journal is ground truth, so the scheduler trace
+survives a SIGKILL with no lost transitions.
+
 See docs/robustness.md "Run server".
 """
 
 from __future__ import annotations
 
+import collections
+import glob as glob_mod
 import json
 import os
 import queue as queue_mod
@@ -132,6 +148,26 @@ class Request:
         self.shape_hint = _shape_hint(kind, spec)
         self.control = None      # RunControl while running
         self.subscribers = []    # list[queue.Queue] of live streams
+        # Servescope scheduler stamps (per-request accounting).
+        self.enqueued_at = self.submitted  # when it last entered the queue
+        self.queue_wait = 0.0    # accumulated queued seconds, ALL lives
+        self.started = None      # wall time the last execution started
+        self.finished = None     # wall time the run settled
+        self.worker = None       # worker index that picked it
+        self.affinity_hit = None  # shape hint matched the warm graph
+        self.pick_reason = None  # "affinity" (jumped FIFO) | "fifo"
+        self.parks = 0           # server-drain parks taken
+        self.resumes = 0         # checkpoint resumes (emit "resumed")
+        self.recoveries = 0      # ladder rungs taken (emit "recovered")
+        self.profiler = None     # per-request trace.Profiler while running
+
+    def queue_wait_s(self) -> float:
+        """Accumulated queue-wait over every server life, plus the
+        wait-so-far when the request is still queued."""
+        w = self.queue_wait
+        if self.state == protocol.QUEUED and self.enqueued_at is not None:
+            w += max(0.0, time.time() - self.enqueued_at)
+        return round(w, 6)
 
     def record(self, run_dir: str) -> dict:
         return {
@@ -141,7 +177,131 @@ class Request:
             "restarts": self.restarts, "trail": list(self.trail),
             "error": self.error, "crash": self.crash,
             "summary": self.summary,
+            "shape_hint": self.shape_hint,
+            "queue_wait_s": self.queue_wait_s(),
         }
+
+
+class ServerMetrics:
+    """Server-wide counter registry (Servescope tentpole 2): requests
+    by state/kind/rc, queue high-water, per-worker busy time, affinity
+    hit rate, journal fsync count + latency, recovery/readmit counts,
+    and a recent-completions ring.  All mutation is under one small
+    lock; `snapshot()` returns a JSON-able view the stats op and the
+    server/metrics.json cadence writer share.  Host-side bookkeeping
+    only -- nothing here touches a run's state pytree."""
+
+    RECENT = 16
+
+    def __init__(self, workers: int):
+        self._lock = threading.Lock()
+        self.t0 = time.time()
+        self.submitted = 0
+        self.by_state = {}       # terminal outcomes: state -> count
+        self.by_kind = {}        # admissions: kind -> count
+        self.by_rc = {}          # terminal outcomes: rc -> count
+        self.readmitted = 0
+        self.parked = 0
+        self.resumes = 0
+        self.recoveries = 0
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+        self.queue_high_water = 0
+        self.journal_events = 0
+        self.fsyncs = 0
+        self.fsync_s = 0.0
+        self.workers = [{"busy_s": 0.0, "runs": 0, "current": None,
+                         "since": None} for _ in range(workers)]
+        self.recent = collections.deque(maxlen=self.RECENT)
+
+    def submit(self, kind: str, depth: int) -> None:
+        with self._lock:
+            self.submitted += 1
+            self.by_kind[kind] = self.by_kind.get(kind, 0) + 1
+            self.queue_high_water = max(self.queue_high_water, depth)
+
+    def pick(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.affinity_hits += 1
+            else:
+                self.affinity_misses += 1
+
+    def journal(self, fsync_s: float) -> None:
+        with self._lock:
+            self.journal_events += 1
+            self.fsyncs += 1
+            self.fsync_s += fsync_s
+
+    def worker_start(self, i: int, rid: str) -> None:
+        with self._lock:
+            w = self.workers[i]
+            w["current"], w["since"] = rid, time.time()
+
+    def worker_done(self, i: int) -> None:
+        with self._lock:
+            w = self.workers[i]
+            if w["since"] is not None:
+                w["busy_s"] += time.time() - w["since"]
+            w["runs"] += 1
+            w["current"], w["since"] = None, None
+
+    def event(self, name: str, n: int = 1) -> None:
+        """Bump a named lifecycle counter (readmitted / parked /
+        resumes / recoveries)."""
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+    def settle(self, req: "Request") -> None:
+        """Account one terminal outcome and ring-buffer it."""
+        with self._lock:
+            self.by_state[req.state] = self.by_state.get(req.state, 0) + 1
+            key = str(req.rc)
+            self.by_rc[key] = self.by_rc.get(key, 0) + 1
+            wall = None
+            if req.started is not None and req.finished is not None:
+                wall = round(req.finished - req.started, 3)
+            self.recent.append({
+                "id": req.id, "kind": req.kind, "state": req.state,
+                "rc": req.rc, "wall_s": wall,
+                "queue_wait_s": req.queue_wait_s(),
+                "affinity_hit": req.affinity_hit})
+
+    def snapshot(self) -> dict:
+        now = time.time()
+        with self._lock:
+            hits, misses = self.affinity_hits, self.affinity_misses
+            picks = hits + misses
+            return {
+                "uptime_s": round(now - self.t0, 3),
+                "requests": {
+                    "submitted": self.submitted,
+                    "by_state": dict(self.by_state),
+                    "by_kind": dict(self.by_kind),
+                    "by_rc": dict(self.by_rc)},
+                "affinity": {
+                    "hits": hits, "misses": misses,
+                    "hit_rate": round(hits / picks, 4) if picks else None},
+                "journal": {
+                    "events": self.journal_events,
+                    "fsyncs": self.fsyncs,
+                    "fsync_ms_total": round(self.fsync_s * 1e3, 3),
+                    "fsync_ms_mean": round(
+                        self.fsync_s / self.fsyncs * 1e3, 3)
+                    if self.fsyncs else None},
+                "workers": [{
+                    "id": i, "busy_s": round(w["busy_s"], 3),
+                    "runs": w["runs"], "current": w["current"],
+                    "busy_for_s": round(now - w["since"], 3)
+                    if w["since"] is not None else None}
+                    for i, w in enumerate(self.workers)],
+                "recovery": {
+                    "readmitted": self.readmitted,
+                    "parked": self.parked,
+                    "resumes": self.resumes,
+                    "recoveries": self.recoveries},
+                "recent": list(self.recent),
+            }
 
 
 class Server:
@@ -155,7 +315,7 @@ class Server:
     def __init__(self, data_dir: str, *, queue_limit: int = 8,
                  workers: int = 1, checkpoint_every: float = 2.0,
                  watchdog: float | None = None, auto_resume: bool = False,
-                 quiet: bool = True):
+                 metrics_every: float = 2.0, quiet: bool = True):
         self.data_dir = data_dir
         self.sdir = os.path.join(data_dir, "server")
         self.runs_dir = os.path.join(data_dir, "runs")
@@ -165,8 +325,10 @@ class Server:
         self.checkpoint_every = float(checkpoint_every)
         self.watchdog = watchdog
         self.auto_resume = bool(auto_resume)
+        self.metrics_every = float(metrics_every)
         self.quiet = quiet
         self.warmed = None       # shapes.warm_buckets records, if warmed
+        self.metrics = ServerMetrics(self.workers)
 
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
@@ -178,6 +340,7 @@ class Server:
         self._stopping = False
         self._done = threading.Event()
         self._journal = None
+        self._schedule = None    # server/schedule.jsonl live handle
         self._listener = None
         self._worker_threads = []
         self._readmitted = []
@@ -188,16 +351,35 @@ class Server:
         os.makedirs(self.sdir, exist_ok=True)
         os.makedirs(self.runs_dir, exist_ok=True)
         self._recover()
-        self._journal = open(os.path.join(self.sdir, "journal.jsonl"),
-                             "a", encoding="utf-8")
+        jpath = os.path.join(self.sdir, "journal.jsonl")
+        self._journal = open(jpath, "a", encoding="utf-8")
+        # schedule.jsonl is DERIVED: regenerate it from the fsync'd
+        # journal on every start, so a SIGKILL never loses a scheduler
+        # transition, then keep the handle open for live appends.
+        self._schedule = open(os.path.join(self.sdir, "schedule.jsonl"),
+                              "w", encoding="utf-8")
+        if os.path.exists(jpath):
+            with open(jpath, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail line from a killed writer
+                    self._append_schedule(ev)
         for req in self._readmitted:
             if req.state == protocol.QUEUED:
                 # Journal the re-admission so a second crash still
                 # counts every restart in the trail.  Stranded (parked,
                 # no --auto-resume) requests are only re-mirrored.
-                self._log({"ev": "readmit", "id": req.id})
+                self._log({"ev": "readmit", "id": req.id,
+                           "t": req.enqueued_at})
             self._sync_request(req)
         self._readmitted = []
+        if self._readmit_count:
+            self.metrics.event("readmitted", self._readmit_count)
 
         # A stale socket file from a killed server blocks bind(); it is
         # only stale if nobody answers on it.
@@ -218,10 +400,14 @@ class Server:
                              name="shadow1-serve-accept")
         t.start()
         for i in range(self.workers):
-            wt = threading.Thread(target=self._worker_loop, daemon=True,
+            wt = threading.Thread(target=self._worker_loop, args=(i,),
+                                  daemon=True,
                                   name=f"shadow1-serve-worker-{i}")
             wt.start()
             self._worker_threads.append(wt)
+        self._write_metrics_snapshot()
+        threading.Thread(target=self._metrics_loop, daemon=True,
+                         name="shadow1-serve-metrics").start()
         self._say(f"serve: listening on {self.sock_path} "
                   f"(queue-limit {self.queue_limit}, "
                   f"workers {self.workers}"
@@ -260,7 +446,8 @@ class Server:
         with self._cond:
             self._stopping = True
             self._cond.notify_all()
-            self._log({"ev": "drain", "parked": [r.id for r in running]})
+            self._log({"ev": "drain", "parked": [r.id for r in running],
+                       "t": time.time()})
         try:
             self._listener.close()
         except OSError:
@@ -271,8 +458,12 @@ class Server:
             pass
         for t in self._worker_threads:
             t.join(timeout=10)
+        self._write_metrics_snapshot()
         with self._lock:
             self._journal.close()
+            if self._schedule is not None:
+                self._schedule.close()
+                self._schedule = None
         self._say("serve: stopped")
         self._done.set()
 
@@ -284,7 +475,51 @@ class Server:
         with self._lock:
             self._journal.write(json.dumps(ev, sort_keys=True) + "\n")
             self._journal.flush()
+            t0 = time.perf_counter()
             os.fsync(self._journal.fileno())
+            self.metrics.journal(time.perf_counter() - t0)
+            self._append_schedule(ev)
+
+    _SCHEDULE_STATE = {
+        "submit": protocol.QUEUED, "start": protocol.RUNNING,
+        "park": protocol.PARKED, "cancel": protocol.CANCELLED,
+        "readmit": protocol.QUEUED}
+
+    def _schedule_row(self, ev: dict) -> dict | None:
+        """Map one journal event to one schedule.jsonl span row: the
+        lifecycle transition plus the scheduler context (shape hint,
+        worker id, affinity hit, pick reason, queue depth at pick)."""
+        name = ev.get("ev")
+        if name == "drain":
+            return {"t": ev.get("t"), "ev": "drain", "id": None,
+                    "parked": ev.get("parked")}
+        rid = ev.get("id")
+        if rid is None or (name not in self._SCHEDULE_STATE
+                           and name != "finish"):
+            return None
+        state = ev.get("state") if name == "finish" \
+            else self._SCHEDULE_STATE[name]
+        row = {"t": ev.get("t"), "ev": name, "id": rid, "state": state}
+        req = self._reqs.get(rid)
+        if req is not None:
+            row["kind"] = req.kind
+            row["shape_hint"] = req.shape_hint
+        for k in ("worker", "hit", "reason", "depth", "rc"):
+            if k in ev:
+                row[k] = ev[k]
+        return row
+
+    def _append_schedule(self, ev: dict) -> None:
+        """Append the schedule row for a journal event (call under the
+        lock).  flush but no fsync: the journal is ground truth and the
+        whole file is regenerated from it on start."""
+        if self._schedule is None:
+            return
+        row = self._schedule_row(ev)
+        if row is None:
+            return
+        self._schedule.write(json.dumps(row, sort_keys=True) + "\n")
+        self._schedule.flush()
 
     _readmit_count = 0
 
@@ -315,6 +550,14 @@ class Server:
                 req.trail.append(
                     f"readmitted (was {was} when the server stopped)")
                 req.state = protocol.QUEUED
+                # Queue-wait accumulates across server lives: close the
+                # open queued segment (includes the dead-server gap --
+                # the client was waiting the whole time) and start a new
+                # one at re-admission.
+                now = time.time()
+                if req.enqueued_at is not None:
+                    req.queue_wait += max(0.0, now - req.enqueued_at)
+                req.enqueued_at = now
                 self._queue.append(req.id)
                 self._readmitted.append(req)
             else:
@@ -343,21 +586,51 @@ class Server:
         if t == "start":
             req.state = protocol.RUNNING
             req.trail.append("started")
+            ts = ev.get("t")
+            if ts is not None:
+                if req.enqueued_at is not None:
+                    req.queue_wait += max(0.0, ts - req.enqueued_at)
+                req.enqueued_at = None
+                req.started = ts
+            req.worker = ev.get("worker", req.worker)
+            if "hit" in ev:
+                req.affinity_hit = ev["hit"]
+            if "reason" in ev:
+                req.pick_reason = ev["reason"]
         elif t == "finish":
             req.state = ev.get("state", protocol.FAILED)
             req.rc = ev.get("rc")
             req.trail.append(f"finished rc {req.rc}")
+            req.finished = ev.get("t")
+            # A queued-timeout refusal finishes without a start: the
+            # open queued segment still counts as wait.
+            if req.finished is not None and req.enqueued_at is not None:
+                req.queue_wait += max(
+                    0.0, req.finished - req.enqueued_at)
+            req.enqueued_at = None
         elif t == "park":
             req.state = protocol.PARKED
             req.trail.append("parked (server drain)")
+            req.parks += 1
+            req.enqueued_at = None
         elif t == "cancel":
             req.state = protocol.CANCELLED
             req.rc = RC_FAILED
             req.trail.append("cancelled")
+            req.finished = ev.get("t")
+            if req.finished is not None and req.enqueued_at is not None:
+                req.queue_wait += max(
+                    0.0, req.finished - req.enqueued_at)
+            req.enqueued_at = None
         elif t == "readmit":
             req.restarts += 1
             req.state = protocol.QUEUED
             req.trail.append("readmitted")
+            ts = ev.get("t")
+            if ts is not None:
+                if req.enqueued_at is not None:
+                    req.queue_wait += max(0.0, ts - req.enqueued_at)
+                req.enqueued_at = ts
 
     @staticmethod
     def _id_num(rid):
@@ -413,6 +686,8 @@ class Server:
                 self._op_submit(msg, wf)
             elif op == "status":
                 self._op_status(msg, wf)
+            elif op == "stats":
+                protocol.send(wf, {"ok": True, "stats": self._stats()})
             elif op == "cancel":
                 self._op_cancel(msg, wf)
             elif op == "shutdown":
@@ -453,6 +728,7 @@ class Server:
                        "t": req.submitted})
             self._reqs[rid] = req
             self._queue.append(rid)
+            self.metrics.submit(kind, len(self._queue))
             if msg.get("wait"):
                 sub = queue_mod.Queue()
                 req.subscribers.append(sub)
@@ -515,7 +791,7 @@ class Server:
                         "workers": self.workers,
                         "draining": self._draining,
                         "warmed": bool(self.warmed)},
-                    "runs": [r.record(os.path.join(self.runs_dir, r.id))
+                    "runs": [self._record_locked(r)
                              for _, r in sorted(self._reqs.items())]}
             protocol.send(wf, snap)
             return
@@ -526,7 +802,7 @@ class Server:
                 protocol.send(wf, {"ok": False, "rc": RC_USAGE,
                                    "error": f"unknown run id {rid!r}"})
                 return
-            rec = req.record(os.path.join(self.runs_dir, rid))
+            rec = self._record_locked(req)
             wait = bool(msg.get("wait"))
             if wait and req.state in (protocol.QUEUED, protocol.RUNNING):
                 sub = queue_mod.Queue()
@@ -545,6 +821,14 @@ class Server:
                                    "error": req.error,
                                    "summary": req.summary})
 
+    def _record_locked(self, req: Request) -> dict:
+        """record() plus the live queue position (call under the lock):
+        a queued request's status names where it sits in line."""
+        rec = req.record(os.path.join(self.runs_dir, req.id))
+        if req.state == protocol.QUEUED and req.id in self._queue:
+            rec["queue_position"] = self._queue.index(req.id)
+        return rec
+
     def _op_cancel(self, msg, wf):
         rid = msg.get("id")
         with self._lock:
@@ -558,7 +842,13 @@ class Server:
                 req.state = protocol.CANCELLED
                 req.rc = RC_FAILED
                 req.trail.append("cancelled")
-                self._log({"ev": "cancel", "id": rid})
+                now = time.time()
+                req.finished = now
+                if req.enqueued_at is not None:
+                    req.queue_wait += max(0.0, now - req.enqueued_at)
+                    req.enqueued_at = None
+                self._log({"ev": "cancel", "id": rid, "t": now})
+                self.metrics.settle(req)
                 done = {"event": "done", "id": rid, "rc": RC_FAILED,
                         "state": protocol.CANCELLED}
                 subs = list(req.subscribers)
@@ -574,6 +864,8 @@ class Server:
                         "note": "already settled"}
         for q in subs:
             q.put(done)
+        if done is not None:
+            self._write_request_metrics(req)
         self._sync_request(req)
         protocol.send(wf, resp)
 
@@ -609,7 +901,7 @@ class Server:
 
     # -- scheduler + workers ---------------------------------------------
 
-    def _worker_loop(self):
+    def _worker_loop(self, widx: int):
         while True:
             with self._cond:
                 while (not self._queue or self._draining) \
@@ -617,15 +909,21 @@ class Server:
                     self._cond.wait(0.25)
                 if self._stopping:
                     return
-                req = self._pick_locked()
+                req = self._pick_locked(widx)
                 if req is None:
                     continue
-            self._execute(req)
+            self.metrics.worker_start(widx, req.id)
+            try:
+                self._execute(req)
+            finally:
+                self.metrics.worker_done(widx)
 
-    def _pick_locked(self):
+    def _pick_locked(self, worker: int):
         """Warm-graph affinity: prefer the oldest queued request whose
         shape hint matches the last-executed one (it reuses the
-        compiled graph); fall back to FIFO."""
+        compiled graph); fall back to FIFO.  Stamps the pick on the
+        request: worker id, affinity hit/miss, and whether affinity
+        (not queue order) made the choice."""
         if self._draining or not self._queue:
             return None
         idx = 0
@@ -636,11 +934,24 @@ class Server:
                     break
         rid = self._queue.pop(idx)
         req = self._reqs[rid]
+        req.worker = worker
+        req.affinity_hit = (self._last_hint is not None
+                            and req.shape_hint == self._last_hint)
+        req.pick_reason = "affinity" if (req.affinity_hit and idx > 0) \
+            else "fifo"
         self._last_hint = req.shape_hint
+        self.metrics.pick(req.affinity_hit)
         return req
 
     def _execute(self, req: Request) -> None:
+        from . import trace
         now = time.time()
+        with self._lock:
+            # Close the open queued segment: the request is off the
+            # queue whether it runs or is refused below.
+            if req.enqueued_at is not None:
+                req.queue_wait += max(0.0, now - req.enqueued_at)
+                req.enqueued_at = None
         if req.timeout and now - req.submitted >= req.timeout:
             self._finish(req, RC_USAGE, error=(
                 f"request {req.id} spent {now - req.submitted:.1f}s "
@@ -656,8 +967,16 @@ class Server:
         with self._lock:
             req.control = RunControl(deadline)
             req.state = protocol.RUNNING
+            req.started = now
             req.trail.append("started")
-            self._log({"ev": "start", "id": req.id})
+            # counters=False: per-request accounting must stay host-side
+            # only -- a served run's state pytree (and so its
+            # trajectory) is byte-identical to an unobserved one.
+            req.profiler = trace.Profiler(sync=False, counters=False)
+            self._log({"ev": "start", "id": req.id, "t": now,
+                       "worker": req.worker, "hit": req.affinity_hit,
+                       "reason": req.pick_reason,
+                       "depth": len(self._queue)})
         self._sync_request(req)
         self._emit(req, {"event": "state", "id": req.id,
                          "state": protocol.RUNNING})
@@ -672,6 +991,12 @@ class Server:
                     "path": ev.get("path")
                     or os.path.join(run_dir, "crash.json"),
                     "class": crash.get("failure", {}).get("class")}
+            elif ev.get("event") == "resumed":
+                req.resumes += 1
+                self.metrics.event("resumes")
+            elif ev.get("event") == "recovered":
+                req.recoveries += 1
+                self.metrics.event("recoveries")
             self._emit(req, ev)
 
         try:
@@ -681,12 +1006,22 @@ class Server:
             if not self.quiet:
                 traceback.print_exc()
             rc = RC_FAILED
+        finally:
+            # The run loop installs req.profiler process-globally; drop
+            # it so later requests (or the warm thread) can't attribute
+            # their compiles to a finished request.  Best-effort under
+            # workers>1 -- the install slot is global by design.
+            if trace.current() is req.profiler:
+                trace.install(None)
         outcome = req.control.outcome
         if outcome == "parked":
             with self._lock:
                 req.state = protocol.PARKED
+                req.parks += 1
                 req.trail.append("parked (server drain)")
-                self._log({"ev": "park", "id": req.id})
+                self._log({"ev": "park", "id": req.id,
+                           "t": time.time()})
+            self.metrics.event("parked")
             self._sync_request(req)
             self._emit(req, {"event": "parked", "id": req.id})
         elif outcome == "cancelled":
@@ -741,7 +1076,8 @@ class Server:
         if getattr(ns, "watchdog", None) is None:
             ns.watchdog = self.watchdog
         ns.progress = bool(spec.get("progress"))
-        return cli.run_config(ns, control=control, emit=emit)
+        return cli.run_config(ns, control=control, emit=emit,
+                              profiler=req.profiler)
 
     def _run_builder_kind(self, req, run_dir, control, emit) -> int:
         from . import sim
@@ -765,6 +1101,7 @@ class Server:
                 checkpoint_dir=run_dir,
                 checkpoint_world=(name, kwargs),
                 supervise={"watchdog_s": wd, "quiet": True},
+                profiler=req.profiler,
                 control=control, emit=emit, resume=True)
         except UnrecoveredFailure as e:
             req.error = str(e)
@@ -812,6 +1149,12 @@ class Server:
             req.rc = int(rc)
             req.state = state or (protocol.DONE if rc == RC_OK
                                   else protocol.FAILED)
+            req.finished = time.time()
+            if req.enqueued_at is not None:
+                # Settled without ever starting (queued refusal).
+                req.queue_wait += max(0.0,
+                                      req.finished - req.enqueued_at)
+                req.enqueued_at = None
             if error:
                 req.error = error
             req.trail.append(f"finished rc {req.rc}")
@@ -820,7 +1163,9 @@ class Server:
                 if os.path.exists(p):
                     req.crash = {"path": p, "class": None}
             self._log({"ev": "finish", "id": req.id, "rc": req.rc,
-                       "state": req.state})
+                       "state": req.state, "t": req.finished})
+            self.metrics.settle(req)
+        self._write_request_metrics(req)
         self._sync_request(req)
         done = {"event": "done", "id": req.id, "rc": req.rc,
                 "state": req.state}
@@ -831,6 +1176,123 @@ class Server:
         if req.summary is not None:
             done["summary"] = req.summary
         self._emit(req, done)
+
+    # -- servescope: per-request + fleet metrics --------------------------
+
+    def _write_request_metrics(self, req: Request) -> None:
+        """Assemble runs/<id>/request_metrics.json from the scheduler
+        stamps plus the per-request Profiler, atomically (tmp +
+        rename).  Called once per terminal transition; a re-admitted
+        run overwrites it at its real finish with the accumulated
+        queue-wait / restart counts."""
+        from . import trace
+        run_dir = os.path.join(self.runs_dir, req.id)
+        os.makedirs(run_dir, exist_ok=True)
+        prof = req.profiler
+        m = prof.metrics() if prof is not None else {}
+        phases = m.get("phases") or {}
+
+        def phase_ms(names):
+            return round(sum((phases.get(n) or {}).get("total_s", 0.0)
+                             for n in names) * 1e3, 3)
+
+        events = (m.get("device_counters") or {}).get("events")
+        wall = None
+        if req.started is not None and req.finished is not None:
+            wall = round(req.finished - req.started, 6)
+        out = {
+            "id": req.id, "kind": req.kind, "state": req.state,
+            "rc": req.rc, "shape_hint": req.shape_hint,
+            "worker": req.worker,
+            "queue_wait_s": round(req.queue_wait, 6),
+            "affinity_hit": req.affinity_hit,
+            "pick_reason": req.pick_reason,
+            "wall_s": wall,
+            "compiles": m.get("compiles", 0),
+            "compile_ms": m.get("compile_ms", 0.0),
+            "device_step_ms": phase_ms(("device_step",)),
+            "drain_ms": phase_ms(trace._HOST_DRAIN_PHASES),
+            "host_drain_overlap_pct": m.get("host_drain_overlap_pct",
+                                            0.0),
+            "events": events,
+            "events_per_s": round(events / wall, 3)
+            if events is not None and wall else None,
+            "checkpoints": len(glob_mod.glob(
+                os.path.join(run_dir, "ckpt", "win_*.npz"))),
+            "parks": req.parks,
+            "resumes": req.resumes,
+            "recoveries": req.recoveries,
+            "restarts": req.restarts,
+            "submitted": req.submitted,
+            "started": req.started,
+            "finished": req.finished,
+        }
+        path = os.path.join(run_dir, "request_metrics.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        # Builder runs have no CLI end-block to write the trace; drop
+        # one here so tools/plot.py can merge it with schedule.jsonl
+        # (config runs already wrote theirs via cli.run_config).
+        tpath = os.path.join(run_dir, "trace.json")
+        if prof is not None and prof.events \
+                and not os.path.exists(tpath):
+            try:
+                prof.write_trace(tpath)
+            except OSError:
+                pass
+
+    def _stats(self) -> dict:
+        """One fleet snapshot: the ServerMetrics counters plus the live
+        queue / worker / warm view.  Serves the `stats` protocol op and
+        the server/metrics.json cadence writer."""
+        with self._lock:
+            queue_ids = list(self._queue)
+            states = {}
+            for r in self._reqs.values():
+                states[r.state] = states.get(r.state, 0) + 1
+            queued = [{
+                "id": rid, "position": i,
+                "shape_hint": self._reqs[rid].shape_hint,
+                "queue_wait_s": self._reqs[rid].queue_wait_s()}
+                for i, rid in enumerate(queue_ids)]
+            draining = self._draining
+            warmed = self.warmed
+            last_hint = self._last_hint
+        snap = self.metrics.snapshot()
+        snap.update({
+            "ts": time.time(),
+            "version": protocol.PROTOCOL_VERSION,
+            "pid": os.getpid(),
+            "data_dir": self.data_dir,
+            "draining": draining,
+            "states": states,
+            "queue": {"depth": len(queue_ids),
+                      "limit": self.queue_limit,
+                      "high_water": self.metrics.queue_high_water,
+                      "queued": queued},
+            "warm": {"warmed": bool(warmed),
+                     "buckets": len(warmed) if warmed else 0,
+                     "last_hint": last_hint},
+        })
+        return snap
+
+    def _write_metrics_snapshot(self) -> None:
+        """Atomically snapshot `_stats()` to server/metrics.json (tmp +
+        rename, like every other state file)."""
+        path = os.path.join(self.sdir, "metrics.json")
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self._stats(), f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # metrics are best-effort; never take the server down
+
+    def _metrics_loop(self) -> None:
+        while not self._done.wait(self.metrics_every):
+            self._write_metrics_snapshot()
 
     def _say(self, msg):
         if not self.quiet:

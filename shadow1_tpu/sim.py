@@ -486,7 +486,8 @@ def run(state, params, app, until=None, profiler=None, devices=None,
         from . import trace
         trace.install(profiler)
         try:
-            state = trace.ensure_counters(state)
+            if getattr(profiler, "counters", True):
+                state = trace.ensure_counters(state)
             state = parallel.mesh_run_chunked(state, params, app, int(t),
                                               mesh=mesh)
             trace.fetch_counters(state, profiler)
@@ -501,7 +502,8 @@ def run(state, params, app, until=None, profiler=None, devices=None,
     from . import trace
     trace.install(profiler)
     try:
-        state = trace.ensure_counters(state)
+        if getattr(profiler, "counters", True):
+            state = trace.ensure_counters(state)
         state = engine.run_chunked(state, params, app, int(t))
         trace.fetch_counters(state, profiler)
         return state
@@ -550,7 +552,11 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
             state, every=1 if digest is True else int(digest), shards=n)
     if profiler is not None:
         trace.install(profiler)
-        state = trace.ensure_counters(state)
+        # counters=False profilers (the run server's per-request
+        # accounting) keep the pytree untouched: a served run must stay
+        # byte-identical to an unobserved one.
+        if getattr(profiler, "counters", True):
+            state = trace.ensure_counters(state)
     state = trace.ensure_flight_recorder(state, shards=n)
     if supervise:
         state = trace.ensure_sentinel(state)
@@ -614,7 +620,13 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
             "hb_ns": None, "every_ns": int(every_ns), "stop_ns": int(t),
             "chunk_ns": engine.CHUNK_NS, "devices": n,
             "bucket": bool(bucket), "hosts_real": int(hosts_real),
-            "scope": scope, "profile": profiler is not None,
+            # "profile" means "the TraceCounters block is on the state"
+            # (the replay template must match the checkpoint pytree): a
+            # counters=False profiler (the run server's per-request
+            # accounting) leaves the state bare, so record False.
+            "scope": scope,
+            "profile": (profiler is not None
+                        and getattr(profiler, "counters", True)),
             "flight_rows": int(state.fr.steps.shape[0]),
             "lineage": (str(lineage) if lineage is not None else None),
             "digest": (int(state.dg.every)
@@ -628,7 +640,8 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
         opts = dict(supervise) if isinstance(supervise, dict) else {}
         sup = sup_mod.Supervisor(
             ckdir, app, mesh=mesh, chunk_ns=engine.CHUNK_NS,
-            on_violation=lambda st: flight.drain(st, profiler), **opts)
+            on_violation=lambda st: flight.drain(st, profiler),
+            emit=emit, **opts)
     drains = Drains(flight=flight, spans=spans, digests=digests,
                     profiler=profiler)
     try:

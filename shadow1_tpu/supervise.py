@@ -218,7 +218,8 @@ class Supervisor:
 
     def __init__(self, data_dir: str, app, *, mesh=None, chunk_ns=None,
                  watchdog_s: float | None = None, quiet: bool = False,
-                 resume_cmd: str | None = None, on_violation=None):
+                 resume_cmd: str | None = None, on_violation=None,
+                 emit=None):
         from . import trace
         self.data_dir = data_dir
         self.app = app
@@ -228,6 +229,7 @@ class Supervisor:
         self.quiet = quiet
         self.resume_cmd = resume_cmd
         self.on_violation = on_violation
+        self.emit = emit  # ladder-rung event callback (run server)
         self.sentinel = trace.SentinelDrain()
         self.megakernel_off = False
         self.ladder = []       # crash.json trail: rungs taken/skipped
@@ -347,6 +349,9 @@ class Supervisor:
             self.ladder.append({"rung": rung, "action": "taken",
                                 "failure": cls, "checkpoint": ck})
             self.recoveries += 1
+            if self.emit is not None:
+                self.emit({"event": "recovered", "rung": rung,
+                           "failure": cls, "window": ck["window"]})
             self._say(f"supervise: ladder rung {rung!r}: resuming from "
                       f"window {ck['window']} (t={ck['t_ns']} ns)")
             return state

@@ -150,12 +150,20 @@ class Profiler:
     device launch so `device_step` spans measure execution rather than
     async dispatch (full --profile mode).  sync=False records spans
     without extra synchronization -- the cheap mode bench.py uses.
+
+    counters=False keeps the state pytree untouched: the run loops skip
+    `ensure_counters`, so the profiler records host-side spans and
+    compile events only.  The run server's per-request accounting uses
+    this mode -- a served run must stay byte-identical to an unobserved
+    one (zero kernelcount delta); events/s still lands via
+    `fetch_counters`, which reads the always-present n_events scalar.
     """
 
     enabled = True
 
-    def __init__(self, sync: bool = True):
+    def __init__(self, sync: bool = True, counters: bool = True):
         self.sync = sync
+        self.counters = counters
         self.t0 = time.perf_counter()
         self.events = []        # (name, t_rel_s, dur_s, args|None)
         self.transfer_bytes = 0

@@ -1,0 +1,391 @@
+"""Servescope: fleet-level observability for the resident run server
+(shadow1_tpu/server.py; docs/observability.md "Servescope").
+
+The contract under test:
+
+* Every settled request leaves runs/<id>/request_metrics.json carrying
+  the scheduler's stamps (queue-wait, affinity hit/miss, worker, pick
+  reason) and the per-request Profiler's accounting (compiles,
+  device-step/drain wall, host_drain_overlap_pct, events/s) -- and the
+  numbers are the RUN's numbers: rc and the event count match a solo
+  sim.run of the same world (the tier-0 pin; test_server.py separately
+  pins that the trajectory itself is byte-identical, so the telemetry
+  is provably host-side only).
+* The `stats` op returns one fleet snapshot -- queue depth + per-entry
+  positions, per-worker busy view, affinity hit rate, requests by
+  state/kind/rc -- and the server mirrors the same JSON to
+  server/metrics.json on a cadence.
+* server/schedule.jsonl (derived from the write-ahead journal, so it
+  survives any crash the journal survives) records every request's
+  full lifecycle under the awkward paths too: cancelled while queued,
+  timed out mid-run, parked by a drain.
+
+tools/faultdrill.py's `server` drill covers the SIGKILL/auto-resume
+version (queue-wait accumulating across server lives); these tests
+stay in-process.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from shadow1_tpu import protocol, server, sim
+from shadow1_tpu.core import engine, simtime
+from shadow1_tpu.supervise import RC_OK, RC_USAGE
+
+SEC = simtime.SIMTIME_ONE_SECOND
+
+# The same small phold world as test_server.py, so the two modules
+# share one compiled graph within a session.
+PHOLD_KW = dict(num_hosts=16, msgs_per_host=2, seed=7,
+                stop_time=6 * SEC)
+CK_S = 2.0
+
+
+def _direct_ref(out_dir, kw=None):
+    kw = dict(kw or PHOLD_KW)
+    state, params, app = sim.build_phold(**kw)
+    return sim.run(state, params, app,
+                   checkpoint_every=int(CK_S * SEC),
+                   checkpoint_dir=str(out_dir),
+                   checkpoint_world=("phold", kw),
+                   supervise={"watchdog_s": None, "quiet": True},
+                   resume=True)
+
+
+def _start(data_dir, **kw):
+    kw.setdefault("queue_limit", 4)
+    kw.setdefault("quiet", True)
+    return server.Server(str(data_dir), **kw).start()
+
+
+def _spec(kw=None, **over):
+    spec = {"name": "phold", "kwargs": dict(kw or PHOLD_KW),
+            "checkpoint_every": CK_S}
+    spec.update(over)
+    return spec
+
+
+def _submit_wait(sock, spec, timeout=None):
+    evs = []
+    for ev in protocol.stream(sock, {"op": "submit", "kind": "builder",
+                                     "spec": spec, "timeout": timeout,
+                                     "wait": True, "progress": False}):
+        evs.append(ev)
+        if not ev.get("ok", True) or ev.get("event") in ("done",
+                                                         "parked"):
+            break
+    return evs
+
+
+def _metrics(data, rid):
+    with open(os.path.join(str(data), "runs", rid,
+                           "request_metrics.json")) as f:
+        return json.load(f)
+
+
+def _schedule(data):
+    rows = []
+    with open(os.path.join(str(data), "server", "schedule.jsonl")) as f:
+        for line in f:
+            if line.strip():
+                rows.append(json.loads(line))
+    return rows
+
+
+def _chains(rows):
+    out = {}
+    for r in rows:
+        if r.get("id"):
+            out.setdefault(r["id"], []).append(r)
+    return out
+
+
+def _wait_terminal(sock, rid, deadline_s=300):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        rec = protocol.request(sock, {"op": "status", "id": rid})["run"]
+        if rec["state"] in protocol.TERMINAL:
+            return rec
+        time.sleep(0.05)
+    pytest.fail(f"{rid} never settled")
+
+
+def _slow_launch(monkeypatch, delay=0.2):
+    real = engine.run_chunked
+
+    def slow(*a, **kw):
+        time.sleep(delay)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(engine, "run_chunked", slow)
+
+
+# Every field the Servescope per-request schema promises
+# (docs/observability.md): scheduler stamps + profiler accounting.
+_METRIC_KEYS = (
+    "id", "kind", "state", "rc", "shape_hint", "worker",
+    "queue_wait_s", "affinity_hit", "pick_reason", "wall_s",
+    "compiles", "compile_ms", "device_step_ms", "drain_ms",
+    "host_drain_overlap_pct", "events", "events_per_s", "checkpoints",
+    "parks", "resumes", "recoveries", "restarts",
+    "submitted", "started", "finished")
+
+
+@pytest.mark.tier0
+class TestRequestMetricsPin:
+    def test_served_metrics_match_solo_run(self, tmp_path):
+        # The tier-0 Servescope pin (tools/smoke.py): a served phold
+        # request settles with a request_metrics.json whose rc and
+        # event count equal a direct sim.run of the same world.
+        ref = _direct_ref(tmp_path / "ref")
+        data = tmp_path / "data"
+        srv = _start(data)
+        sock = protocol.default_socket(str(data))
+        try:
+            evs = _submit_wait(sock, _spec())
+            rid, done = evs[0]["id"], evs[-1]
+            assert done["event"] == "done" and done["rc"] == RC_OK
+            m = _metrics(data, rid)
+            for key in _METRIC_KEYS:
+                assert key in m, f"request_metrics.json lacks {key!r}"
+            assert m["id"] == rid and m["kind"] == "builder"
+            assert m["state"] == protocol.DONE and m["rc"] == RC_OK
+            # The run's numbers, not the server's: same trajectory as
+            # the solo reference.
+            assert m["events"] == int(ref.n_events)
+            assert m["wall_s"] > 0 and m["events_per_s"] > 0
+            assert m["queue_wait_s"] >= 0
+            assert m["worker"] == 0
+            assert m["checkpoints"] >= 1  # win_0 anchor at minimum
+            assert m["parks"] == 0 and m["restarts"] == 0
+            assert m["started"] >= m["submitted"]
+            assert m["finished"] >= m["started"]
+            # Builder runs drop a trace.json for the tools/plot.py
+            # server-timeline merge.
+            assert (data / "runs" / rid / "trace.json").exists()
+        finally:
+            srv.shutdown()
+
+
+class TestAffinityAccounting:
+    def test_second_same_hint_request_records_a_hit(self, tmp_path):
+        data = tmp_path / "data"
+        srv = _start(data, workers=1)
+        sock = protocol.default_socket(str(data))
+        try:
+            ra = _submit_wait(sock, _spec())[0]["id"]
+            rb = _submit_wait(sock, _spec())[0]["id"]
+            ma, mb = _metrics(data, ra), _metrics(data, rb)
+            assert ma["shape_hint"] == mb["shape_hint"]
+            # Cold server: the first pick can't match any prior hint;
+            # the identical follow-up must.
+            assert ma["affinity_hit"] is False
+            assert mb["affinity_hit"] is True
+            # Both were head-of-queue picks -- a hit only upgrades the
+            # reason when it jumped the FIFO order.
+            assert ma["pick_reason"] == "fifo"
+            assert mb["pick_reason"] == "fifo"
+            st = protocol.request(sock, {"op": "stats"})
+            assert st["ok"]
+            aff = st["stats"]["affinity"]
+            assert aff["hits"] == 1 and aff["misses"] == 1
+            assert aff["hit_rate"] == 0.5
+        finally:
+            srv.shutdown()
+
+
+class TestStatsOp:
+    def test_fleet_snapshot_with_concurrent_requests(self, tmp_path,
+                                                     monkeypatch):
+        _slow_launch(monkeypatch, delay=0.3)
+        data = tmp_path / "data"
+        srv = _start(data, workers=1, metrics_every=0.2)
+        sock = protocol.default_socket(str(data))
+        try:
+            ra = protocol.request(sock, {"op": "submit",
+                                         "kind": "builder",
+                                         "spec": _spec()})["id"]
+            rb = protocol.request(sock, {"op": "submit",
+                                         "kind": "builder",
+                                         "spec": _spec()})["id"]
+            # Two live requests on one worker: catch the window where
+            # ra runs and rb queues behind it.
+            deadline = time.time() + 60
+            s = None
+            while time.time() < deadline:
+                resp = protocol.request(sock, {"op": "stats"})
+                assert resp["ok"]
+                s = resp["stats"]
+                if s["queue"]["depth"] == 1 \
+                        and s["workers"][0]["current"] == ra:
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail(f"never saw ra running + rb queued: {s}")
+            assert s["requests"]["submitted"] == 2
+            assert s["requests"]["by_kind"] == {"builder": 2}
+            assert s["queue"]["limit"] == 4
+            assert s["queue"]["high_water"] >= 1
+            q = s["queue"]["queued"][0]
+            assert q["id"] == rb and q["position"] == 0
+            assert q["queue_wait_s"] >= 0 and q["shape_hint"]
+            assert s["workers"][0]["busy_for_s"] >= 0
+            assert s["states"].get("running") == 1
+            assert s["journal"]["events"] >= 3  # 2 submits + a start
+            assert s["journal"]["fsyncs"] >= s["journal"]["events"]
+
+            # `status` polish rides the same stamps: a queued request
+            # names its place in line and its wait so far.
+            rec = protocol.request(sock, {"op": "status",
+                                          "id": rb})["run"]
+            assert rec["queue_position"] == 0
+            assert rec["queue_wait_s"] >= 0
+            assert rec["shape_hint"] == q["shape_hint"]
+
+            _wait_terminal(sock, ra)
+            _wait_terminal(sock, rb)
+            resp = protocol.request(sock, {"op": "stats"})
+            s = resp["stats"]
+            # JSON round-trip stringifies counter keys.
+            assert s["requests"]["by_state"].get("done") == 2
+            assert s["requests"]["by_rc"].get("0") == 2
+            assert len(s["recent"]) == 2
+            assert {r["id"] for r in s["recent"]} == {ra, rb}
+            assert s["workers"][0]["runs"] == 2
+        finally:
+            srv.shutdown()
+        # The cadence writer mirrored the same snapshot shape to disk
+        # (shutdown writes a final one).
+        with open(data / "server" / "metrics.json") as f:
+            snap = json.load(f)
+        assert snap["requests"]["submitted"] == 2
+        assert snap["requests"]["by_state"].get("done") == 2
+        assert snap["queue"]["depth"] == 0
+
+
+class TestScheduleLifecycle:
+    def test_cancel_timeout_drain_transitions(self, tmp_path,
+                                              monkeypatch):
+        _slow_launch(monkeypatch)
+        data = tmp_path / "data"
+        srv = _start(data, workers=1)
+        sock = protocol.default_socket(str(data))
+        rd = None
+        try:
+            # ra runs to completion; rb is cancelled while queued.
+            ra = protocol.request(sock, {"op": "submit",
+                                         "kind": "builder",
+                                         "spec": _spec()})["id"]
+            rb = protocol.request(sock, {"op": "submit",
+                                         "kind": "builder",
+                                         "spec": _spec()})["id"]
+            resp = protocol.request(sock, {"op": "cancel", "id": rb})
+            assert resp["ok"] and resp["state"] == protocol.CANCELLED
+            # A cancelled-while-queued request still settles with its
+            # accounting: no start, but the wait it did pay recorded.
+            mb = _metrics(data, rb)
+            assert mb["state"] == protocol.CANCELLED
+            assert mb["queue_wait_s"] >= 0 and mb["wall_s"] is None
+            _wait_terminal(sock, ra)
+
+            # rt times out mid-run: rc 2, lifecycle still closed.
+            evs = _submit_wait(sock, _spec(), timeout=0.05)
+            rt, done = evs[0]["id"], evs[-1]
+            assert done["rc"] == RC_USAGE
+
+            # rd is parked by a drain while mid-flight.
+            rd = protocol.request(sock, {"op": "submit",
+                                         "kind": "builder",
+                                         "spec": _spec()})["id"]
+            ckdir = data / "runs" / rd / "ckpt"
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if any(f.startswith("win_") and f != "win_0.npz"
+                       for f in (os.listdir(ckdir)
+                                 if ckdir.exists() else [])):
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("no mid-run checkpoint before the drain")
+        finally:
+            srv.shutdown(drain=rd is not None)
+            srv.wait()
+
+        rows = _schedule(data)
+        chains = _chains(rows)
+        assert [r["ev"] for r in chains[ra]] == ["submit", "start",
+                                                 "finish"]
+        assert chains[ra][-1]["state"] == protocol.DONE
+        assert chains[ra][-1]["rc"] == RC_OK
+        assert chains[ra][1]["worker"] == 0
+        assert "reason" in chains[ra][1] and "hit" in chains[ra][1]
+        assert [r["ev"] for r in chains[rb]] == ["submit", "cancel"]
+        assert chains[rb][-1]["state"] == protocol.CANCELLED
+        assert [r["ev"] for r in chains[rt]] == ["submit", "start",
+                                                 "finish"]
+        assert chains[rt][-1]["state"] == protocol.FAILED
+        assert [r["ev"] for r in chains[rd]] == ["submit", "start",
+                                                 "park"]
+        assert chains[rd][-1]["state"] == protocol.PARKED
+        # The drain itself is a (request-less) span row; every row
+        # carries a wall timestamp for the plot.py timeline.
+        assert any(r["ev"] == "drain" and r.get("id") is None
+                   for r in rows)
+        assert all("t" in r for r in rows)
+
+        # Life 2: the restart regenerates schedule.jsonl from the
+        # journal -- nothing lost, and the re-admission appears.
+        srv2 = _start(data, workers=1, auto_resume=True)
+        sock = protocol.default_socket(str(data))
+        try:
+            rec = _wait_terminal(sock, rd)
+            assert rec["rc"] == RC_OK
+            chains = _chains(_schedule(data))
+            evs2 = [r["ev"] for r in chains[rd]]
+            assert evs2[:4] == ["submit", "start", "park", "readmit"]
+            assert evs2[-1] == "finish"
+            assert evs2.count("start") == 2
+            m = _metrics(data, rd)
+            assert m["restarts"] == 1 and m["parks"] == 1
+            assert m["resumes"] >= 1
+        finally:
+            srv2.shutdown()
+
+
+class TestClientStats:
+    def test_stats_cmd_and_status_wait_rc_line(self, tmp_path, capsys):
+        from shadow1_tpu import cli
+        data = tmp_path / "data"
+        srv = _start(data)
+        sock = protocol.default_socket(str(data))
+        try:
+            rid = _submit_wait(sock, _spec())[0]["id"]
+
+            rc = cli.main(["stats", "--server", str(data), "--json"])
+            assert rc == RC_OK
+            s = json.loads(capsys.readouterr().out)
+            assert s["requests"]["submitted"] == 1
+
+            rc = cli.main(["stats", "--server", str(data)])
+            assert rc == RC_OK
+            out = capsys.readouterr().out
+            assert "serving" in out and "worker 0:" in out
+            assert "affinity" in out and "journal:" in out
+            assert rid in out  # recent-completions ring
+
+            rc = cli.main(["status", rid, "--server", str(data),
+                           "--wait"])
+            assert rc == RC_OK
+            cap = capsys.readouterr()
+            assert f"{rid}: exit rc 0" in cap.err
+        finally:
+            srv.shutdown()
+
+    def test_stats_without_server_is_rc2(self, tmp_path, capsys):
+        from shadow1_tpu import cli
+        rc = cli.main(["stats", "--server", str(tmp_path)])
+        assert rc == RC_USAGE
+        assert "no run server" in capsys.readouterr().err

@@ -32,10 +32,13 @@ import sys
 
 # metric-name suffix -> direction ("up" = bigger is better).
 _HIGHER_BETTER = ("events_per_sec", "value", "vs_baseline",
-                  "events_per_microstep")
+                  "events_per_microstep", "requests_per_sec",
+                  "affinity_hit_rate")
 _LOWER_BETTER = ("wall_sec", "wall_s", "p50_ms", "p95_ms", "max_ms",
                  "total_s", "compile_s", "compile_ms",
-                 "stage_emissions_ms", "alltoall_ms")
+                 "stage_emissions_ms", "alltoall_ms",
+                 "queue_wait_total_s", "queue_wait_mean_s",
+                 "queue_wait_max_s")
 
 # Machine-bound leaves: wall-clock / throughput numbers that only
 # compare between runs on the same backend + core count.  Across
@@ -53,9 +56,11 @@ _MACHINE_BOUND = ("events_per_sec", "value", "vs_baseline", "wall_sec",
 # backend, so the dotted prefix downgrades the entire block -- a probe
 # time never flags across environments.  The flowscope drain costs
 # (profile.scope.*) are host-side fetch/merge wall times, same class.
+# The served-mode block (server.*: queue waits, requests/s, fsync
+# latency) times the host scheduler, same class again.
 _MACHINE_BOUND_PREFIXES = ("profile.flight.", "profile.scope.",
                            "profile.lineage.", "profile.digest.",
-                           "mesh.")
+                           "mesh.", "server.")
 
 
 def _machine_bound(name: str) -> bool:
@@ -244,6 +249,19 @@ def _serve_config(d: dict):
     if not isinstance(cfg, dict) or "serve" not in cfg:
         return _UNSTAMPED
     return bool(cfg["serve"])
+
+
+def _queue_limit_config(d: dict):
+    """The admission-queue bound a served bench ran under: the
+    config.queue_limit stamp, or _UNSTAMPED for pre-stamp (or solo)
+    files.  Queue waits scale with how deep the scheduler lets the
+    backlog grow, so served rounds only compare within one
+    --queue-limit bucket; legacy files stay comparable (the
+    checkpoint rule)."""
+    cfg = d.get("config")
+    if not isinstance(cfg, dict) or "queue_limit" not in cfg:
+        return _UNSTAMPED
+    return cfg["queue_limit"]
 
 
 def _kernel_world(d: dict):
@@ -477,6 +495,18 @@ def main(argv=None) -> int:
               f"solo one (old serve={se_old!r}, new serve={se_new!r}); "
               f"re-record both solo (bench.py) or both through the run "
               f"server", file=sys.stderr)
+        return 2
+    ql_old, ql_new = _queue_limit_config(old), _queue_limit_config(new)
+    if ql_old is not _UNSTAMPED and ql_new is not _UNSTAMPED \
+            and ql_old != ql_new:
+        # Queue waits (and so requests/s) depend on how deep the
+        # admission queue may grow before the scheduler pushes back, so
+        # served rounds bucket by --queue-limit like throughput buckets
+        # by device count.  Unstamped legacy files pass.
+        print(f"benchdiff: refusing to compare served runs across "
+              f"queue limits (old queue_limit={ql_old!r}, "
+              f"new queue_limit={ql_new!r}); re-record with matching "
+              f"--queue-limit settings", file=sys.stderr)
         return 2
     if args.kernels:
         wo, wn = _kernel_world(old), _kernel_world(new)

@@ -30,6 +30,12 @@ Drills (--drill, default "all"):
   waited to completion.  Passes when each run exits rc 0 with its
   windows.jsonl byte-identical to an uninterrupted solo reference of
   the same world, and `status` reports the re-admission in the trail.
+  The Servescope artifacts must survive the kill too: every run ends
+  with a request_metrics.json (rc 0, restarts and resumes counted,
+  queue-wait accumulated across BOTH server lives) and the journal-
+  derived server/schedule.jsonl reconstructs each request's full
+  lifecycle -- no lost transitions, the readmission present, exactly
+  one terminal finish.
 
 Why NaN and not a counter poison: the conservation sentinel is
 delta-based (it snapshots counters at window open), so corruption
@@ -336,6 +342,107 @@ def _serve(data_dir: str, *, resume: bool):
     return p
 
 
+# Legal scheduler lifecycle edges (server/schedule.jsonl rows, derived
+# from the write-ahead journal): what state each event may fire FROM.
+# A killed server readmits running requests too, hence running->queued.
+_SCHED_FROM = {
+    "submit": (None,),
+    "start": ("queued",),
+    "park": ("running",),
+    "readmit": ("parked", "running", "queued"),
+    "cancel": ("queued", "running"),
+    "finish": ("running",),
+}
+
+
+def _check_schedule(data: str, ids: dict) -> list:
+    """Servescope cross-check: the journal-derived schedule.jsonl must
+    reconstruct every drilled request's full lifecycle across the
+    SIGKILL -- no lost transitions, the readmission present, exactly
+    one terminal finish -- and the per-request queue-wait accounting
+    (request_metrics.json) must cover BOTH enqueue->start segments,
+    not just the post-restart one."""
+    errs = []
+    spath = os.path.join(data, "server", "schedule.jsonl")
+    if not os.path.exists(spath):
+        return [f"server: no schedule.jsonl at {spath}"]
+    rows = {}
+    with open(spath) as f:
+        for line in f:
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                errs.append("server: torn row in schedule.jsonl "
+                            "(derived file should be regenerated whole)")
+                continue
+            if row.get("id") in ids:
+                rows.setdefault(row["id"], []).append(row)
+    for rid, seed in sorted(ids.items()):
+        evs = rows.get(rid) or []
+        chain = [r["ev"] for r in evs]
+        if not evs or chain[0] != "submit":
+            errs.append(f"server: {rid} schedule does not open with "
+                        f"submit: {chain}")
+            continue
+        state, ok = None, True
+        for r in evs:
+            if state not in _SCHED_FROM.get(r["ev"], ()):
+                errs.append(f"server: {rid} illegal transition "
+                            f"{state!r} --{r['ev']}--> in {chain}")
+                ok = False
+                break
+            state = r["state"]
+        if not ok:
+            continue
+        if chain.count("finish") != 1 or chain[-1] != "finish":
+            errs.append(f"server: {rid} lifecycle does not end in "
+                        f"exactly one finish: {chain}")
+        if "readmit" not in chain:
+            errs.append(f"server: {rid} schedule records no readmit "
+                        f"after the SIGKILL: {chain}")
+        if chain.count("start") < 2:
+            errs.append(f"server: {rid} schedule records "
+                        f"{chain.count('start')} start(s), expected "
+                        f">= 2 (pre-kill + post-readmit): {chain}")
+        # Queue-wait accumulation: sum the enqueue->start segments the
+        # schedule shows and require request_metrics.json to carry at
+        # least that much (it may also include recovery gaps).
+        segs, enq = 0.0, None
+        for r in evs:
+            if r["ev"] in ("submit", "readmit"):
+                enq = r.get("t")
+            elif r["ev"] == "start" and None not in (enq, r.get("t")):
+                segs += max(0.0, r["t"] - enq)
+                enq = None
+        mpath = os.path.join(data, "runs", rid, "request_metrics.json")
+        if not os.path.exists(mpath):
+            errs.append(f"server: {rid} has no request_metrics.json "
+                        f"after the restart")
+            continue
+        with open(mpath) as f:
+            m = json.load(f)
+        if m.get("rc") != 0:
+            errs.append(f"server: {rid} request_metrics rc "
+                        f"{m.get('rc')}, expected 0")
+        if not m.get("restarts"):
+            errs.append(f"server: {rid} request_metrics restarts == 0 "
+                        f"after a kill")
+        if not m.get("resumes"):
+            errs.append(f"server: {rid} request_metrics resumes == 0 "
+                        f"(the resumed run never anchored?)")
+        wait = m.get("queue_wait_s")
+        if wait is None or wait + 0.5 < segs:
+            errs.append(f"server: {rid} queue_wait_s {wait!r} does not "
+                        f"cover the {len(chain)}-row schedule's "
+                        f"enqueue->start segments ({segs:.3f}s) -- "
+                        f"wait lost across the restart")
+        if not errs:
+            print(f"  {rid}: schedule lifecycle "
+                  f"{' -> '.join(chain)}; queue_wait {wait:.3f}s "
+                  f"over {chain.count('start')} admissions")
+    return errs
+
+
 def drill_server(wd, every, stop):
     d = os.path.join(wd, "server")
     data = os.path.join(d, "data")
@@ -423,6 +530,10 @@ def drill_server(wd, every, stop):
                 print(f"  {rid}: rc 0, windows.jsonl byte-identical "
                       f"to solo reference (restarts="
                       f"{rec.get('restarts')})")
+        # Servescope: the observability artifacts must survive the
+        # SIGKILL too -- per-request accounting present and the
+        # journal-derived schedule reconstructing every lifecycle.
+        errs.extend(_check_schedule(data, ids))
         srv.terminate()  # SIGTERM: drain (nothing left in flight)
         if srv.wait(timeout=60) != 0:
             errs.append(f"server: drained serve exited rc "

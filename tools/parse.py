@@ -29,10 +29,18 @@ its last event), the windows where each group last changed, and the
 stream's span/cadence/shard layout (docs/observability.md
 "Statescope").  For comparing two streams use `shadow1-tpu diff`.
 
+`schedule` digests a server/schedule.jsonl scheduler trace
+(server.py's Servescope span rows, regenerated from the journal) into
+the fleet's scheduling story: per-request lifecycle folds (every
+transition in time order, with queue-wait per queued segment),
+aggregate queue-wait stats, the warm-graph affinity hit rate, and
+per-worker request counts (docs/observability.md "Servescope").
+
 Usage: tools/parse.py <data-directory> [--json out.json] [--top N]
        tools/parse.py spans <data-dir-or-spans.jsonl> [--top N]
        tools/parse.py digests <data-dir-or-digests.jsonl> [--top N]
        tools/parse.py replaydiff <a/windows.jsonl> <b/windows.jsonl>
+       tools/parse.py schedule <data-dir-or-schedule.jsonl> [--top N]
 """
 
 from __future__ import annotations
@@ -279,6 +287,95 @@ def parse_digests(path: str, top: int = 10) -> dict | None:
     }
 
 
+def parse_schedule(path: str, top: int = 10) -> dict | None:
+    """Digest server/schedule.jsonl (server.py Servescope format) into
+    per-request lifecycles and fleet aggregates.  Each request's rows
+    fold in time order into a lifecycle string (submit -> start ->
+    finish ...), a per-segment queue-wait total, and its pick context
+    (worker, affinity hit, reason).  Accepts a data directory (looks
+    under server/) or the jsonl path itself."""
+    if os.path.isdir(path):
+        cand = os.path.join(path, "server", "schedule.jsonl")
+        path = cand if os.path.exists(cand) \
+            else os.path.join(path, "schedule.jsonl")
+    rows = _load_jsonl(path)
+    if rows is None:
+        return None
+    by_id: dict = {}
+    drains = 0
+    for r in rows:
+        if r.get("ev") == "drain":
+            drains += 1
+            continue
+        if r.get("id"):
+            by_id.setdefault(r["id"], []).append(r)
+
+    reqs = {}
+    hits = misses = 0
+    per_worker: dict = {}
+    waits = []
+    for rid, evs in sorted(by_id.items()):
+        evs.sort(key=lambda r: (r.get("t") is None, r.get("t") or 0))
+        wait = 0.0
+        enq = None
+        for r in evs:
+            ev, t = r.get("ev"), r.get("t")
+            if ev in ("submit", "readmit"):
+                enq = t
+            elif t is not None and enq is not None:
+                wait += max(0.0, t - enq)
+                enq = None
+            if ev == "start":
+                if r.get("hit") is True:
+                    hits += 1
+                elif r.get("hit") is False:
+                    misses += 1
+                w = r.get("worker")
+                if w is not None:
+                    per_worker[str(w)] = per_worker.get(str(w), 0) + 1
+        last = evs[-1]
+        terminal = last.get("ev") == "finish" or \
+            last.get("state") in ("cancelled",)
+        if terminal:
+            waits.append(wait)
+        reqs[rid] = {
+            "lifecycle": " -> ".join(r.get("ev") for r in evs),
+            "transitions": len(evs),
+            "state": last.get("state"),
+            "rc": last.get("rc"),
+            "kind": last.get("kind") or evs[0].get("kind"),
+            "worker": next((r.get("worker") for r in reversed(evs)
+                            if r.get("worker") is not None), None),
+            "affinity_hit": next((r.get("hit") for r in reversed(evs)
+                                  if r.get("hit") is not None), None),
+            "pick_reason": next((r.get("reason") for r in reversed(evs)
+                                 if r.get("reason") is not None), None),
+            "readmits": sum(1 for r in evs if r.get("ev") == "readmit"),
+            "parks": sum(1 for r in evs if r.get("ev") == "park"),
+            "queue_wait_s": round(wait, 6),
+        }
+    longest = sorted(reqs, key=lambda k: -reqs[k]["queue_wait_s"])
+    picks = hits + misses
+    return {
+        "rows": len(rows),
+        "requests": len(reqs),
+        "drains": drains,
+        "settled": sum(1 for r in reqs.values()
+                       if r["state"] in ("done", "failed", "cancelled")),
+        "affinity": {"hits": hits, "misses": misses,
+                     "hit_rate": round(hits / picks, 4) if picks
+                     else None},
+        "per_worker_starts": dict(sorted(per_worker.items())),
+        "queue_wait": {
+            "total_s": round(sum(waits), 6),
+            "mean_s": round(sum(waits) / len(waits), 6) if waits
+            else None,
+            "max_s": round(max(waits), 6) if waits else None},
+        "longest_waits": [{"id": k, **reqs[k]} for k in longest[:top]],
+        "lifecycles": reqs,
+    }
+
+
 def _load_windows(path: str) -> dict:
     """windows.jsonl rows keyed by global window index.  Accepts a data
     directory or the jsonl path itself."""
@@ -389,6 +486,27 @@ def main(argv=None) -> int:
         if digest is None:
             print(f"error: {args.path}: no digests.jsonl record "
                   f"(re-run with --digest-every)", file=sys.stderr)
+            return 2
+        text = json.dumps(digest, indent=2, sort_keys=True)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(text + "\n")
+        print(text)
+        return 0
+    if argv and argv[0] == "schedule":
+        ap = argparse.ArgumentParser(prog="parse.py schedule")
+        ap.add_argument("path", help="server/schedule.jsonl (or the "
+                                     "serve data dir)")
+        ap.add_argument("--json", default=None,
+                        help="also write to this file")
+        ap.add_argument("--top", type=int, default=10,
+                        help="longest-waits list length")
+        args = ap.parse_args(argv[1:])
+        digest = parse_schedule(args.path, top=args.top)
+        if digest is None:
+            print(f"error: {args.path}: no schedule.jsonl record "
+                  f"(written by a `shadow1-tpu serve` server)",
+                  file=sys.stderr)
             return 2
         text = json.dumps(digest, indent=2, sort_keys=True)
         if args.json:

@@ -44,6 +44,14 @@ when digests.jsonl is absent:
                       quiet, and comparing two runs' rasters shows
                       where their trajectories part
 
+When the data directory is a `shadow1-tpu serve` root (Servescope;
+server/schedule.jsonl present) one more panel appears, skipped
+silently otherwise:
+  server_timeline.png -- request Gantt by worker (queued segment
+                      hatched, running segment solid, affinity hits
+                      outlined) over wall time, with a queue-depth
+                      subplot reconstructed from the same transitions
+
 Rate columns are step-held per host between its rows, so hosts on
 different per-host heartbeat cadences aggregate without sawtooth
 artifacts; delta columns (packets, drops) are summed at the timestamps
@@ -103,6 +111,17 @@ def load_digests(data_dir: str):
     """Statescope digest rows from digests.jsonl (trace.DigestDrain
     format), or None when the run recorded no digests."""
     return _load_jsonl(os.path.join(data_dir, "digests.jsonl"))
+
+
+def load_schedule(data_dir: str):
+    """Scheduler span rows from server/schedule.jsonl (server.py
+    Servescope format), or None when the directory is not a serve
+    root.  Accepts the serve data dir or the server/ subdir."""
+    rows = _load_jsonl(os.path.join(data_dir, "server",
+                                    "schedule.jsonl"))
+    if rows is None:
+        rows = _load_jsonl(os.path.join(data_dir, "schedule.jsonl"))
+    return rows
 
 
 def _load_jsonl(path: str):
@@ -379,6 +398,106 @@ def main(data_dir: str, out_dir: str | None = None) -> list:
             ax.set_yticks(range(len(groups)))
             ax.set_yticklabels(groups)
             p = os.path.join(out_dir, "digests.png")
+            f.savefig(p, dpi=110, bbox_inches="tight")
+            plt.close(f)
+            written.append(p)
+
+    crows = load_schedule(data_dir)
+    if crows:
+        # Server timeline (Servescope): top panel is a request Gantt by
+        # worker lane -- each request draws its queued segment (hatched,
+        # from submit/readmit to start) and its running segment (solid,
+        # start to finish/park; affinity hits get a dark outline).  The
+        # bottom panel replays queue depth from the same transitions.
+        # Wall clock, not sim time: this is the fleet's schedule.
+        by_id = defaultdict(list)
+        for r in crows:
+            if r.get("id") and r.get("t") is not None:
+                by_id[r["id"]].append(r)
+        for evs in by_id.values():
+            evs.sort(key=lambda r: r["t"])
+        t0 = min((evs[0]["t"] for evs in by_id.values() if evs),
+                 default=None)
+        if t0 is not None:
+            workers = sorted({r.get("worker") for evs in by_id.values()
+                              for r in evs
+                              if r.get("worker") is not None})
+            lanes = {w: i for i, w in enumerate(workers)}
+            n_lanes = max(len(lanes), 1)
+            f, (ax, axq) = plt.subplots(
+                2, 1, figsize=(8, 0.6 * n_lanes + 4.5), sharex=True,
+                gridspec_kw={"height_ratios": [max(n_lanes, 2), 2]})
+            for rid, evs in sorted(by_id.items()):
+                enq = None
+                start = None
+                lane = 0
+                hit = False
+                for r in evs:
+                    t = r["t"] - t0
+                    ev = r.get("ev")
+                    if ev in ("submit", "readmit"):
+                        enq = t
+                    elif ev == "start":
+                        lane = lanes.get(r.get("worker"), 0)
+                        hit = bool(r.get("hit"))
+                        if enq is not None:
+                            ax.barh(lane, max(t - enq, 0.005), left=enq,
+                                    height=0.35, color="lightgray",
+                                    hatch="///", edgecolor="gray",
+                                    linewidth=0.5)
+                            enq = None
+                        start = t
+                    elif ev in ("finish", "park", "cancel"):
+                        seg0 = start if start is not None else enq
+                        if seg0 is not None:
+                            color = {"finish": "tab:blue",
+                                     "park": "tab:orange",
+                                     "cancel": "tab:red"}[ev]
+                            if ev == "finish" and r.get("rc") \
+                                    not in (0, None):
+                                color = "tab:red"
+                            ax.barh(lane, max(t - seg0, 0.005),
+                                    left=seg0, height=0.55,
+                                    color=color, alpha=0.8,
+                                    edgecolor="black"
+                                    if hit else "none",
+                                    linewidth=1.0 if hit else 0.0)
+                            ax.annotate(rid, (seg0, lane), fontsize=6,
+                                        xytext=(2, 8),
+                                        textcoords="offset points")
+                        start = None
+                        enq = None
+            ax.set_title("Request timeline by worker "
+                         "(hatched = queued; outlined = affinity hit)")
+            ax.set_yticks(range(n_lanes))
+            ax.set_yticklabels([f"worker {w}" for w in workers]
+                               or ["worker 0"])
+            ax.invert_yaxis()
+
+            # Queue depth over time from the same rows: +1 on
+            # submit/readmit, -1 on start or queued-cancel.
+            deltas = []
+            queued_ids = set()
+            for r in sorted((r for evs in by_id.values() for r in evs),
+                            key=lambda r: r["t"]):
+                ev, rid = r.get("ev"), r.get("id")
+                if ev in ("submit", "readmit"):
+                    queued_ids.add(rid)
+                    deltas.append((r["t"] - t0, +1))
+                elif rid in queued_ids and ev in ("start", "cancel",
+                                                  "finish"):
+                    queued_ids.discard(rid)
+                    deltas.append((r["t"] - t0, -1))
+            depth = 0
+            xs, ys = [0.0], [0]
+            for t, d in deltas:
+                depth += d
+                xs.append(t)
+                ys.append(depth)
+            axq.step(xs, ys, where="post")
+            axq.set_ylabel("queue depth")
+            axq.set_xlabel("wall time since first submit (s)")
+            p = os.path.join(out_dir, "server_timeline.png")
             f.savefig(p, dpi=110, bbox_inches="tight")
             plt.close(f)
             written.append(p)

@@ -19,6 +19,11 @@ pin per subsystem:
   - server       test_server.py        serve round-trip: a submitted
                                        run matches direct sim.run
                                        bitwise, clean shutdown
+  - servescope   test_servescope.py    a served request's
+                                       request_metrics.json carries
+                                       the solo run's rc and event
+                                       count (observability is
+                                       host-side only)
 
 Together they run in well under five minutes on the virtual 8-device
 CPU mesh, giving a fast did-I-break-determinism signal before paying
