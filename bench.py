@@ -280,6 +280,117 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
     return 0
 
 
+# ENSEMBLE rung (--worlds N): the world-axis batching record
+# (docs/ensemble.md).  N phold worlds run as ONE vmapped batch through
+# ensemble.run_until -- one compiled graph serves every world -- and
+# the record carries ensembles_per_sec (whole worlds retired per wall
+# second) plus a per-world events/s breakdown.  A smaller world than
+# the solo probe: the rung measures world-axis batching efficiency,
+# not single-world engine throughput.
+ENSEMBLE_HOSTS = 2048
+ENSEMBLE_SIM_SECONDS = 1
+
+
+def main_ensemble(n_worlds: int, gate_against: str | None = None) -> int:
+    from shadow1_tpu import ensemble
+
+    worlds = ensemble.replicate(
+        sim.build_phold, n_worlds, seed=1,
+        num_hosts=ENSEMBLE_HOSTS,
+        msgs_per_host=MSGS_PER_HOST,
+        mean_delay_ns=MEAN_DELAY_NS,
+        stop_time=(ENSEMBLE_SIM_SECONDS + 1)
+        * simtime.SIMTIME_ONE_SECOND,
+        pool_capacity=ENSEMBLE_HOSTS * 8,
+        rx_batch=2,
+    )
+    estate, eparams, app = ensemble.stack(worlds)
+
+    profiler = trace.install(trace.Profiler(sync=False))
+    with profiler.span("warmup_compile"):
+        warm = ensemble.run_until(estate, eparams, app,
+                                  10 * simtime.SIMTIME_ONE_MILLISECOND)
+        jax.block_until_ready(warm)
+    graphs_after_warm = ensemble.cache_size()
+
+    best = None
+    for _attempt in range(2):
+        t0 = time.perf_counter()
+        with profiler.span("measure_pass"):
+            out = ensemble.run_until(
+                warm, eparams, app,
+                ENSEMBLE_SIM_SECONDS * simtime.SIMTIME_ONE_SECOND)
+            n_steps = int(out.n_steps.sum())
+        wall = time.perf_counter() - t0
+        if best is None or wall < best[0]:
+            best = (wall, out, n_steps)
+    wall, out, n_steps = best
+
+    # Per-world event deltas over the measured pass (axis 0 = world).
+    ev_w = [(int(out.app.recv[k].sum() - warm.app.recv[k].sum())
+             + int(out.app.sent[k].sum() - warm.app.sent[k].sum()))
+            for k in range(n_worlds)]
+    events = sum(ev_w)
+    rate = events / wall
+    metrics = profiler.metrics()
+    trace.install(None)
+    result = {
+        "metric": "phold_ensemble_events_per_sec",
+        "value": round(rate, 2),
+        "unit": "events/sec",
+        "wall_sec": round(wall, 2),
+        "ensemble": {
+            # Whole worlds retired per wall second on this fixed
+            # workload: the headline world-axis batching number (an
+            # N-world ensemble at the solo wall time scores N x the
+            # solo run's 1/wall).
+            "ensembles_per_sec": round(n_worlds / wall, 4),
+            "per_world_events_per_sec": [round(e / wall, 2)
+                                         for e in ev_w],
+            # One-compiled-graph check: the measured passes must reuse
+            # the warmup's graph (ladder rung 10 asserts growth <= 1).
+            "run_until_graphs": ensemble.cache_size(),
+            "run_until_graphs_after_warmup": graphs_after_warm,
+        },
+        "config": {
+            "num_hosts": ENSEMBLE_HOSTS,
+            "msgs_per_host": MSGS_PER_HOST,
+            "sim_seconds": ENSEMBLE_SIM_SECONDS,
+            "rx_batch": app.rx_batch,
+            # stack() pins megakernel off (no vmap batching rule for
+            # the Pallas kernel; docs/ensemble.md).
+            "megakernel": bool(eparams.megakernel),
+            "netem": None,
+            "scope": None,
+            "lineage": None,
+            "digest": None,
+            "checkpoint_every": None,
+            "sentinel": False,
+            "supervise": False,
+            "serve": False,
+        },
+        "env": {
+            "backend": jax.default_backend(),
+            "cpu_count": os.cpu_count(),
+            "n_devices": 1,
+            # World-count bucket: benchdiff refuses to compare records
+            # across ensemble sizes (rc 2), like cross-device-count.
+            "n_worlds": n_worlds,
+        },
+        "profile": {
+            "phases": metrics["phases"],
+            "compile": metrics["compile"],
+            "compiles": metrics["compiles"],
+            "compile_ms": metrics["compile_ms"],
+            "transfers": metrics["transfers"],
+        },
+    }
+    print(json.dumps(result))
+    if gate_against:
+        return _gate(gate_against, result)
+    return 0
+
+
 # SERVED rung (--serve K): the Servescope observability probe.  K
 # identical phold builder requests go through a live resident run
 # server (one worker, so requests queue and the affinity path is
@@ -619,11 +730,20 @@ if __name__ == "__main__":
                     help="admission-queue bound for --serve (raised to "
                          "K when smaller; stamped in the config block "
                          "so benchdiff buckets served rounds by it)")
+    ap.add_argument("--worlds", type=int, default=None, metavar="N",
+                    help="ENSEMBLE rung: run N phold worlds as one "
+                         "vmapped batch (shadow1_tpu/ensemble, one "
+                         "compiled graph for every world) and record "
+                         "ensembles_per_sec plus a per-world events/s "
+                         "breakdown; n_worlds is stamped in env so "
+                         "benchdiff buckets ensemble rounds by size")
     ap.add_argument("--mesh-child", type=int, default=None,
                     help=argparse.SUPPRESS)
     ns = ap.parse_args()
     if ns.mesh_child:
         sys.exit(_mesh_child(ns.mesh_child))
+    if ns.worlds:
+        sys.exit(main_ensemble(ns.worlds, ns.gate_against))
     if ns.serve:
         sys.exit(main_served(ns.serve, ns.queue_limit, ns.gate_against))
     if ns.devices:

@@ -72,14 +72,19 @@ class Phold:
     wants_window_end = True
     # NOTE: on_tick is row-local over hosts (every read/write is row-
     # wise, global identity only through host_ids(state)), but it must
-    # NOT run inside a megakernel block: the exponential-delay draw is
-    # f32 log1p, and XLA CPU compiles f32 transcendentals to ulp-
-    # DIFFERENT results depending on the surrounding fusion context
-    # (measured: jit vs eager of the identical reference window loop
-    # disagree by 1-2ns per draw).  Bitwise megakernel-vs-reference
-    # equality therefore requires the tick to stay in the main XLA
-    # graph, where both paths compile it identically -- see the
-    # "f32 stability" section of docs/megakernel.md.
+    # NOT run inside a megakernel block: XLA CPU compiles f32
+    # transcendentals to ulp-DIFFERENT results depending on the
+    # surrounding fusion context (measured: jit vs eager of the
+    # identical reference window loop disagree by 1-2ns per draw with
+    # an f32 log1p).  The exponential-delay draw therefore promotes to
+    # f64 before the log1p -- f64 transcendentals lower to a libm call
+    # whose value is independent of fusion context -- which is also
+    # what keeps a vmapped ensemble world bitwise equal to the same
+    # world run solo (vmap restructures the engine graph and with it
+    # every f32 fusion neighborhood; see docs/ensemble.md).  Bitwise
+    # megakernel-vs-reference equality still requires the tick to stay
+    # in the main XLA graph -- see the "f32 stability" section of
+    # docs/megakernel.md.
 
     def __init__(self, mean_delay_ns: int, sock_slot: int = 0,
                  rx_batch: int = 1):
@@ -105,10 +110,16 @@ class Phold:
                          jnp.asarray(simtime.SIMTIME_INVALID, I64))
 
     def _delay(self, params, host_ids, ctr):
-        """Exponential delay, keyed by (host, draw counter)."""
+        """Exponential delay, keyed by (host, draw counter).
+
+        The log1p runs in f64: f32 transcendentals are fusion-context-
+        sensitive on XLA CPU (ulp flips when the surrounding graph
+        changes, e.g. under vmap), while the f64 path is a stable libm
+        call.  The ns result is exact far beyond any plausible mean.
+        """
         key = rng.purpose_key(params.seed_key, rng.PURPOSE_HOST_APP)
         u = rng.keyed_uniform(key, host_ids, ctr, jnp.uint32(1))
-        d = -jnp.log1p(-u) * self.mean_delay_ns
+        d = -jnp.log1p(-u.astype(jnp.float64)) * self.mean_delay_ns
         return jnp.maximum(d.astype(I64), 1)
 
     def _pick_dst(self, params, host_ids, ctr, num_hosts):
@@ -215,8 +226,9 @@ def init_state(num_hosts: int, params, msgs_per_host: int = 1,
     key = rng.purpose_key(params.seed_key, rng.PURPOSE_HOST_APP)
     rows = jnp.arange(num_hosts, dtype=U32)
     u = rng.keyed_uniform(key, rows, jnp.uint32(0), jnp.uint32(1))
+    # f64 log1p to match _delay (fusion-context-stable; see its note).
     first = jnp.maximum(
-        (-jnp.log1p(-u) * mean_delay_ns).astype(I64), 1)
+        (-jnp.log1p(-u.astype(jnp.float64)) * mean_delay_ns).astype(I64), 1)
     return PholdState(
         next_send=first,
         pending=jnp.full((num_hosts,), msgs_per_host, I32),

@@ -48,13 +48,28 @@ def world_manifest(state, params, **extra) -> dict:
     """The manifest dict save() stamps: format version, ShapeKey
     fingerprint (statics + block presence/shapes), snapshot position
     (global window index + sim time), and any caller extras (shard
-    layout, padding, run identity)."""
+    layout, padding, run identity).
+
+    Always stamps `n_worlds` (1 for a solo run; ensemble callers
+    override via extras) so replay/diff refuse loudly instead of
+    silently mixing world axes.  A STACKED ensemble state is refused
+    outright: checkpoints are per-world -- slice one out first
+    (ensemble.world)."""
     from . import shapes
+    from .core.state import world_count
+    w = world_count(state)
+    if w is not None:
+        raise ValueError(
+            f"cannot checkpoint a stacked {w}-world ensemble state: "
+            f"checkpoints are per-world -- slice a world out first "
+            f"(ensemble.world(estate, eparams, k)) and stamp "
+            f"n_worlds/world manifest extras")
     m = {
         "format": FORMAT_VERSION,
         "shape": shapes.key_manifest(shapes.shape_key(state, params)),
         "window": int(state.n_windows),
         "t_ns": int(state.now),
+        "n_worlds": 1,
     }
     if getattr(state, "dg", None) is not None:
         # Statescope stamp: `shadow1-tpu diff` refuses to compare runs
@@ -147,6 +162,18 @@ def load(path: str, template_state, template_params):
         if "_manifest" in z.files:
             from . import shapes
             saved = json.loads(str(z["_manifest"]))
+            # World-axis refusal before any shape comparison: a
+            # checkpoint stamped by an ensemble run must not silently
+            # load into a solo template (legacy files without the stamp
+            # are solo by construction -- missing means 1).
+            saved_worlds = int(saved.get("n_worlds", 1))
+            if saved_worlds != 1:
+                raise ValueError(
+                    f"checkpoint was saved by a {saved_worlds}-world "
+                    f"ensemble run (world {saved.get('world', '?')}): "
+                    f"loading it into a solo run would silently mix "
+                    f"world axes; re-run the ensemble "
+                    f"(--worlds {saved_worlds}) instead")
             cur = shapes.key_manifest(
                 shapes.shape_key(template_state, template_params))
             detail = shapes.describe_key_mismatch(

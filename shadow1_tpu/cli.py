@@ -172,6 +172,31 @@ def _add_run_flags(r, *, config_required: bool = True):
                         "observability stack (--pcap, --log-level, "
                         "--profile, heartbeats) runs sharded; only "
                         "real-process plugins remain single-device")
+    r.add_argument("--worlds", type=int, default=1, metavar="N",
+                   help="ensemble mode (docs/ensemble.md): run N whole "
+                        "simulations as one vmapped batch over a "
+                        "leading world axis -- one compiled graph "
+                        "serves every world.  World k runs with seed "
+                        "SEED+k and is bitwise identical to the solo "
+                        "run `--seed SEED+k` on the same launch grid.  "
+                        "Artifact rows (heartbeat.csv, digests.jsonl, "
+                        "...) carry a world column; summary.json holds "
+                        "one summary per world.  Composes with "
+                        "--devices: worlds are placed world-major over "
+                        "the device mesh (N must divide the world "
+                        "count).  Unsupported combos (--pcap, "
+                        "checkpointing, real-process plugins, serve) "
+                        "are refused by name")
+    r.add_argument("--sweep", metavar="SWEEP.json", default=None,
+                   help="ensemble sweep spec: JSON object, either "
+                        "{\"seeds\": [1, 2, ...]} (one world per seed) "
+                        "or {\"worlds\": [{\"seed\": 1, \"churn\": "
+                        "0.5}, ...]} with per-world overrides of "
+                        "seed/churn/churn_downtime -- only knobs that "
+                        "leave compile shapes unchanged may vary, so "
+                        "every world runs the same compiled graph.  "
+                        "Implies --worlds <count>; the resolved spec "
+                        "is recorded in run.json")
     r.add_argument("--scope", metavar="SPEC", default=None,
                    help="flowscope: sample per-flow TCP state (cwnd, "
                         "ssthresh, srtt, inflight, retransmits, bytes) "
@@ -490,7 +515,8 @@ def _parser():
 
 
 def build_world(args, *, quiet: bool = False, want_mesh: bool = True,
-                allow_substrate: bool = True) -> types.SimpleNamespace:
+                allow_substrate: bool = True,
+                netem_n_events: int | None = None) -> types.SimpleNamespace:
     """Assemble and instrument a world from the run flags.
 
     The single world-construction path `run` and `replay` share: config
@@ -507,6 +533,11 @@ def build_world(args, *, quiet: bool = False, want_mesh: bool = True,
     replay of a mesh checkpoint needs the padded shapes without the
     mesh.  `allow_substrate=False` refuses configs with real-process
     plugins (replay cannot restore external process state).
+
+    `netem_n_events` pads the netem event arrays up to a fixed slot
+    count (netem/state.py make_netem_block): ensemble builds pass the
+    max event count across worlds so seed-dependent chaos timelines
+    stack into one block shape (docs/ensemble.md).
     """
     from .config import assemble
 
@@ -530,7 +561,8 @@ def build_world(args, *, quiet: bool = False, want_mesh: bool = True,
     # installed by assemble) with the CLI's --netem/--churn additions into
     # one schedule and reinstall.  Reinstalling over an already-shrunk
     # lookahead can only shrink it further -- conservative, never wrong.
-    if args.netem or args.churn is not None:
+    if args.netem or args.churn is not None or (
+            netem_n_events is not None and asm.netem is not None):
         from . import netem as netem_mod
         tl = asm.netem if asm.netem is not None else netem_mod.timeline()
         if args.netem:
@@ -543,7 +575,8 @@ def build_world(args, *, quiet: bool = False, want_mesh: bool = True,
             tl.chaos(params.seed_key, len(asm.hostnames), args.churn,
                      mean_down_s=args.churn_downtime, t_end=int(stop))
         state, params = netem_mod.install(
-            state.replace(nm=None), params, tl)
+            state.replace(nm=None), params, tl,
+            n_events=netem_n_events)
         if not quiet:
             print(f"[shadow1-tpu] netem: {tl.describe()}", file=sys.stderr)
 
@@ -757,6 +790,237 @@ class _EmitStream:
         pass
 
 
+# Per-world override knobs a --sweep spec may vary.  Everything else
+# (host counts, slab sizes, app wiring, netem presence) is a compile
+# shape or a block-presence static: varying it across worlds would
+# break the one-compiled-graph contract, so stack() would refuse the
+# build anyway -- refuse here first, by name.
+_SWEEP_KEYS = ("seed", "churn", "churn_downtime")
+
+
+def _sweep_overrides(args):
+    """Resolve --worlds/--sweep into one flag-override dict per world
+    (plus the raw spec for run.json bookkeeping).
+
+    Plain `--worlds N` runs world k with seed SEED+k: distinct integer
+    seeds give independent threefry root keys (core/rng.py), and every
+    world stays reproducible SOLO as `--seed SEED+k` -- the bitwise
+    world-vs-solo contract (docs/ensemble.md) holds per world with no
+    extra bookkeeping.  A --sweep file replaces the derived seeds with
+    an explicit spec."""
+    spec = None
+    if args.sweep:
+        try:
+            with open(args.sweep) as f:
+                spec = json.load(f)
+        except OSError as e:
+            raise CliError(f"--sweep: cannot read {args.sweep}: {e}")
+        except ValueError as e:
+            raise CliError(
+                f"--sweep: {args.sweep} is not valid JSON: {e}")
+        if not isinstance(spec, dict) or not ({"seeds", "worlds"}
+                                              & set(spec)):
+            raise CliError(
+                '--sweep spec must be a JSON object with "seeds" (a '
+                'list of integers, one world per seed) or "worlds" (a '
+                'list of per-world override objects)')
+    if spec is None:
+        return [{"seed": args.seed + k}
+                for k in range(max(1, args.worlds))], None
+    if "seeds" in spec and "worlds" in spec:
+        raise CliError(
+            '--sweep spec has both "seeds" and "worlds"; give one')
+    if "seeds" in spec:
+        seeds = spec["seeds"]
+        if not isinstance(seeds, list) or not seeds or \
+                not all(isinstance(s, int) and not isinstance(s, bool)
+                        for s in seeds):
+            raise CliError(
+                '--sweep "seeds" must be a non-empty list of integers')
+        overrides = [{"seed": s} for s in seeds]
+    else:
+        ws = spec["worlds"]
+        if not isinstance(ws, list) or not ws or \
+                not all(isinstance(w, dict) for w in ws):
+            raise CliError(
+                '--sweep "worlds" must be a non-empty list of objects')
+        overrides = []
+        for k, w in enumerate(ws):
+            bad = sorted(set(w) - set(_SWEEP_KEYS))
+            if bad:
+                raise CliError(
+                    f"--sweep world {k} overrides {bad}; only "
+                    f"{list(_SWEEP_KEYS)} may vary per world (anything "
+                    f"else changes compile shapes or block presence, "
+                    f"which would break the one-compiled-graph "
+                    f"contract -- vary those across separate runs)")
+            overrides.append({"seed": w.get("seed", args.seed + k),
+                              **{kk: w[kk] for kk in _SWEEP_KEYS[1:]
+                                 if kk in w}})
+    if args.worlds > 1 and args.worlds != len(overrides):
+        raise CliError(
+            f"--worlds {args.worlds} but the --sweep spec defines "
+            f"{len(overrides)} world(s); drop --worlds or make them "
+            f"agree")
+    return overrides, spec
+
+
+def _run_ensemble_config(args, *, control=None, emit=None,
+                         profiler=None) -> int:
+    """Execute a `run --worlds N` / `--sweep` invocation: build every
+    world through build_world (per-world seeds, devices forced to 1 --
+    ensemble sharding places whole worlds, not host shards), stack,
+    and hand off to sim.run_ensemble (docs/ensemble.md).
+
+    The refusal surface is explicit: combos whose host-side machinery
+    has no world axis are refused rc 2 BY NAME, naming the limitation
+    and the solo workaround, instead of silently writing solo-shaped
+    artifacts that a later reader would mis-join."""
+    from . import sim as sim_mod
+    from .ensemble import EnsembleMismatch
+
+    try:
+        overrides, spec = _sweep_overrides(args)
+        nw = len(overrides)
+        if getattr(args, "worlds", 1) < 1:
+            raise CliError("--worlds must be >= 1")
+        if control is not None or emit is not None:
+            raise CliError(
+                "--worlds/--sweep under serve/submit is unsupported: "
+                "the run server's park/resume and crash recovery are "
+                "checkpoint-anchored and checkpoints are per-world; "
+                "submit each world as its own request (--seed <world "
+                "seed>)")
+        if args.profile or profiler is not None:
+            raise CliError(
+                "--profile is unsupported with --worlds/--sweep: the "
+                "profiler's phase spans and counter files are per-run "
+                "with no world column; profile one world solo "
+                "(--seed <that world's seed>)")
+        if args.pcap:
+            raise CliError(
+                "--pcap is unsupported with --worlds/--sweep: the "
+                "capture ring and pcap writer have no world column, "
+                "so packets from different worlds would interleave "
+                "into one capture; capture one world solo (--seed "
+                "<that world's seed>)")
+        if getattr(args, "checkpoint_every", None) or \
+                getattr(args, "auto_resume", False) or \
+                getattr(args, "watchdog", None):
+            raise CliError(
+                "--checkpoint-every/--auto-resume/--watchdog are "
+                "unsupported with --worlds/--sweep: checkpoints are "
+                "per-world (checkpoint.world_manifest refuses stacked "
+                "states), so supervision has no recovery anchor; "
+                "checkpoint one world solo, or re-run the ensemble "
+                "from t=0 (bitwise reproducible per seed)")
+        if args.devices > 1:
+            if nw % args.devices != 0:
+                raise CliError(
+                    f"--devices {args.devices} shards the WORLD axis "
+                    f"in ensemble mode (world-major, "
+                    f"docs/ensemble.md) and needs n_worlds % devices "
+                    f"== 0; got {nw} world(s)")
+            devs = jax.devices()
+            if len(devs) < args.devices:
+                raise CliError(
+                    f"--devices {args.devices} but only {len(devs)} "
+                    f"{jax.default_backend()} device(s) visible")
+
+        def build(k, n_events=None):
+            a = argparse.Namespace(**vars(args))
+            a.devices = 1
+            for key, val in overrides[k].items():
+                setattr(a, key, val)
+            try:
+                return build_world(a, quiet=args.quiet or k > 0,
+                                   want_mesh=False,
+                                   allow_substrate=False,
+                                   netem_n_events=n_events)
+            except CliError as e:
+                if "substrate" in str(e):
+                    raise CliError(
+                        "--worlds/--sweep cannot run real-process "
+                        "plugins: the substrate drives one set of "
+                        "external processes with no world axis; run "
+                        "plugin configs solo") from e
+                raise
+
+        built = [build(k) for k in range(nw)]
+        if any(b.want_pcap for b in built):
+            raise CliError(
+                "this config enables packet capture (<host logpcap>), "
+                "which is unsupported with --worlds/--sweep: the "
+                "capture ring has no world column; capture one world "
+                "solo (--seed <that world's seed>)")
+        has_nm = [b.state.nm is not None for b in built]
+        if any(has_nm) and not all(has_nm):
+            raise CliError(
+                "every sweep world must carry netem or none: the nm "
+                "block's presence is a compile static "
+                "(shapes.ShapeKey), so worlds with and without churn "
+                "cannot share one compiled graph -- give every world "
+                "a churn rate (0.0 keeps the block with no flaps) or "
+                "none")
+        # Seed-dependent chaos timelines draw different event counts
+        # per world; rebuild on the shared max-count bucket so the nm
+        # block stacks (netem_n_events pads the tail with inert
+        # never-fire slots -- docs/ensemble.md).
+        ev_counts = [int(b.state.nm.ev_time.shape[0])
+                     for b in built if b.state.nm is not None]
+        if ev_counts and len(set(ev_counts)) > 1:
+            bucket = max(ev_counts)
+            if not args.quiet:
+                print(f"[shadow1-tpu] ensemble: netem event counts "
+                      f"{sorted(set(ev_counts))} -> bucket {bucket}",
+                      file=sys.stderr)
+            built = [build(k, n_events=bucket) for k in range(nw)]
+
+        sweep_record = None
+        if spec is not None or nw > 1:
+            sweep_record = {"worlds": overrides}
+            if args.sweep:
+                import os
+                sweep_record["file"] = os.path.abspath(args.sweep)
+
+        t_wall = time.perf_counter()
+        try:
+            estate, eparams, app, summaries = sim_mod.run_ensemble(
+                [(b.state, b.params, b.app) for b in built],
+                until=built[0].stop,
+                data_dir=args.data_directory,
+                digest=getattr(args, "digest_every", None),
+                heartbeat_s=(args.heartbeat_frequency
+                             if args.data_directory else 0),
+                devices=(args.devices if args.devices > 1 else None),
+                hostnames=list(built[0].asm.hostnames),
+                sweep=sweep_record,
+                quiet=args.quiet)
+        except EnsembleMismatch as e:
+            raise CliError(f"worlds do not stack: {e}")
+    except CliError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return e.rc
+
+    bad = [s for s in summaries if s["err_flags"]]
+    if not args.quiet or bad:
+        for s in summaries:
+            flag = (f", ERR=0x{s['err_flags']:x}" if s["err_flags"]
+                    else "")
+            print(f"[shadow1-tpu] world {s['world']}: "
+                  f"{s['events']} events, {s['packets_sent']} packets, "
+                  f"{s['drops']} drops{flag}", file=sys.stderr)
+        print(f"[shadow1-tpu] ensemble: {nw} world(s) in "
+              f"{time.perf_counter() - t_wall:.2f}s wall",
+              file=sys.stderr)
+    if bad:
+        print(f"error: {len(bad)} world(s) raised invariant-violation "
+              f"flags (err_flags above; docs/robustness.md)",
+              file=sys.stderr)
+        return RC_INVARIANT
+    return RC_OK
+
+
 def run_config(args, *, control=None, emit=None, profiler=None) -> int:
     """Execute a `run` invocation.  `control` / `emit` are the run
     server's hooks (server.RunControl + an event callback): the loop
@@ -771,6 +1035,13 @@ def run_config(args, *, control=None, emit=None, profiler=None) -> int:
     import os
 
     from . import trace
+
+    if getattr(args, "sweep", None) or getattr(args, "worlds", 1) > 1:
+        # Ensemble mode: N whole simulations vmapped over a leading
+        # world axis (docs/ensemble.md).  Its flag surface is a strict
+        # subset -- unsupported combos are refused by name inside.
+        return _run_ensemble_config(args, control=control, emit=emit,
+                                    profiler=profiler)
 
     if args.profile:
         if not args.data_directory:

@@ -2355,7 +2355,18 @@ def run_until_impl(state: SimState, params, app, t_target):
     Uniform predicates guarantee identical window/micro-step trip counts
     on every shard, which is what lets collectives live inside the
     while_loops at all -- and makes n_steps/n_windows/now replicated for
-    free."""
+    free.
+
+    Ensemble mode (ensemble/__init__.py) needs NO changes here, and must
+    never get any: under `jax.vmap` the while_loops batch by running
+    while ANY world's predicate holds and select-freezing finished
+    lanes, so each world advances by its own per-world gmin -- worlds
+    never synchronize each other's windows, and a finished world's state
+    is carried through untouched (the select keeps it bitwise frozen).
+    Keeping this function vmap-transparent is what makes an ensemble
+    world bitwise equal to its solo run AND keeps ensemble-absent runs
+    lowering byte-identical HLO (the tier-0 pins in
+    tests/test_ensemble.py check both)."""
     from . import megakernel as mk
     t_target = jnp.asarray(t_target, I64)
     mesh = _on_mesh(state)

@@ -279,7 +279,11 @@ def make_net_params(
         bw_up_Bps=jnp.asarray(bw_up_Bps, I64),
         bw_down_Bps=jnp.asarray(bw_down_Bps, I64),
         min_latency_ns=jnp.asarray(min_latency_ns, I64),
-        seed_key=rng.root_key(seed),
+        # `seed` is an int (the common case) or an already-derived PRNG
+        # key -- ensemble.replicate builds world k from
+        # rng.world_key(root_key(seed), k) and hands the key through.
+        seed_key=(seed if isinstance(seed, jnp.ndarray)
+                  else rng.root_key(seed)),
         stop_time=jnp.asarray(stop_time, I64),
         bootstrap_end=jnp.asarray(bootstrap_end, I64),
         cpu_ns_per_event=jnp.asarray(cpu_ns_per_event, I64),

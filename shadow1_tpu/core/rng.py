@@ -30,6 +30,7 @@ PURPOSE_JITTER = 4
 PURPOSE_SCHED = 5
 PURPOSE_CHAOS = 6   # netem churn process draws (netem/timeline.py)
 PURPOSE_LINEAGE = 7  # packet-lineage sampling + trace-id assignment
+PURPOSE_WORLD = 8   # ensemble world-id fold (ensemble/__init__.py)
 
 
 def root_key(seed: int) -> jax.Array:
@@ -39,6 +40,22 @@ def root_key(seed: int) -> jax.Array:
 
 def purpose_key(key: jax.Array, purpose: int) -> jax.Array:
     return jax.random.fold_in(key, purpose)
+
+
+def world_key(key: jax.Array, world: int) -> jax.Array:
+    """Seed key for world `world` of an ensemble replicated from `key`.
+
+    World 0 is the IDENTITY -- `ensemble.replicate(n)[0]` is bitwise the
+    solo run seeded the same way, which is what the tier-0 ensemble pins
+    compare against.  Worlds k>0 fold the world id under PURPOSE_WORLD so
+    their streams are decorrelated from every solo seed and from each
+    other (a plain fold_in(key, k) would collide with fold_in paths that
+    already consume small integers).  Host-side, build-time only: the
+    fold happens once per world before stacking, never inside the
+    compiled graph."""
+    if world == 0:
+        return key
+    return jax.random.fold_in(purpose_key(key, PURPOSE_WORLD), world)
 
 
 # Plain Python int, wrapped per-trace: a module-level jnp constant would run

@@ -1365,6 +1365,22 @@ def host_ids(state, dtype=I32) -> jnp.ndarray:
     return ids + state.hoff.astype(dtype)
 
 
+def world_count(state) -> int | None:
+    """Number of worlds when `state` carries an ensemble's leading world
+    axis (ensemble.stack), else None for an ordinary solo state.
+
+    Probes `state.now` -- an i64 scalar in every solo state, so a stacked
+    state is unambiguously ndim == 1.  Host-side introspection helpers
+    that read row counts off leaf shapes (e.g. `hosts.num_hosts`, which
+    returns leaf.shape[0]) are WRONG on a stacked state: slice a world
+    out first (`ensemble.world(estate, eparams, k)`) before calling
+    them."""
+    now = jnp.asarray(state.now)
+    if now.ndim == 0:
+        return None
+    return int(now.shape[0])
+
+
 # Known-bad region of the TPU tunnel backend (BASELINE.md;
 # tools/repro_tunnel_crash.py r4 finding): slab >= 128 at >= 10k hosts
 # reproducibly faults the tunnel worker.  One source of truth for the

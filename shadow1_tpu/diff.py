@@ -115,15 +115,30 @@ def load_digests(data_dir: str) -> dict:
         except (FileNotFoundError, ValueError):
             pass
     shards = len(rows[0]["sums"][DIGEST_GROUPS[0]])
+    # World-axis stamp: run.json for ensemble runs, else the rows
+    # themselves (ensemble DigestDrains stamp a "world" column).
+    # Missing on both means a legacy/solo record -- 1.
+    n_worlds = int(info.get("n_worlds") or 0)
+    if not n_worlds:
+        n_worlds = len({r["world"] for r in rows if "world" in r}) or 1
     return {"dir": data_dir, "rows": rows, "every": every,
             "shards": shards, "schema": schema,
             "devices": info.get("devices"),
+            "n_worlds": n_worlds,
             "checkpointed": os.path.exists(run_json)}
 
 
 def _check_comparable(a: dict, b: dict, devices) -> None:
     """Named refusals for incomparable digest records -- eager, before
     any stream walk or device work."""
+    for r in (a, b):
+        if r.get("n_worlds", 1) != 1:
+            raise DiffUsageError(
+                f"{r['dir']}: digest record of a {r['n_worlds']}-world "
+                f"ensemble run -- the stream interleaves per-world rows "
+                f"and a pairwise diff would silently mix world axes; "
+                f"summarize per world with `tools/parse.py ensemble` "
+                f"(first-divergence-from-world-0 is computed there)")
     if a["every"] and b["every"] and int(a["every"]) != int(b["every"]):
         raise DiffUsageError(
             f"digest cadence mismatch: {a['dir']} recorded every "

@@ -1,0 +1,248 @@
+"""Ensemble worlds: vmap whole simulations over a leading world axis.
+
+Shadow runs one simulated world per process; here one compiled graph
+serves an N-world ensemble -- seeded Monte-Carlo batches, netem chaos
+sweeps, latency/loss parameter grids -- by stacking every `SimState`
+and `NetParams` leaf on a leading world axis and `jax.vmap`-ing the
+unmodified engine window loop over it.
+
+Three contracts make the axis safe (tests/test_ensemble.py pins all):
+
+* **Bitwise solo equivalence.**  World k of a stacked ensemble is
+  leaf-for-leaf bitwise equal to the same world run solo.  `jax.vmap`
+  batches the engine's `lax.while_loop`s by running while ANY lane's
+  predicate holds and select-freezing finished lanes, so each world
+  advances by its own per-world gmin -- worlds never synchronize each
+  other's windows, and a finished world's state is carried through
+  untouched.  (The one numerical precondition -- transcendentals must
+  not be fusion-context-sensitive -- is handled at the source: see the
+  f64 note in apps/phold.py.)
+
+* **HLO identity for ensemble-absent runs.**  The engine body is
+  vmap-transparent: `core/engine.py` gains no ensemble branches, so a
+  solo run lowers byte-identical HLO whether or not this package is
+  imported.
+
+* **RNG hygiene.**  `replicate` seeds world k with
+  `rng.world_key(root_key(seed), k)`: world 0 is the identity (bitwise
+  the solo run with the same seed), worlds k>0 fold the world id under
+  `PURPOSE_WORLD` so their streams are independent of every solo seed.
+
+Mesh composition (world-major rule): `shard_worlds` shards the WORLD
+axis across the existing 1-D hosts mesh -- each device owns
+n_worlds/n_devices complete worlds, there are no cross-device
+collectives (worlds are independent), and the per-world host arrays
+stay whole.  A 2-D world x hosts mesh (worlds outer, host-sharding
+inner with the parallel/mesh.py collectives nested under vmap) is
+deferred: it only pays once a single world outgrows one device's HBM,
+and it couples the window-advance collectives to the world axis --
+docs/ensemble.md records the rationale.
+
+Megakernel note: stacking forces `params.megakernel = False`.  Pallas
+kernels have no batching rule under vmap; the reference path is already
+pinned bitwise-identical to the megakernel path (tests/test_megakernel),
+so the trajectory contract is unaffected.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .. import shapes
+from ..core import engine, rng, simtime
+from ..core.state import world_count
+
+I64 = jnp.int64
+
+__all__ = [
+    "EnsembleMismatch", "stack", "replicate", "run_until", "run_chunked",
+    "world", "world_count", "shard_worlds", "cache_size",
+]
+
+
+class EnsembleMismatch(ValueError):
+    """Worlds cannot share one compiled graph: shapes/statics differ.
+
+    Raised by `stack` naming the first mismatched block or static.  The
+    CLI maps it to rc 2 (usage), pointing at `--bucket`."""
+
+
+def _as_triple(w, k):
+    try:
+        state, params, app = w
+    except (TypeError, ValueError):
+        raise EnsembleMismatch(
+            f"world {k} is not a (state, params, app) triple: {type(w)!r}")
+    return state, params, app
+
+
+def stack(worlds):
+    """Stack N built worlds onto a leading world axis.
+
+    `worlds`: sequence of (state, params, app) triples, all members of
+    ONE shape bucket (identical ShapeKey: same hosts/slabs/statics, same
+    present-or-None block layout) with equal apps.  Returns
+    (estate, eparams, app) where every leaf carries a leading [N] axis.
+
+    Refuses with `EnsembleMismatch` naming the first mismatched
+    block/static -- rebuild the members into one bucket (`--bucket` /
+    `shapes.bucket_for`; for seed-dependent netem schedules pad with the
+    timeline `n_events` bucket) rather than letting `jnp.stack` throw a
+    bare shape error.
+
+    `params.megakernel` is forced off on every member before the shape
+    comparison (see module docstring)."""
+    worlds = [_as_triple(w, k) for k, w in enumerate(worlds)]
+    if not worlds:
+        raise EnsembleMismatch("stack() needs at least one world")
+    worlds = [(s, p.replace(megakernel=False), a) for (s, p, a) in worlds]
+
+    s0, p0, a0 = worlds[0]
+    m0 = shapes.key_manifest(shapes.shape_key(s0, p0))
+    td0 = (jax.tree_util.tree_structure(s0), jax.tree_util.tree_structure(p0))
+    for k, (s, p, a) in enumerate(worlds[1:], start=1):
+        if a != a0:
+            raise EnsembleMismatch(
+                f"world {k} does not stack with world 0: app differs "
+                f"({a!r} vs {a0!r}); an ensemble shares ONE app (the app "
+                f"is a static argument of the compiled graph)")
+        mk = shapes.key_manifest(shapes.shape_key(s, p))
+        why = shapes.describe_key_mismatch(
+            m0, mk, a_label="world 0", b_label=f"world {k}")
+        if why is not None:
+            raise EnsembleMismatch(
+                f"world {k} does not stack with world 0: {why}; rebuild "
+                f"the members into one shape bucket (--bucket / "
+                f"shapes.bucket_for; netem schedules take an n_events "
+                f"bucket)")
+        td = (jax.tree_util.tree_structure(s),
+              jax.tree_util.tree_structure(p))
+        if td != td0:
+            raise EnsembleMismatch(
+                f"world {k} does not stack with world 0: pytree "
+                f"structure differs (same ShapeKey but different leaf "
+                f"layout -- e.g. app state blocks)")
+        # Leaf-level shape/dtype sweep: names mismatches the ShapeKey is
+        # too coarse for (per-leaf ring capacities, netem tables).
+        for (path, l0), lk in zip(
+                jax.tree_util.tree_flatten_with_path((s0, p0))[0],
+                jax.tree_util.tree_leaves((s, p))):
+            a_sh = (jnp.shape(l0), jnp.result_type(l0))
+            b_sh = (jnp.shape(lk), jnp.result_type(lk))
+            if a_sh != b_sh:
+                raise EnsembleMismatch(
+                    f"world {k} does not stack with world 0: leaf "
+                    f"{jax.tree_util.keystr(path)} is {b_sh[0]}/{b_sh[1]} "
+                    f"vs {a_sh[0]}/{a_sh[1]}; rebuild the members into "
+                    f"one shape bucket (--bucket; netem schedules take "
+                    f"an n_events bucket)")
+
+    estate = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[w[0] for w in worlds])
+    eparams = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[w[1] for w in worlds])
+    return estate, eparams, a0
+
+
+def replicate(build, n: int, *, seed: int = 1, vary=None, **kwargs):
+    """Build n worlds from one builder under the world-key RNG fold.
+
+    World k calls `build(seed=rng.world_key(root_key(seed), k), ...)`,
+    so world 0 is bitwise the solo `build(seed=seed)` world and worlds
+    k>0 get independent streams (core/rng.py world_key).  `vary` is an
+    optional callable `(k) -> dict` of per-world builder-kwarg
+    overrides for parameter grids.  Returns the world list, ready for
+    `stack`."""
+    root = rng.root_key(seed)
+    worlds = []
+    for k in range(int(n)):
+        kw = dict(kwargs)
+        if vary is not None:
+            kw.update(vary(k) or {})
+        worlds.append(build(seed=rng.world_key(root, k), **kw))
+    return worlds
+
+
+@functools.partial(jax.jit, static_argnames=("app",))
+def _run_until(estate, eparams, t_target, *, app):
+    return jax.vmap(
+        lambda s, p: engine.run_until_impl(s, p, app, t_target)
+    )(estate, eparams)
+
+
+def run_until(estate, eparams, app, t_target):
+    """Run every world's window loop until simulated `t_target`, one
+    compiled graph for the whole ensemble (vmapped engine.run_until)."""
+    return _run_until(estate, eparams, jnp.asarray(t_target, I64), app=app)
+
+
+def cache_size() -> int:
+    """Compiled-graph count of the ensemble runner (ladder rung 10
+    asserts one graph serves the whole ensemble)."""
+    return _run_until._cache_size()
+
+
+def run_chunked(estate, eparams, app, t_target: int,
+                chunk_ns: int = engine.CHUNK_NS):
+    """Host-side loop of bounded ensemble launches up to `t_target` --
+    engine.run_chunked with the world axis.  Chunk boundaries are
+    absolute times shared by all worlds (each world still advances by
+    its own windows inside a launch), so drains see every world at the
+    same boundary."""
+    from .. import trace
+
+    t = int(jnp.min(estate.now))
+    t_target = int(t_target)
+    prof = trace.current()
+    while t < t_target:
+        t = min(t + chunk_ns, t_target)
+        with prof.span("device_step", t_ns=t):
+            estate = run_until(estate, eparams, app, t)
+            if prof.sync:
+                jax.block_until_ready(estate)
+    return estate
+
+
+def world(estate, eparams, k: int):
+    """Slice world k back out of a stacked ensemble: returns
+    (state, params) with the world axis removed -- safe to hand to any
+    host-side introspection that reads row counts off leaf shapes."""
+    n = world_count(estate)
+    if n is None:
+        raise ValueError("world(): state has no world axis (solo state?)")
+    if not 0 <= k < n:
+        raise IndexError(f"world {k} out of range [0, {n})")
+    return (jax.tree_util.tree_map(lambda x: x[k], estate),
+            jax.tree_util.tree_map(lambda x: x[k], eparams))
+
+
+def shard_worlds(estate, eparams, mesh=None):
+    """Place a stacked ensemble world-major across the hosts mesh.
+
+    The WORLD axis shards over the existing 1-D device mesh
+    (parallel/sharding.HOST_AXIS): each device owns complete worlds, so
+    the vmapped graph partitions with zero collectives.  Requires
+    n_worlds % n_devices == 0 (worlds are whole; there is nothing
+    meaningful to pad them with).  See module docstring for why the
+    2-D world x hosts mesh is deferred."""
+    from ..parallel.sharding import HOST_AXIS, make_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        mesh = make_mesh()
+    n = world_count(estate)
+    if n is None:
+        raise ValueError("shard_worlds(): state has no world axis; "
+                         "stack() worlds first")
+    d = mesh.devices.size
+    if n % d:
+        raise ValueError(
+            f"shard_worlds(): {n} worlds do not divide over {d} devices; "
+            f"run a multiple of {d} worlds (worlds are never split)")
+    sh = NamedSharding(mesh, P(HOST_AXIS))
+    put = lambda x: jax.device_put(x, sh)
+    return (jax.tree_util.tree_map(put, estate),
+            jax.tree_util.tree_map(put, eparams))

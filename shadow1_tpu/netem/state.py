@@ -111,7 +111,7 @@ class NetemBlock:
 
 
 def make_netem_block(num_hosts: int, events, link_pairs=(),
-                     groups=None) -> NetemBlock:
+                     groups=None, n_events=None) -> NetemBlock:
     """Build a NetemBlock from a host-side event list.
 
     `events`: iterable of (time_ns, kind, a, b, val) -- sorted here
@@ -119,12 +119,15 @@ def make_netem_block(num_hosts: int, events, link_pairs=(),
     `link_pairs`: distinct (a, b) pairs that per-link events reference;
     the override table is sized to exactly these.
     `groups`: optional [H] group-id assignment for partitions.
+    `n_events`: optional event-table bucket; extra slots stay T_NEVER
+    (never fire), letting worlds with different schedule lengths share
+    one shape (ensemble stacking).
     """
     import numpy as np
 
     evs = sorted(enumerate(events), key=lambda iv: (iv[1][0], iv[0]))
     evs = [v for _, v in evs]
-    n = max(1, len(evs))
+    n = max(1, len(evs), 0 if n_events is None else int(n_events))
     t = np.full(n, T_NEVER, np.int64)
     k = np.zeros(n, np.int32)
     a = np.full(n, -1, np.int32)

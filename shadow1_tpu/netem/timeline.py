@@ -176,11 +176,20 @@ class Timeline:
                 in self.events if k in _PAIR_KINDS or
                 (k in (EV_LINK_LAT, EV_LINK_LOSS) and a >= 0)}
 
-    def build(self, num_hosts: int):
+    def build(self, num_hosts: int, n_events: int | None = None):
         """Lower to a NetemBlock, or None when the timeline is empty --
-        the None fast path keeps untouched worlds bit-identical."""
+        the None fast path keeps untouched worlds bit-identical.
+
+        `n_events` pads the event table to a fixed bucket (slots beyond
+        the real schedule carry T_NEVER, which the cursor never reaches)
+        so seed-dependent schedules -- chaos churn draws a different
+        event count per seed -- share one shape across ensemble worlds."""
         if not self.events and not self.groups:
             return None
+        if n_events is not None and len(self.events) > n_events:
+            raise ValueError(
+                f"timeline has {len(self.events)} events, more than the "
+                f"requested n_events bucket {n_events}")
         groups = np.zeros(num_hosts, np.int32)
         for h, g in self.groups.items():
             if not 0 <= h < num_hosts:
@@ -194,7 +203,7 @@ class Timeline:
                                      f"[0, {num_hosts})")
         return make_netem_block(num_hosts, self.events,
                                 link_pairs=self.link_pairs(),
-                                groups=groups)
+                                groups=groups, n_events=n_events)
 
     def describe(self) -> dict:
         """Compact summary for bench/metrics config blocks."""
@@ -211,14 +220,15 @@ def timeline() -> Timeline:
     return Timeline()
 
 
-def install(state, params, tl: Timeline):
+def install(state, params, tl: Timeline, n_events: int | None = None):
     """Attach a timeline to a built world: returns (state, params) with
     the block on `state.nm` and the conservative lookahead shrunk by the
     smallest latency scale the schedule can reach (a sub-1.0 scale would
     otherwise let the window overrun the smallest live latency).  An
-    empty timeline returns the inputs unchanged (None fast path)."""
+    empty timeline returns the inputs unchanged (None fast path).
+    `n_events` pads the event table to a shared bucket (Timeline.build)."""
     num_hosts = int(state.hosts.num_hosts)
-    block = tl.build(num_hosts)
+    block = tl.build(num_hosts, n_events=n_events)
     if block is None:
         return state, params
     scale = _apply.min_lat_scale_x1000(tl.events)

@@ -62,15 +62,23 @@ def _pack_heartbeat(hosts):
 
 
 class Tracker:
-    """Appends per-host heartbeat rows; one instance per run."""
+    """Appends per-host heartbeat rows; one instance per run.
+
+    Ensemble runs share one heartbeat.csv across W per-world trackers:
+    `world` prefixes every row with a world column (and the header with
+    `world,`), `write_header=False` keeps trackers 1..W-1 from
+    truncating what world 0 wrote -- the drain-layer world-column
+    convention (docs/ensemble.md)."""
 
     HEADER = ("time_s,host,bytes_sent_per_s,bytes_recv_per_s,"
               "pkts_sent,pkts_recv,drops_inet,drops_router,"
               "tx_queued,rx_queued\n")
 
     def __init__(self, data_dir: str, hostnames, interval_s: int = 1,
-                 per_host_interval_s=None):
+                 per_host_interval_s=None, world: int | None = None,
+                 write_header: bool = True):
         self.dir = data_dir
+        self.world = world
         self.hostnames = list(hostnames)
         self.interval_ns = interval_s * SEC
         h = len(self.hostnames)
@@ -89,8 +97,10 @@ class Tracker:
         self._last_row_t = np.zeros(h, np.int64)
         os.makedirs(data_dir, exist_ok=True)
         self.path = os.path.join(data_dir, "heartbeat.csv")
-        with open(self.path, "w") as f:
-            f.write(self.HEADER)
+        if write_header:
+            with open(self.path, "w") as f:
+                f.write(self.HEADER if world is None
+                        else "world," + self.HEADER)
         self._last = {f: np.zeros(h, np.int64) for f in _FIELDS}
         self._last_t = 0  # _last rows advance per written heartbeat row
 
@@ -117,6 +127,8 @@ class Tracker:
                 dt_s = max((now_ns - self._last_row_t[i]) / SEC, 1e-9)
                 self._last_row_t[i] = now_ns
                 d = {k: int(cur[k][i] - self._last[k][i]) for k in _FIELDS}
+                if self.world is not None:
+                    f.write(f"{self.world},")
                 f.write(f"{now_ns / SEC:.3f},{name},"
                         f"{d['bytes_sent'] / dt_s:.1f},"
                         f"{d['bytes_recv'] / dt_s:.1f},"
@@ -253,15 +265,20 @@ class LogDrain:
 
     Sharded rings (make_log_ring shards=N, mesh runs) drain per shard
     segment and merge into global sim-time order; record host ids are
-    global on every layout, so the hostname mapping is unchanged."""
+    global on every layout, so the hostname mapping is unchanged.
 
-    def __init__(self, path, hostnames):
+    `world` prefixes every line with a `[w<k>]` tag; `path` may be an
+    already-open shared file (ensemble runs interleave W worlds' lines
+    into one shadow.log; trace._open_sink ownership rules)."""
+
+    def __init__(self, path, hostnames, world: int | None = None):
         self.path = path
         self.hostnames = list(hostnames)
+        self.world = world
         self._last_total = 0
         self._last_tot = None   # [shards] per-segment cursors, lazy
         self._lost_reported = 0
-        self._f = open(path, "w")
+        self._f, self._own = trace._open_sink(path)
 
     def drain(self, state):
         with trace.current().span("log_drain"):
@@ -312,18 +329,20 @@ class LogDrain:
                           f"(ring capacity {per})\n")
         idx = np.concatenate(parts)
         order = np.argsort(t[idx], kind="stable")
+        wtag = "" if self.world is None else f"[w{self.world}] "
         for k in idx[order]:
             name = self.hostnames[host[k]] if host[k] < len(self.hostnames) \
                 else str(host[k])
             msg = _LOG_MSG.get(int(code[k]), f"event {code[k]}")
-            self._f.write(f"[{t[k] / SEC:13.9f}] [{name}] "
+            self._f.write(f"[{t[k] / SEC:13.9f}] {wtag}[{name}] "
                           + msg.format(arg=int(arg[k])) + "\n")
         self._f.flush()
         self._last_total = total
         return new
 
     def close(self):
-        self._f.close()
+        if self._own:
+            self._f.close()
 
 
 def census(state) -> dict:

@@ -134,32 +134,42 @@ def key_manifest(key: ShapeKey) -> dict:
     return d
 
 
-def describe_key_mismatch(saved: dict, current: dict) -> str | None:
+def describe_key_mismatch(saved: dict, current: dict,
+                          a_label: str = "checkpoint",
+                          b_label: str = "template") -> str | None:
     """Name the first difference between two key_manifest() dicts, or
     None when they match.  Block differences name the BLOCK (a missing
     flight recorder, a log ring sized differently); static differences
     name the STATIC (cong, megakernel, pool_slab, ...) -- the load-time
-    diagnosis checkpoint.load prints instead of a bare structure error."""
+    diagnosis checkpoint.load prints instead of a bare structure error.
+    `a_label`/`b_label` rename the two sides for non-checkpoint callers
+    (ensemble.stack compares world 0 against world k)."""
     sb = saved.get("blocks", {})
     cb = current.get("blocks", {})
     for name in _STATE_BLOCKS:
         in_s, in_c = name in sb, name in cb
         if in_s and not in_c:
-            return (f"block {name!r} is present in the checkpoint but "
-                    f"absent on the template (install it before loading)")
+            return (f"block {name!r} is present in the {a_label} but "
+                    f"absent on the {b_label} (install it before loading)"
+                    if a_label == "checkpoint" else
+                    f"block {name!r} is present on the {a_label} but "
+                    f"absent on the {b_label}")
         if in_c and not in_s:
-            return (f"block {name!r} is present on the template but "
-                    f"absent in the checkpoint (build the template "
-                    f"without it; add instrumentation AFTER loading)")
+            return (f"block {name!r} is present on the {b_label} but "
+                    f"absent in the {a_label} (build the template "
+                    f"without it; add instrumentation AFTER loading)"
+                    if a_label == "checkpoint" else
+                    f"block {name!r} is present on the {b_label} but "
+                    f"absent on the {a_label}")
         if in_s and sb[name] != cb[name]:
-            return (f"block {name!r} leaf shapes differ: checkpoint "
-                    f"{sb[name]} vs template {cb[name]}")
+            return (f"block {name!r} leaf shapes differ: {a_label} "
+                    f"{sb[name]} vs {b_label} {cb[name]}")
     for field in sorted(set(saved) | set(current)):
         if field == "blocks":
             continue
         if saved.get(field) != current.get(field):
-            return (f"static {field!r} differs: checkpoint "
-                    f"{saved.get(field)!r} vs template "
+            return (f"static {field!r} differs: {a_label} "
+                    f"{saved.get(field)!r} vs {b_label} "
                     f"{current.get(field)!r}")
     return None
 

@@ -262,20 +262,23 @@ def build_gossip(num_hosts: int = 500,
 
 def add_churn(state, params, rate_per_s: float,
               mean_down_s: float = 5.0, hosts=None,
-              t_start: int = 0, t_end: int | None = None):
+              t_start: int = 0, t_end: int | None = None,
+              n_events: int | None = None):
     """Install seeded chaos churn on a built world: every selected host
     alternates exponential up-times (mean 1/rate_per_s s) and down-times
     (mean mean_down_s s), drawn from params.seed_key -- bitwise
     reproducible for a given seed (netem/timeline.py chaos).  Returns
     (state, params); params' conservative lookahead is untouched (churn
-    never shortens latencies)."""
+    never shortens latencies).  `n_events` pads the schedule to a fixed
+    bucket so per-seed churn worlds (whose draw counts differ) stack on
+    an ensemble world axis -- see ensemble.stack."""
     from . import netem
     num_hosts = int(state.hosts.num_hosts)
     tl = netem.timeline().chaos(
         params.seed_key, num_hosts, rate_per_s,
         mean_down_s=mean_down_s, hosts=hosts, t_start=t_start,
         t_end=int(params.stop_time) if t_end is None else int(t_end))
-    return netem.install(state, params, tl)
+    return netem.install(state, params, tl, n_events=n_events)
 
 
 class Drains:
@@ -695,6 +698,194 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
                 profiler.set_digest(digests.summary())
         if profiler is not None:
             trace.install(None)
+
+
+def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
+                 lineage=None, digest=None, heartbeat_s: int = 0,
+                 log: bool = False, devices=None, chunk_ns=None,
+                 hostnames=None, sweep=None, quiet: bool = True):
+    """Run N worlds as one vmapped ensemble (docs/ensemble.md).
+
+    `worlds` is a sequence of built (state, params, app) triples -- one
+    shape bucket, equal apps (ensemble.stack validates and refuses by
+    name).  Each world is bitwise identical to the same world run solo
+    through engine.run_chunked on the same launch grid (the tier-0 pin
+    in tests/test_ensemble.py).
+
+    Instrumentation (`scope`/`lineage`/`digest`, same specs as run())
+    installs per world BEFORE stacking, so the blocks stack like any
+    other state.  With `data_dir` the drains share one artifact file
+    per kind -- heartbeat.csv, shadow.log, flows.jsonl/links.jsonl,
+    spans.jsonl, digests.jsonl -- every row carrying a world column
+    (the drain-layer convention); run.json records `n_worlds` and the
+    `sweep` spec for replay bookkeeping, and summary.json holds one
+    summary per world.
+
+    `devices=N` places worlds world-major across the first N devices
+    (ensemble.shard_worlds; n_worlds must divide).  Checkpointing /
+    supervision / substrate plugins are NOT supported under the world
+    axis (the CLI refuses those combos; checkpoint.world_manifest
+    refuses stacked states).
+
+    Returns (estate, eparams, app, summaries): the final stacked state
+    and one summary dict per world."""
+    import os
+    import time as _time
+
+    import jax
+
+    from . import ensemble, trace
+    from . import replay as replay_mod
+
+    worlds = list(worlds)
+    nw = len(worlds)
+
+    def _install(st, p, a):
+        if scope is not None and st.scope is None:
+            st = trace.ensure_flowscope(st, shards=1,
+                                        **trace.parse_scope_spec(scope))
+        if lineage is not None and st.lineage is None:
+            st = trace.ensure_lineage(
+                st, rate=trace.parse_lineage_rate(lineage), shards=1)
+        if digest is not None and digest is not False and st.dg is None:
+            st = trace.ensure_digests(
+                st, every=1 if digest is True else int(digest), shards=1)
+        if log and st.log is None:
+            from .core.state import make_log_ring
+            h = int(st.hosts.num_hosts)
+            # Level 1 everywhere (drops + netem kills; the CLI's
+            # "message" tier) -- ensemble runs log per-world incidents,
+            # not per-packet debug floods.
+            st = st.replace(log=make_log_ring(),
+                            log_level=jnp.ones((h,), jnp.int32))
+        return st, p, a
+
+    worlds = [_install(*w) for w in worlds]
+    estate, eparams, app = ensemble.stack(worlds)
+    if until is None:
+        until = int(jnp.max(eparams.stop_time))
+    until = int(until)
+    if chunk_ns is None:
+        chunk_ns = engine.CHUNK_NS
+
+    if devices is not None and int(devices) > 1:
+        import jax as _jax
+
+        from . import parallel
+        n = int(devices)
+        devs = _jax.devices()
+        if len(devs) < n:
+            raise ValueError(
+                f"run_ensemble: devices={n} but only {len(devs)} "
+                f"{_jax.default_backend()} device(s) visible")
+        estate, eparams = ensemble.shard_worlds(
+            estate, eparams, parallel.make_mesh(devs[:n]))
+
+    # Per-world drain sets over shared artifact files (world columns
+    # tell the rows apart; trace._open_sink ownership keeps the shared
+    # file open until the run closes it).
+    shared = []
+    drains = []
+    if data_dir is not None:
+        os.makedirs(data_dir, exist_ok=True)
+        names = (list(hostnames) if hostnames is not None else
+                 [f"host{i}" for i in
+                  range(int(worlds[0][0].hosts.num_hosts))])
+
+        def share(fname, want):
+            if not want:
+                return None
+            f = open(os.path.join(data_dir, fname), "w")
+            shared.append(f)
+            return f
+
+        log_f = share("shadow.log", worlds[0][0].log is not None)
+        ff = share("flows.jsonl", worlds[0][0].scope is not None
+                   and bool(worlds[0][0].scope.sample_flows))
+        lf = share("links.jsonl", worlds[0][0].scope is not None
+                   and bool(worlds[0][0].scope.sample_links))
+        sp = share("spans.jsonl", worlds[0][0].lineage is not None)
+        dg = share("digests.jsonl", worlds[0][0].dg is not None)
+        wn = share("windows.jsonl", worlds[0][0].fr is not None)
+        for k in range(nw):
+            from .observe import LogDrain, Tracker
+            tracker = None
+            if heartbeat_s and heartbeat_s > 0:
+                tracker = Tracker(data_dir, names,
+                                  interval_s=int(heartbeat_s),
+                                  world=k, write_header=(k == 0))
+            drains.append(Drains(
+                tracker=tracker,
+                log=(LogDrain(log_f, names, world=k)
+                     if log_f is not None else None),
+                flight=(trace.FlightDrain(wn, world=k)
+                        if wn is not None else None),
+                scope=(trace.ScopeDrain(ff, lf, real_hosts=len(names),
+                                        world=k)
+                       if (ff is not None or lf is not None) else None),
+                spans=(trace.LineageDrain(sp, world=k)
+                       if sp is not None else None),
+                digests=(trace.DigestDrain(dg, world=k)
+                         if dg is not None else None),
+            ))
+        replay_mod.write_run_json(data_dir, {
+            "n_worlds": nw,
+            "sweep": sweep,
+            "stop_ns": until,
+            "chunk_ns": int(chunk_ns),
+            "digest": (1 if digest is True else int(digest))
+            if digest else None,
+            "devices": int(devices) if devices else 1,
+        })
+
+    def drain_all(t):
+        for k, dr in enumerate(drains):
+            ws = jax.tree_util.tree_map(lambda x: x[k], estate)
+            dr.drain_all(ws, t)
+
+    wall0 = _time.monotonic()
+    t = int(jnp.min(estate.now))
+    while t < until:
+        t = min(t + int(chunk_ns), until)
+        estate = ensemble.run_until(estate, eparams, app, t)
+        drain_all(t)
+    jax.block_until_ready(estate)
+    wall = _time.monotonic() - wall0
+
+    summaries = []
+    ev = jnp.asarray(estate.n_events)
+    err = jnp.asarray(estate.err)
+    sent = jnp.sum(jnp.asarray(estate.hosts.pkts_sent), axis=1)
+    drop = (jnp.sum(jnp.asarray(estate.hosts.pkts_dropped_inet), axis=1)
+            + jnp.sum(jnp.asarray(estate.hosts.pkts_dropped_router),
+                      axis=1))
+    for k in range(nw):
+        summaries.append({
+            "world": k,
+            "events": int(ev[k]),
+            "packets_sent": int(sent[k]),
+            "drops": int(drop[k]),
+            "err_flags": int(err[k]),
+            "windows": int(jnp.asarray(estate.n_windows)[k]),
+        })
+    for dr in drains:
+        for ring in (dr.log, dr.flight, dr.scope, dr.spans, dr.digests):
+            if ring is not None:
+                ring.close()
+    for f in shared:
+        f.close()
+    if data_dir is not None:
+        import json as _json
+        with open(os.path.join(data_dir, "summary.json"), "w") as f:
+            _json.dump({"n_worlds": nw, "wall_seconds": round(wall, 3),
+                        "simulated_seconds":
+                        until / simtime.SIMTIME_ONE_SECOND,
+                        "sweep": sweep, "worlds": summaries}, f, indent=2)
+    if not quiet:
+        print(f"[shadow1-tpu] ensemble: {nw} worlds, "
+              f"{until / simtime.SIMTIME_ONE_SECOND:.3f}s simulated in "
+              f"{wall:.2f}s wall")
+    return estate, eparams, app, summaries
 
 
 def build_onion(num_circuits: int,

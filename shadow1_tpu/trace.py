@@ -596,6 +596,21 @@ def ensure_flight_recorder(state, capacity: int = 4096, shards: int = 1,
     return state.replace(fr=fr)
 
 
+def _open_sink(path_or_file, mode: str = "w"):
+    """(file, owned) from a drain's output target.
+
+    A str path opens a file the drain OWNS (close() closes it).  An
+    already-open file-like (anything with .write) is SHARED -- ensemble
+    runs hand one windows.jsonl/flows.jsonl/... to W per-world drains,
+    whose rows interleave with a "world" column telling them apart --
+    and close() leaves it open for the owner (sim.run_ensemble)."""
+    if path_or_file is None:
+        return None, False
+    if hasattr(path_or_file, "write"):
+        return path_or_file, False
+    return open(path_or_file, mode), True
+
+
 class ReplayDivergence(RuntimeError):
     """A replayed trajectory produced a flight-recorder row that differs
     bitwise from the original run's windows.jsonl record.  Raised by
@@ -653,10 +668,15 @@ class FlightDrain:
     truncating it: auto-resume (supervise.py) trims the file to rows
     below the resume checkpoint's window, then appends the re-recorded
     (bitwise-identical) rows from there, keeping one contiguous record
-    across process lifetimes."""
+    across process lifetimes.
 
-    def __init__(self, path: str | None = None, start: int = 0,
-                 verify_against: dict | None = None, mode: str = "w"):
+    `world` stamps every row with an ensemble world id (the drain-layer
+    world-column convention, docs/ensemble.md); `path` may be an
+    already-open shared file (see _open_sink)."""
+
+    def __init__(self, path=None, start: int = 0,
+                 verify_against: dict | None = None, mode: str = "w",
+                 world: int | None = None):
         self.path = path
         self.rows = []
         self.rows_lost = 0
@@ -665,7 +685,8 @@ class FlightDrain:
         self._last = int(start)
         self.verify_against = verify_against
         self.verified = 0       # rows that matched an original record
-        self._f = open(path, mode) if path else None
+        self.world = world
+        self._f, self._own = _open_sink(path, mode)
 
     def drain(self, state, profiler=None) -> int:
         """Fetch rows appended since the last drain; returns how many."""
@@ -722,6 +743,8 @@ class FlightDrain:
             for w in range(start, total):
                 k = w % c
                 row = {"window": w,
+                       **({} if self.world is None
+                          else {"world": self.world}),
                        "t_start": int(ws[k]), "t_end": int(we[k]),
                        "steps": int(steps[k]), "events": int(ev[k]),
                        "routed": int(rt[k]), "delivered": int(dl[k]),
@@ -745,7 +768,8 @@ class FlightDrain:
 
     def close(self):
         if self._f is not None:
-            self._f.close()
+            if self._own:
+                self._f.close()
             self._f = None
 
     def summary(self, state=None, n_devices: int = 1) -> dict:
@@ -930,18 +954,22 @@ class DigestDrain:
 
     Ring wrap between drains loses the oldest rows (`rows_lost`); size
     the ring or the cadence so the gap between drains stays under
-    capacity when a complete record matters (the FlightDrain caveat)."""
+    capacity when a complete record matters (the FlightDrain caveat).
 
-    def __init__(self, path: str | None = None, start: int = 0,
-                 mode: str = "w"):
+    `world` stamps every row with an ensemble world id; `path` may be
+    an already-open shared file (see _open_sink)."""
+
+    def __init__(self, path=None, start: int = 0,
+                 mode: str = "w", world: int | None = None):
         self.path = path
         self.rows = []
         self.rows_lost = 0
         self.shards = None
         self.capacity = None
         self.every = None
+        self.world = world
         self._last = int(start)
-        self._f = open(path, mode) if path else None
+        self._f, self._own = _open_sink(path, mode)
 
     def drain(self, state, profiler=None) -> int:
         """Fetch rows appended since the last drain; returns how many."""
@@ -970,7 +998,10 @@ class DigestDrain:
                 start = self._last
             for r in range(start, total):
                 k = r % c
-                row = {"window": int(win[k]), "t_end": int(t_end[k]),
+                row = {"window": int(win[k]),
+                       **({} if self.world is None
+                          else {"world": self.world}),
+                       "t_end": int(t_end[k]),
                        "sums": {g: sums[k, gi].tolist()
                                 for gi, g in enumerate(DIGEST_GROUPS)}}
                 self.rows.append(row)
@@ -983,7 +1014,8 @@ class DigestDrain:
 
     def close(self):
         if self._f is not None:
-            self._f.close()
+            if self._own:
+                self._f.close()
             self._f = None
 
     def summary(self) -> dict:
@@ -1089,12 +1121,17 @@ class ScopeDrain:
     count; padding appends hosts at the end) so a mesh/bucket-padded
     run reports the same links as the exact-size world -- the same
     contract Tracker heartbeats keep by only writing named hosts.
-    Padded hosts never open sockets, so flow rows need no filter."""
+    Padded hosts never open sockets, so flow rows need no filter.
 
-    def __init__(self, flows_path: str | None = None,
-                 links_path: str | None = None,
-                 real_hosts: int | None = None):
+    `world` stamps every row with an ensemble world id; the paths may
+    be already-open shared files (see _open_sink)."""
+
+    def __init__(self, flows_path=None,
+                 links_path=None,
+                 real_hosts: int | None = None,
+                 world: int | None = None):
         self.real_hosts = real_hosts
+        self.world = world
         self.flow_rows = []
         self.link_rows = []
         self.flow_rows_lost = 0
@@ -1105,8 +1142,8 @@ class ScopeDrain:
         self._last = {}             # ring prefix -> [shards] cursors
         self._wrap_lost = {}        # ring prefix -> rows lost to wrap
         self._prev = {}             # flow key -> (t, acked) for rate_Bps
-        self._ff = open(flows_path, "w") if flows_path else None
-        self._lf = open(links_path, "w") if links_path else None
+        self._ff, self._own_ff = _open_sink(flows_path)
+        self._lf, self._own_lf = _open_sink(links_path)
 
     def drain(self, state, profiler=None) -> int:
         """Fetch rows appended since the last drain; returns how many."""
@@ -1179,6 +1216,8 @@ class ScopeDrain:
             if prefix == "l" and self.real_hosts is not None \
                     and row["host"] >= self.real_hosts:
                 continue
+            if self.world is not None:
+                row = {"world": self.world, **row}
             rows.append(row)
             if f is not None:
                 f.write(json.dumps(row) + "\n")
@@ -1212,8 +1251,8 @@ class ScopeDrain:
                 "cap_Bps": v["cap"], "drops": v["drops"]}
 
     def close(self):
-        for f in (self._ff, self._lf):
-            if f is not None:
+        for f, own in ((self._ff, self._own_ff), (self._lf, self._own_lf)):
+            if f is not None and own:
                 f.close()
         self._ff = self._lf = None
 
@@ -1328,17 +1367,21 @@ class LineageDrain:
     `capacity` rows per drain interval and counts the rest into
     `lineage.lost`); `spans_lost` in the summary makes the gap
     visible, and lifetime counters (`n_assigned`, the drop totals the
-    drained rows carry) stay exact."""
+    drained rows carry) stay exact.
 
-    def __init__(self, spans_path: str | None = None):
+    `world` stamps every row with an ensemble world id; `spans_path`
+    may be an already-open shared file (see _open_sink)."""
+
+    def __init__(self, spans_path=None, world: int | None = None):
         self.rows = []
         self.rows_lost = 0
         self.n_assigned = 0
         self.rate = None            # learned from the block at first drain
         self.shards = None
+        self.world = world
         self._last = None           # [shards] drained-cursor array
         self._wrap_lost = 0
-        self._f = open(spans_path, "w") if spans_path else None
+        self._f, self._own = _open_sink(spans_path)
 
     def drain(self, state, profiler=None) -> int:
         """Fetch span rows appended since the last drain; returns how
@@ -1389,7 +1432,9 @@ class LineageDrain:
             order = np.argsort(arrs[0][idx], kind="stable")
             n = 0
             for k in idx[order]:
-                row = {"t": int(arrs[0][k]), "id": int(arrs[1][k]),
+                row = {**({} if self.world is None
+                          else {"world": self.world}),
+                       "t": int(arrs[0][k]), "id": int(arrs[1][k]),
                        "host": int(arrs[2][k]),
                        "stage": SPAN_STAGE_NAMES.get(
                            int(arrs[3][k]), str(int(arrs[3][k]))),
@@ -1404,7 +1449,7 @@ class LineageDrain:
             return n
 
     def close(self):
-        if self._f is not None:
+        if self._f is not None and self._own:
             self._f.close()
         self._f = None
 

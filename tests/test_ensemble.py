@@ -1,0 +1,301 @@
+"""Ensemble worlds: vmap whole simulations over a leading world axis.
+
+docs/ensemble.md promises for the ensemble subsystem
+(shadow1_tpu/ensemble, sim.run_ensemble, the drain world columns):
+
+* Bitwise solo equivalence: world k of a stacked ensemble run is leaf-
+  for-leaf bitwise identical to the same world run solo through
+  engine.run_until on the same launch grid -- across arrival batching
+  (rx_batch 1 and 2), lossy bulk TCP retransmission, and per-world
+  seeded netem churn (the tier-0 pins).
+* One compiled graph: ensemble.run_until serves every world of a
+  stacked batch from a single jit cache entry.
+* HLO identity for solo runs: using the ensemble machinery leaves the
+  solo engine's lowering byte-identical -- worlds that never stack pay
+  zero compiled ops for the subsystem's existence.
+* RNG hygiene: world 0 of a replicate() is bitwise the solo build with
+  the same seed (world_key identity at 0); worlds k>0 build from
+  independent PURPOSE_WORLD-folded keys, reproducible solo by passing
+  the folded key as the builder seed.
+* Loud refusals: stack() names the first mismatched block/static and
+  points at --bucket; checkpoint.world_manifest refuses stacked
+  states; checkpoint.load refuses ensemble-stamped files;
+  shadow1-tpu diff refuses ensemble digest records and points at
+  tools/parse.py ensemble.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shadow1_tpu import checkpoint, ensemble, sim
+from shadow1_tpu import diff as diff_mod
+from shadow1_tpu.core import engine, rng, simtime
+from shadow1_tpu.core.state import world_count
+
+SEC = simtime.SIMTIME_ONE_SECOND
+
+
+# ---------------------------------------------------------------- helpers
+
+def _mismatched_leaves(solo, world_slice):
+    """Names of leaves where a sliced-out world differs from the solo
+    run -- empty means bitwise leaf-for-leaf identical."""
+    paths = jax.tree_util.tree_flatten_with_path(solo)[0]
+    leaves = jax.tree_util.tree_leaves(world_slice)
+    assert len(paths) == len(leaves)
+    return [jax.tree_util.keystr(p)
+            for (p, a), b in zip(paths, leaves)
+            if not np.array_equal(np.asarray(a), np.asarray(b))]
+
+
+def _assert_worlds_equal_solo(worlds, horizon):
+    estate, eparams, app = ensemble.stack(worlds)
+    out = ensemble.run_until(estate, eparams, app, horizon)
+    for k, (s, p, a) in enumerate(worlds):
+        solo = engine.run_until(s, p.replace(megakernel=False), a,
+                                horizon)
+        wk = jax.tree_util.tree_map(lambda x, k=k: x[k], out)
+        bad = _mismatched_leaves(solo, wk)
+        assert not bad, f"world {k} diverged from solo at {bad[:6]}"
+
+
+def _phold(seed, rx_batch=1):
+    s, p, a = sim.build_phold(num_hosts=32, msgs_per_host=2,
+                              stop_time=3 * SEC, pool_capacity=32 * 8,
+                              seed=seed, rx_batch=rx_batch)
+    return s, p.replace(megakernel=False), a
+
+
+def _bulk(seed):
+    s, p, a = sim.build_bulk(num_hosts=8, bytes_per_client=1 << 16,
+                             reliability=0.98, stop_time=5 * SEC,
+                             seed=seed, pool_capacity=1 << 10)
+    return s, p.replace(megakernel=False), a
+
+
+def _churn(seed, n_events=128):
+    # Chaos timelines draw seed-dependent event counts; the shared
+    # n_events bucket (sim.add_churn passthrough) makes them stack.
+    s, p, a = _phold(seed)
+    s, p = sim.add_churn(s, p, 0.5, mean_down_s=1.0, n_events=n_events)
+    return s, p, a
+
+
+# ------------------------------------------- tier-0 bitwise solo pins
+
+@pytest.mark.tier0
+def test_world_bitwise_equals_solo_phold():
+    _assert_worlds_equal_solo([_phold(1), _phold(7)], 2 * SEC)
+
+
+@pytest.mark.tier0
+def test_world_bitwise_equals_solo_phold_rx_batch2():
+    _assert_worlds_equal_solo(
+        [_phold(1, rx_batch=2), _phold(7, rx_batch=2)], 2 * SEC)
+
+
+@pytest.mark.tier0
+def test_world_bitwise_equals_solo_lossy_tcp():
+    _assert_worlds_equal_solo([_bulk(3), _bulk(11)], 2 * SEC)
+
+
+@pytest.mark.tier0
+def test_world_bitwise_equals_solo_netem_churn():
+    _assert_worlds_equal_solo([_churn(4), _churn(13)], 2 * SEC)
+
+
+# ------------------------------------------------ graph + HLO identity
+
+def test_one_compiled_graph_serves_every_world():
+    worlds = [_phold(s) for s in (1, 7, 9)]
+    estate, eparams, app = ensemble.stack(worlds)
+    before = ensemble.cache_size()
+    out = ensemble.run_until(estate, eparams, app, SEC)
+    out = ensemble.run_until(out, eparams, app, 2 * SEC)
+    jax.block_until_ready(out)
+    assert ensemble.cache_size() - before <= 1
+
+
+def test_solo_hlo_identical_after_ensemble_use():
+    # The engine's solo lowering must not know the ensemble exists:
+    # byte-identical HLO before and after stacking + running a batch
+    # in the same process (run_until_impl has no world-axis branches).
+    s, p, a = _phold(5)
+    txt_before = engine.run_until.lower(s, p, a, SEC).as_text()
+    _assert_worlds_equal_solo([_phold(5), _phold(6)], SEC)
+    txt_after = engine.run_until.lower(s, p, a, SEC).as_text()
+    assert txt_before == txt_after
+
+
+def test_ensemble_chunked_matches_solo_chunked():
+    # Chunk boundaries repartition windows, so chunked and un-chunked
+    # runs legitimately differ; the contract is grid-for-grid: the
+    # ensemble on a chunk grid equals each world run solo on the SAME
+    # grid.
+    worlds = [_phold(2), _phold(8)]
+    estate, eparams, app = ensemble.stack(worlds)
+    out = ensemble.run_chunked(estate, eparams, app, 2 * SEC,
+                               chunk_ns=SEC)
+    for k, (s, p, a) in enumerate(worlds):
+        solo = engine.run_chunked(s, p.replace(megakernel=False), a,
+                                  2 * SEC, chunk_ns=SEC)
+        wk = jax.tree_util.tree_map(lambda x, k=k: x[k], out)
+        bad = _mismatched_leaves(solo, wk)
+        assert not bad, f"world {k} diverged from solo-chunked: {bad[:6]}"
+
+
+# ------------------------------------------------------- RNG hygiene
+
+def test_world_key_identity_at_zero():
+    key = rng.root_key(5)
+    assert np.array_equal(np.asarray(rng.world_key(key, 0)),
+                          np.asarray(key))
+
+
+def test_world_key_folds_are_distinct_and_deterministic():
+    key = rng.root_key(5)
+    k1, k2 = rng.world_key(key, 1), rng.world_key(key, 2)
+    assert not np.array_equal(np.asarray(k1), np.asarray(key))
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+    assert np.array_equal(np.asarray(k1),
+                          np.asarray(rng.world_key(key, 1)))
+
+
+def test_replicate_world_is_solo_build_with_folded_seed():
+    kw = dict(num_hosts=16, msgs_per_host=2, stop_time=SEC,
+              pool_capacity=16 * 8)
+    worlds = ensemble.replicate(sim.build_phold, 2, seed=5, **kw)
+    # World 0: bitwise the plain seed-5 build (identity fold).
+    s0, p0, _ = sim.build_phold(seed=5, **kw)
+    assert not _mismatched_leaves((s0, p0), (worlds[0][0], worlds[0][1]))
+    # World 1: bitwise the solo build seeded with the folded key -- the
+    # recipe for reproducing any ensemble member as a solo run.
+    k1 = rng.world_key(rng.root_key(5), 1)
+    s1, p1, _ = sim.build_phold(seed=k1, **kw)
+    assert not _mismatched_leaves((s1, p1), (worlds[1][0], worlds[1][1]))
+
+
+# ------------------------------------------------------ loud refusals
+
+def test_stack_refuses_shape_mismatch_naming_world_and_bucket():
+    a = sim.build_phold(num_hosts=16, stop_time=SEC,
+                        pool_capacity=16 * 8)
+    b = sim.build_phold(num_hosts=32, stop_time=SEC,
+                        pool_capacity=32 * 8)
+    with pytest.raises(ensemble.EnsembleMismatch) as ei:
+        ensemble.stack([a, b])
+    msg = str(ei.value)
+    assert "world 1" in msg
+    assert "--bucket" in msg
+
+
+def test_stack_refuses_app_mismatch():
+    with pytest.raises(ensemble.EnsembleMismatch):
+        ensemble.stack([_phold(1), _bulk(1)])
+
+
+def test_world_count_probe():
+    s, p, a = _phold(1)
+    assert world_count(s) is None
+    estate, _, _ = ensemble.stack([_phold(1), _phold(2), _phold(3)])
+    assert world_count(estate) == 3
+
+
+def test_checkpoint_refuses_stacked_state():
+    estate, eparams, _ = ensemble.stack([_phold(1), _phold(2)])
+    with pytest.raises(ValueError, match="ensemble"):
+        checkpoint.world_manifest(estate, eparams)
+
+
+def test_checkpoint_load_refuses_ensemble_stamp(tmp_path):
+    s, p, _ = _phold(1)
+    path = str(tmp_path / "w.npz")
+    checkpoint.save(path, s, p, manifest={"n_worlds": 2, "world": 1})
+    with pytest.raises(ValueError, match="--worlds 2"):
+        checkpoint.load(path, s, p)
+
+
+def test_shard_worlds_requires_divisibility():
+    from shadow1_tpu import parallel
+    estate, eparams, _ = ensemble.stack(
+        [_phold(1), _phold(2), _phold(3)])
+    mesh = parallel.make_mesh(jax.devices()[:2])
+    with pytest.raises(ValueError, match="divide"):
+        ensemble.shard_worlds(estate, eparams, mesh)
+
+
+# ------------------------------------------------- run_ensemble + CLI
+
+def test_run_ensemble_artifacts_and_diff_refusal(tmp_path):
+    data = str(tmp_path / "run")
+    worlds = [_phold(1), _phold(7)]
+    estate, eparams, app, summaries = sim.run_ensemble(
+        worlds, until=SEC, data_dir=data, digest=2, heartbeat_s=1)
+    assert [s["world"] for s in summaries] == [0, 1]
+    assert all(s["events"] > 0 for s in summaries)
+
+    info = json.load(open(os.path.join(data, "ckpt", "run.json")))
+    assert info["n_worlds"] == 2
+
+    with open(os.path.join(data, "heartbeat.csv")) as f:
+        header = f.readline()
+        assert header.startswith("world,")
+        seen = {line.split(",", 1)[0] for line in f if line.strip()}
+    assert seen == {"0", "1"}
+
+    with open(os.path.join(data, "digests.jsonl")) as f:
+        dworlds = {json.loads(line)["world"] for line in f
+                   if line.strip()}
+    assert dworlds == {0, 1}
+
+    summary = json.load(open(os.path.join(data, "summary.json")))
+    assert summary["n_worlds"] == 2
+    assert len(summary["worlds"]) == 2
+
+    # Statescope diff refuses ensemble records by name and points at
+    # the ensemble-aware reader instead of mis-joining world streams.
+    with pytest.raises(ValueError, match="parse.py ensemble"):
+        diff_mod.diff_runs(data, data)
+
+
+def test_cli_sweep_overrides():
+    import argparse
+
+    from shadow1_tpu import cli
+
+    ns = argparse.Namespace(sweep=None, worlds=3, seed=5)
+    overrides, spec = cli._sweep_overrides(ns)
+    assert overrides == [{"seed": 5}, {"seed": 6}, {"seed": 7}]
+    assert spec is None
+
+
+def test_cli_sweep_spec_refusals(tmp_path):
+    import argparse
+
+    from shadow1_tpu import cli
+
+    def run(spec_obj, worlds=1):
+        path = tmp_path / "sweep.json"
+        path.write_text(json.dumps(spec_obj))
+        ns = argparse.Namespace(sweep=str(path), worlds=worlds, seed=1)
+        return cli._sweep_overrides(ns)
+
+    overrides, spec = run({"seeds": [4, 9]})
+    assert overrides == [{"seed": 4}, {"seed": 9}]
+    assert spec == {"seeds": [4, 9]}
+
+    overrides, _ = run({"worlds": [{"seed": 2, "churn": 0.5}, {}]})
+    assert overrides[0] == {"seed": 2, "churn": 0.5}
+    assert overrides[1] == {"seed": 2}  # base seed 1 + world index 1
+
+    with pytest.raises(cli.CliError, match="non-empty list of integers"):
+        run({"seeds": [1, "x"]})
+    with pytest.raises(cli.CliError, match="only"):
+        run({"worlds": [{"seed": 1, "pool_slab": 9}]})
+    with pytest.raises(cli.CliError, match="--worlds 3"):
+        run({"seeds": [1, 2]}, worlds=3)

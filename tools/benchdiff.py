@@ -302,6 +302,16 @@ def _n_devices(d: dict) -> int:
     return 1 if n is None else int(n)
 
 
+def _n_worlds(d: dict) -> int:
+    """Ensemble world count of the recorded run.  Files written before
+    bench.py grew --worlds were all solo measurements, so a missing
+    env.n_worlds normalizes to 1 (legacy BENCH_r{N} baselines stay
+    gateable against today's solo runs)."""
+    env = d.get("env")
+    n = env.get("n_worlds") if isinstance(env, dict) else None
+    return 1 if n is None else int(n)
+
+
 def _env(d: dict):
     """The recorded execution environment (backend, cpu_count,
     n_devices), or None for files written before bench.py stamped one."""
@@ -526,6 +536,16 @@ def main(argv=None) -> int:
               f"counts (old n_devices={do}, new n_devices={dn}); "
               f"events_per_sec gates within the same --devices bucket",
               file=sys.stderr)
+        return 2
+    wo_n, wn_n = _n_worlds(old), _n_worlds(new)
+    if wo_n != wn_n:
+        # Same rule for the world axis: an 8-world vmapped batch and a
+        # solo run execute different programs over different totals --
+        # comparing their throughput measures batching, not regression.
+        print(f"benchdiff: refusing to compare runs across ensemble "
+              f"world counts (old n_worlds={wo_n}, new "
+              f"n_worlds={wn_n}); events_per_sec gates within the "
+              f"same --worlds bucket", file=sys.stderr)
         return 2
     eo, en = _env(old), _env(new)
     # Both-absent compares (hand-written JSONs, pre-env recordings on

@@ -16,6 +16,10 @@ excluded; steady-state wall time per simulated second reported):
   rung 9: shape-bucket compile sharing    (three differently-sized phold
           worlds through shapes.pad_world_to_bucket; FAILS if run_until
           compiles more than one graph for the sweep -- docs/shapes.md)
+  rung 10: ensemble world-axis batching   (8 phold worlds vmapped over a
+          leading world axis through ensemble.run_until; FAILS if the
+          ensemble compiles more than one graph or its wall time is not
+          well under 8 sequential solo runs -- docs/ensemble.md)
 
     python tools/ladder.py [rung ...]     # default: 1 2 3 5 6
 """
@@ -214,8 +218,82 @@ def rung_buckets(sizes=(40, 48, 56), slab: int = 8, span_s: int = 2):
     }
 
 
+def rung_ensemble(n_worlds: int = 8, num_hosts: int = 1024,
+                  span_s: int = 1):
+    """N phold worlds as ONE vmapped batch (shadow1_tpu/ensemble) vs
+    the same N worlds run solo back to back.  Asserts (a) the whole
+    ensemble costs at most ONE ensemble.run_until graph beyond warmup
+    and (b) the batched wall time beats N sequential solo runs -- the
+    two properties the world axis exists to provide (docs/ensemble.md).
+    The wall gate applies on accelerator backends only: a TPU/GPU fills
+    its idle lanes with the world axis, but XLA CPU executes the batch
+    as wider serial vector work, so ensemble-vs-sequential wall there
+    measures vectorization overhead, not batching (the same reason
+    rung 8 asserts bitwise equality on CPU and leaves its rate
+    informational).  The graph-count gate applies everywhere."""
+    from shadow1_tpu import ensemble
+
+    # Slab 16: per-world seeds explore different burst shapes, and the
+    # deepest of 8 trajectories must still fit the shared pool (world 2
+    # of the default seed overflows a x8 slab).
+    kw = dict(num_hosts=num_hosts, pool_capacity=num_hosts * 16,
+              msgs_per_host=4, rx_batch=2,
+              stop_time=(span_s + 1) * SEC)
+    worlds = ensemble.replicate(sim.build_phold, n_worlds, seed=1, **kw)
+    estate, eparams, app = ensemble.stack(worlds)
+
+    # Warm both paths (compile excluded from the measured spans).
+    warm_e = ensemble.run_until(estate, eparams, app, SEC // 100)
+    s0, p0, a0 = worlds[0]
+    # stack() pins megakernel off; the solo comparator must run the
+    # same graph flavor or the wall ratio measures the kernel, not the
+    # world axis.
+    p0 = p0.replace(megakernel=False)
+    warm_s = engine.run_until(s0, p0, a0, SEC // 100)
+    jax.block_until_ready((warm_e, warm_s))
+
+    graphs0 = ensemble.cache_size()
+    t0 = time.perf_counter()
+    out_e = ensemble.run_until(warm_e, eparams, app, span_s * SEC)
+    jax.block_until_ready(out_e)
+    wall_ens = time.perf_counter() - t0
+    graphs = ensemble.cache_size() - graphs0
+    assert graphs <= 1, (
+        f"ensemble sweep compiled {graphs} extra run_until graph(s): "
+        f"one graph must serve every world")
+
+    t0 = time.perf_counter()
+    outs = []
+    for s, p, a in worlds:
+        outs.append(engine.run_until(
+            s, p.replace(megakernel=False), a, span_s * SEC))
+    jax.block_until_ready(outs)
+    wall_solo = time.perf_counter() - t0
+
+    for k in range(n_worlds):
+        assert int(out_e.err[k]) == 0, \
+            f"world {k} err flags {int(out_e.err[k])}"
+    if jax.default_backend() != "cpu":
+        assert wall_ens < wall_solo, (
+            f"{n_worlds}-world ensemble took {wall_ens:.2f}s vs "
+            f"{wall_solo:.2f}s for {n_worlds} sequential solo runs: "
+            f"the world axis is not batching")
+    return {
+        "backend": jax.default_backend(),
+        "wall_gated": jax.default_backend() != "cpu",
+        "n_worlds": n_worlds,
+        "num_hosts": num_hosts,
+        "run_until_graphs": graphs,
+        "wall_ensemble_s": round(wall_ens, 3),
+        "wall_solo_sequential_s": round(wall_solo, 3),
+        "speedup_vs_sequential": round(wall_solo / wall_ens, 2),
+        "events": [int(out_e.n_events[k]) for k in range(n_worlds)],
+    }
+
+
 def main(rungs):
-    unknown = set(rungs) - {"1", "2", "3", "4", "5", "6", "7", "8", "9"}
+    unknown = set(rungs) - {"1", "2", "3", "4", "5", "6", "7", "8", "9",
+                            "10"}
     if unknown:
         raise SystemExit(f"unknown ladder rungs: {sorted(unknown)}")
     results = {"backend": jax.default_backend()}
@@ -252,6 +330,8 @@ def main(rungs):
         record("phold_multichip", rung_multichip)
     if "9" in rungs:
         record("phold_buckets", rung_buckets)
+    if "10" in rungs:
+        record("phold_ensemble", rung_ensemble)
     print(json.dumps(results))
 
 

@@ -287,6 +287,70 @@ def parse_digests(path: str, top: int = 10) -> dict | None:
     }
 
 
+def parse_ensemble(data_dir: str) -> dict | None:
+    """Digest an ensemble run's data directory (`run --worlds N`,
+    docs/ensemble.md) into a per-world summary table: events, packets,
+    drops, err flags per world (summary.json), and -- when the run
+    recorded statescope digests -- each world's FIRST divergence from
+    world 0 (the window and field group where its digest stream first
+    differs), reusing the digests.jsonl world-column convention.
+    Returns None when the directory holds no ensemble summary.json."""
+    sp = os.path.join(data_dir, "summary.json")
+    try:
+        with open(sp) as f:
+            summary = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if "worlds" not in summary or "n_worlds" not in summary:
+        return None
+
+    # First divergence per world: compare each world's digest stream
+    # against world 0's, window-aligned (same cadence by construction:
+    # one vmapped graph records every world's digest at the same
+    # windows).
+    div = {}
+    rows = _load_jsonl(os.path.join(data_dir, "digests.jsonl"))
+    if rows:
+        by_world: dict = {}
+        for r in rows:
+            by_world.setdefault(r.get("world", 0), {})[r["window"]] = \
+                r["sums"]
+        base = by_world.get(0, {})
+        for w, wins in sorted(by_world.items()):
+            if w == 0:
+                continue
+            first = None
+            for win in sorted(base):
+                if win not in wins:
+                    continue
+                bad = [g for g in base[win]
+                       if wins[win].get(g) != base[win][g]]
+                if bad:
+                    first = {"window": win, "groups": sorted(bad)}
+                    break
+            div[w] = first
+
+    worlds = []
+    for s in summary["worlds"]:
+        k = s["world"]
+        row = dict(s)
+        if rows:
+            row["first_divergence_from_world_0"] = (
+                None if k == 0 else div.get(k))
+        worlds.append(row)
+    out = {
+        "n_worlds": summary["n_worlds"],
+        "wall_seconds": summary.get("wall_seconds"),
+        "simulated_seconds": summary.get("simulated_seconds"),
+        "sweep": summary.get("sweep"),
+        "worlds": worlds,
+    }
+    if not rows:
+        out["note"] = ("no digests.jsonl: first-divergence columns "
+                       "need a --digest-every run")
+    return out
+
+
 def parse_schedule(path: str, top: int = 10) -> dict | None:
     """Digest server/schedule.jsonl (server.py Servescope format) into
     per-request lifecycles and fleet aggregates.  Each request's rows
@@ -486,6 +550,25 @@ def main(argv=None) -> int:
         if digest is None:
             print(f"error: {args.path}: no digests.jsonl record "
                   f"(re-run with --digest-every)", file=sys.stderr)
+            return 2
+        text = json.dumps(digest, indent=2, sort_keys=True)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(text + "\n")
+        print(text)
+        return 0
+    if argv and argv[0] == "ensemble":
+        ap = argparse.ArgumentParser(prog="parse.py ensemble")
+        ap.add_argument("data_dir", help="an ensemble run's "
+                                         "--data-directory")
+        ap.add_argument("--json", default=None,
+                        help="also write to this file")
+        args = ap.parse_args(argv[1:])
+        digest = parse_ensemble(args.data_dir)
+        if digest is None:
+            print(f"error: {args.data_dir}: no ensemble summary.json "
+                  f"(written by `run --worlds N` / --sweep)",
+                  file=sys.stderr)
             return 2
         text = json.dumps(digest, indent=2, sort_keys=True)
         if args.json:

@@ -36,6 +36,13 @@ when spans.jsonl is absent:
 When the run recorded statescope digests (`--digest-every N`,
 trace.DigestDrain format) one more panel appears, skipped silently
 when digests.jsonl is absent:
+When the directory came from an ensemble run (`run --worlds N`,
+docs/ensemble.md; summary.json carries n_worlds + per-world rows) one
+more panel appears, skipped silently for solo runs:
+  ensemble.png     -- per-world events/drops bars plus, per world,
+                      the window where its digest stream first
+                      diverged from world 0 (needs --digest-every)
+
   digests.png      -- change-activity raster: one row per state
                       field-group, one cell per recorded window,
                       filled where that window changed the group's
@@ -113,6 +120,48 @@ def load_digests(data_dir: str):
     return _load_jsonl(os.path.join(data_dir, "digests.jsonl"))
 
 
+def load_ensemble(data_dir: str):
+    """Per-world summary rows from an ensemble run's summary.json
+    (sim.run_ensemble format), or None for solo runs."""
+    path = os.path.join(data_dir, "summary.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            sj = json.load(f)
+    except ValueError:
+        return None
+    if not isinstance(sj, dict) or not sj.get("n_worlds") \
+            or not sj.get("worlds"):
+        return None
+    return sj["worlds"]
+
+
+def _first_divergences(drows):
+    """Map world -> {window, groups} where each world's digest stream
+    first differs from world 0's (window-aligned; the vmapped graph
+    records every world at the same windows).  Empty without digests."""
+    out: dict = {}
+    if not drows:
+        return out
+    by_world: dict = {}
+    for r in drows:
+        by_world.setdefault(r.get("world", 0), {})[r["window"]] = \
+            r["sums"]
+    base = by_world.get(0, {})
+    for w, wins in by_world.items():
+        if w == 0:
+            continue
+        for win in sorted(base):
+            if win not in wins:
+                continue
+            bad = [g for g in base[win] if wins[win].get(g) != base[win][g]]
+            if bad:
+                out[w] = {"window": win, "groups": sorted(bad)}
+                break
+    return out
+
+
 def load_schedule(data_dir: str):
     """Scheduler span rows from server/schedule.jsonl (server.py
     Servescope format), or None when the directory is not a serve
@@ -153,7 +202,10 @@ def aggregate(rows):
     series = {k: [0.0] * n for k in RATE_COLS + DELTA_COLS}
     per_host = defaultdict(list)
     for r in rows:
-        per_host[r["host"]].append(r)
+        # Ensemble runs prefix a world column (docs/ensemble.md): hold
+        # each (world, host) series separately so worlds don't splice
+        # into one bogus step function; the charts aggregate over all.
+        per_host[(r.get("world", ""), r["host"])].append(r)
     for host_rows in per_host.values():
         host_rows.sort(key=lambda r: float(r["time_s"]))
         for k in RATE_COLS:
@@ -501,6 +553,46 @@ def main(data_dir: str, out_dir: str | None = None) -> list:
             f.savefig(p, dpi=110, bbox_inches="tight")
             plt.close(f)
             written.append(p)
+
+    erows = load_ensemble(data_dir)
+    if erows:
+        # Ensemble panel (docs/ensemble.md): one bar pair per world --
+        # events delivered and packets dropped -- with each world k>0
+        # annotated with the window where its digest stream first
+        # diverged from world 0 (the per-world seeds guarantee they DO
+        # diverge; the panel shows how soon).  Worlds that raised err
+        # flags draw red.
+        ks = [s["world"] for s in erows]
+        events = [s.get("events", 0) for s in erows]
+        drops = [s.get("drops", 0) for s in erows]
+        colors = ["tab:red" if s.get("err_flags") else "tab:blue"
+                  for s in erows]
+        div = _first_divergences(load_digests(data_dir))
+        f, (ax, axd) = plt.subplots(
+            2, 1, figsize=(max(6, 0.8 * len(ks) + 3), 6), sharex=True)
+        ax.bar([k - 0.2 for k in ks], events, width=0.4,
+               color=colors, label="events")
+        ax.bar([k + 0.2 for k in ks], drops, width=0.4,
+               color="tab:orange", label="drops")
+        ax.set_yscale("symlog")
+        ax.set_ylabel("count")
+        ax.legend(fontsize=8)
+        ax.set_title(f"Ensemble: {len(ks)} worlds, one compiled graph")
+        for k in ks:
+            if k == 0 or k not in div:
+                continue
+            axd.bar(k, div[k]["window"], width=0.4, color="tab:green")
+            axd.annotate(",".join(div[k]["groups"]),
+                         (k, div[k]["window"]), fontsize=6,
+                         ha="center", xytext=(0, 3),
+                         textcoords="offset points")
+        axd.set_ylabel("first divergence\nfrom world 0 (window)")
+        axd.set_xlabel("world")
+        axd.set_xticks(ks)
+        p = os.path.join(out_dir, "ensemble.png")
+        f.savefig(p, dpi=110, bbox_inches="tight")
+        plt.close(f)
+        written.append(p)
 
     for p in written:
         print(p)

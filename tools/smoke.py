@@ -24,6 +24,11 @@ pin per subsystem:
                                        the solo run's rc and event
                                        count (observability is
                                        host-side only)
+  - ensemble     test_ensemble.py      world k of a vmapped ensemble
+                                       vs the same world run solo:
+                                       bitwise leaf-for-leaf (phold
+                                       rx_batch 1/2, lossy bulk TCP,
+                                       per-world netem churn)
 
 Together they run in well under five minutes on the virtual 8-device
 CPU mesh, giving a fast did-I-break-determinism signal before paying
