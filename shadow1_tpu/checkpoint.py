@@ -19,6 +19,18 @@ hosts_real) so replay can restore onto the same mesh or gather down to
 a single device (replay.py, docs/observability.md "Time-travel
 replay").
 
+Format version 2 extends the manifest to STACKED ensemble states
+(docs/ensemble.md): every leaf carries its leading [n_worlds] axis in
+the file, the ShapeKey fingerprint comes from a world-0 slice (every
+member shares one key -- ensemble.stack refused otherwise), and the
+manifest stamps `n_worlds`, the per-world window counters (`windows`),
+per-world clocks (`t_ns_worlds`), and any quarantine-frozen world
+indices (`frozen`).  `load` refuses only MISMATCHED world counts --
+naming both values and the `--worlds N` that matches -- and can slice
+one member out solo (`world=K`), which is what `replay --world K`
+restores bitwise (the per-world dual-seeding discipline in
+docs/ensemble.md makes the solo rerun well-defined).
+
 Loading requires a *template* (state, params) pair built the same way
 as the saved run (same config, shapes, apps); the template supplies the
 pytree structure, the file supplies every value.  On a mismatch the
@@ -38,10 +50,17 @@ import numpy as np
 import jax
 
 FORMAT_VERSION = 1
+STACKED_FORMAT_VERSION = 2
 
 
 def _fingerprint(tree) -> str:
     return str(jax.tree_util.tree_structure(tree))
+
+
+def _world0(tree):
+    """World-0 slice of a stacked tree (shape/static probes need a solo
+    view; every world shares one ShapeKey by ensemble.stack's refusal)."""
+    return jax.tree_util.tree_map(lambda x: x[0], tree)
 
 
 def world_manifest(state, params, **extra) -> dict:
@@ -50,35 +69,53 @@ def world_manifest(state, params, **extra) -> dict:
     (global window index + sim time), and any caller extras (shard
     layout, padding, run identity).
 
-    Always stamps `n_worlds` (1 for a solo run; ensemble callers
-    override via extras) so replay/diff refuse loudly instead of
-    silently mixing world axes.  A STACKED ensemble state is refused
-    outright: checkpoints are per-world -- slice one out first
-    (ensemble.world)."""
+    Always stamps `n_worlds` (1 for a solo run) so replay/diff refuse
+    loudly instead of silently mixing world axes.  A STACKED ensemble
+    state stamps format 2: the ShapeKey comes from a world-0 slice,
+    `window`/`t_ns` summarize the stack (max window for the anchor
+    filename; min clock -- the shared launch boundary of the ACTIVE
+    worlds, since quarantined worlds park their clock at
+    ensemble.FROZEN_NOW), and the per-world `windows` / `t_ns_worlds` /
+    `frozen` tables let resume trim and `replay --world K` address each
+    member on its own counters (docs/robustness.md "Ensemble
+    resilience")."""
     from . import shapes
     from .core.state import world_count
     w = world_count(state)
-    if w is not None:
-        raise ValueError(
-            f"cannot checkpoint a stacked {w}-world ensemble state: "
-            f"checkpoints are per-world -- slice a world out first "
-            f"(ensemble.world(estate, eparams, k)) and stamp "
-            f"n_worlds/world manifest extras")
-    m = {
-        "format": FORMAT_VERSION,
-        "shape": shapes.key_manifest(shapes.shape_key(state, params)),
-        "window": int(state.n_windows),
-        "t_ns": int(state.now),
-        "n_worlds": 1,
-    }
-    if getattr(state, "dg", None) is not None:
+    if w is None:
+        probe_s, probe_p = state, params
+        m = {
+            "format": FORMAT_VERSION,
+            "window": int(state.n_windows),
+            "t_ns": int(state.now),
+            "n_worlds": 1,
+        }
+    else:
+        from .ensemble import FROZEN_NOW
+        probe_s, probe_p = _world0(state), _world0(params)
+        wins = [int(x) for x in
+                np.asarray(jax.device_get(state.n_windows)).ravel()]
+        nows = [int(x) for x in
+                np.asarray(jax.device_get(state.now)).ravel()]
+        active = [t for t in nows if t < FROZEN_NOW]
+        m = {
+            "format": STACKED_FORMAT_VERSION,
+            "window": max(wins),
+            "windows": wins,
+            "t_ns": min(active) if active else min(nows),
+            "t_ns_worlds": nows,
+            "frozen": [k for k, t in enumerate(nows) if t >= FROZEN_NOW],
+            "n_worlds": int(w),
+        }
+    m["shape"] = shapes.key_manifest(shapes.shape_key(probe_s, probe_p))
+    if getattr(probe_s, "dg", None) is not None:
         # Statescope stamp: `shadow1-tpu diff` refuses to compare runs
         # whose digest cadence or field-group schema differ, by name
         # (shadow1_tpu/diff.py), instead of mis-aligning streams.
         from .core.state import DIGEST_SCHEMA
-        m["digest"] = {"every": int(state.dg.every),
+        m["digest"] = {"every": int(probe_s.dg.every),
                        "schema": DIGEST_SCHEMA,
-                       "shards": int(state.dg.n_shards)}
+                       "shards": int(probe_s.dg.n_shards)}
     m.update(extra)
     return m
 
@@ -134,7 +171,11 @@ def _mismatch_detail(z, template_state, template_params) -> str:
     if "_manifest" not in z.files:
         return "different config, app, or version"
     from . import shapes
+    from .core.state import world_count
     saved = json.loads(str(z["_manifest"]))
+    if world_count(template_state) is not None:
+        template_state = _world0(template_state)
+        template_params = _world0(template_params)
     cur = shapes.key_manifest(
         shapes.shape_key(template_state, template_params))
     detail = shapes.describe_key_mismatch(saved.get("shape", {}), cur)
@@ -146,36 +187,85 @@ def _mismatch_detail(z, template_state, template_params) -> str:
     return detail
 
 
-def load(path: str, template_state, template_params):
+def _check_worlds(saved, template_worlds, world, path):
+    """The world-axis gate: refuse MISMATCHED world counts by name
+    (both values, plus the `--worlds N` that matches), and validate a
+    `world=K` slice request.  Legacy files without the stamp are solo
+    by construction (missing means 1)."""
+    saved_worlds = int((saved or {}).get("n_worlds", 1))
+    tw = 1 if template_worlds is None else int(template_worlds)
+    if world is not None:
+        k = int(world)
+        if saved_worlds == 1:
+            raise ValueError(
+                f"{path}: world={k} requested but the checkpoint is a "
+                f"solo snapshot (n_worlds 1); world slicing only "
+                f"applies to stacked ensemble checkpoints")
+        if template_worlds is not None:
+            raise ValueError(
+                f"{path}: world={k} restores ONE member solo; pass a "
+                f"solo template, not a {tw}-world stacked one")
+        if not 0 <= k < saved_worlds:
+            raise ValueError(
+                f"{path}: world={k} is out of range; the checkpoint "
+                f"holds worlds 0..{saved_worlds - 1}")
+        return saved_worlds
+    if saved_worlds != tw:
+        if template_worlds is None:
+            raise ValueError(
+                f"checkpoint was saved by a {saved_worlds}-world "
+                f"ensemble run: loading it into a solo run would "
+                f"silently mix world axes; re-run the ensemble "
+                f"(--worlds {saved_worlds}), or slice one member out "
+                f"(checkpoint.load(..., world=K) / replay --world K)")
+        raise ValueError(
+            f"checkpoint world count mismatch: the file holds "
+            f"n_worlds {saved_worlds} but the template is a "
+            f"{tw}-world stack; re-run with --worlds {saved_worlds} "
+            f"to match the saved ensemble")
+    return saved_worlds
+
+
+def load(path: str, template_state, template_params, world=None):
     """Rebuild (state, params) from `path` using the templates' structure.
 
     Every leaf value comes from the file; shapes and dtypes must match the
     template (same config/apps), which is also verified structurally and
     -- for manifest-stamped files -- against the template's ShapeKey, so
     the error names the differing block or static.
+
+    Stacked checkpoints (format 2) load into an equally-stacked template
+    -- mismatched world counts are refused naming both values -- or,
+    with `world=K`, slice member K off every leaf's leading axis into a
+    SOLO template: the restored world is bitwise the slice
+    `ensemble.world(estate, eparams, K)` of the saved stack, which is
+    what `replay --world K` anchors on.
     """
+    from .core.state import world_count
+    template_worlds = world_count(template_state)
     with np.load(path, allow_pickle=False) as z:
         # Manifest check first: a same-structure world with different
         # shapes (more hosts, a wider slab) would otherwise surface as a
         # bare "leaf s8" error; the ShapeKey comparison names the block
-        # or static instead.
+        # or static instead.  The world-axis gate runs before any shape
+        # comparison so axis mixing is named as such.
+        saved = None
         if "_manifest" in z.files:
-            from . import shapes
             saved = json.loads(str(z["_manifest"]))
-            # World-axis refusal before any shape comparison: a
-            # checkpoint stamped by an ensemble run must not silently
-            # load into a solo template (legacy files without the stamp
-            # are solo by construction -- missing means 1).
-            saved_worlds = int(saved.get("n_worlds", 1))
-            if saved_worlds != 1:
-                raise ValueError(
-                    f"checkpoint was saved by a {saved_worlds}-world "
-                    f"ensemble run (world {saved.get('world', '?')}): "
-                    f"loading it into a solo run would silently mix "
-                    f"world axes; re-run the ensemble "
-                    f"(--worlds {saved_worlds}) instead")
-            cur = shapes.key_manifest(
-                shapes.shape_key(template_state, template_params))
+        _check_worlds(saved, template_worlds, world, path)
+        if world is not None:
+            # ensemble.stack forces megakernel off on every member
+            # (Pallas has no vmap batching rule), so the stacked file
+            # was saved with it off.  Statics ride the template treedef,
+            # not the file: normalize so the restored solo member runs
+            # the same reference path the ensemble ran (bitwise replay).
+            template_params = template_params.replace(megakernel=False)
+        if saved is not None:
+            from . import shapes
+            probe_s, probe_p = template_state, template_params
+            if template_worlds is not None:
+                probe_s, probe_p = _world0(probe_s), _world0(probe_p)
+            cur = shapes.key_manifest(shapes.shape_key(probe_s, probe_p))
             detail = shapes.describe_key_mismatch(
                 saved.get("shape", {}), cur)
             if detail is not None:
@@ -192,6 +282,10 @@ def load(path: str, template_state, template_params):
             vals = []
             for i, leaf in enumerate(leaves):
                 v = z[f"{prefix}{i}"]
+                if world is not None:
+                    # Slice member K off the leading world axis; the
+                    # remaining dims must match the solo template.
+                    v = v[int(world)]
                 want = jax.numpy.asarray(leaf)
                 if v.shape != want.shape or v.dtype != want.dtype:
                     hint = ""

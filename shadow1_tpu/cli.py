@@ -184,9 +184,13 @@ def _add_run_flags(r, *, config_required: bool = True):
                         "one summary per world.  Composes with "
                         "--devices: worlds are placed world-major over "
                         "the device mesh (N must divide the world "
-                        "count).  Unsupported combos (--pcap, "
-                        "checkpointing, real-process plugins, serve) "
-                        "are refused by name")
+                        "count).  Composes with --checkpoint-every / "
+                        "--auto-resume / --watchdog (stacked anchors, "
+                        "per-world quarantine -- docs/robustness.md "
+                        "\"Ensemble resilience\") and with serve/"
+                        "submit.  Unsupported combos (--pcap, "
+                        "--profile, real-process plugins) are refused "
+                        "by name")
     r.add_argument("--sweep", metavar="SWEEP.json", default=None,
                    help="ensemble sweep spec: JSON object, either "
                         "{\"seeds\": [1, 2, ...]} (one world per seed) "
@@ -314,6 +318,14 @@ def _parser():
     tgt.add_argument("--time", type=float, default=None, metavar="T",
                      help="target sim time in seconds: replays through "
                           "the window containing T")
+    rp.add_argument("--world", type=int, default=None, metavar="K",
+                    help="for a --worlds/--sweep run's stacked "
+                         "checkpoints: restore world K solo off the "
+                         "stacked anchor and replay just that member, "
+                         "verified bitwise against its own "
+                         "windows.jsonl rows (required for ensemble "
+                         "runs, refused for solo runs -- both by "
+                         "name); the member runs on one device")
     rp.add_argument("--out", default=None,
                     help="where replay outputs land (default: "
                          "DATA_DIR/replay)")
@@ -884,14 +896,7 @@ def _run_ensemble_config(args, *, control=None, emit=None,
         nw = len(overrides)
         if getattr(args, "worlds", 1) < 1:
             raise CliError("--worlds must be >= 1")
-        if control is not None or emit is not None:
-            raise CliError(
-                "--worlds/--sweep under serve/submit is unsupported: "
-                "the run server's park/resume and crash recovery are "
-                "checkpoint-anchored and checkpoints are per-world; "
-                "submit each world as its own request (--seed <world "
-                "seed>)")
-        if args.profile or profiler is not None:
+        if args.profile:
             raise CliError(
                 "--profile is unsupported with --worlds/--sweep: the "
                 "profiler's phase spans and counter files are per-run "
@@ -904,16 +909,24 @@ def _run_ensemble_config(args, *, control=None, emit=None,
                 "so packets from different worlds would interleave "
                 "into one capture; capture one world solo (--seed "
                 "<that world's seed>)")
-        if getattr(args, "checkpoint_every", None) or \
-                getattr(args, "auto_resume", False) or \
-                getattr(args, "watchdog", None):
+        # Checkpointed / supervised ensembles save STACKED anchors
+        # (checkpoint format 2, docs/robustness.md "Ensemble
+        # resilience"); the flag contract matches the solo path.
+        ck_every_ns = None
+        if getattr(args, "checkpoint_every", None):
+            if args.checkpoint_every <= 0:
+                raise CliError("--checkpoint-every must be positive")
+            if not args.data_directory:
+                raise CliError(
+                    "--checkpoint-every requires --data-directory")
+            ck_every_ns = int(args.checkpoint_every * SEC)
+        supervise_on = bool(getattr(args, "auto_resume", False))
+        if supervise_on and not ck_every_ns:
             raise CliError(
-                "--checkpoint-every/--auto-resume/--watchdog are "
-                "unsupported with --worlds/--sweep: checkpoints are "
-                "per-world (checkpoint.world_manifest refuses stacked "
-                "states), so supervision has no recovery anchor; "
-                "checkpoint one world solo, or re-run the ensemble "
-                "from t=0 (bitwise reproducible per seed)")
+                "--auto-resume requires --checkpoint-every "
+                "(recovery is checkpoint-anchored)")
+        if getattr(args, "watchdog", None) and not supervise_on:
+            raise CliError("--watchdog requires --auto-resume")
         if args.devices > 1:
             if nw % args.devices != 0:
                 raise CliError(
@@ -968,13 +981,14 @@ def _run_ensemble_config(args, *, control=None, emit=None,
         # never-fire slots -- docs/ensemble.md).
         ev_counts = [int(b.state.nm.ev_time.shape[0])
                      for b in built if b.state.nm is not None]
+        nm_bucket = None
         if ev_counts and len(set(ev_counts)) > 1:
-            bucket = max(ev_counts)
+            nm_bucket = max(ev_counts)
             if not args.quiet:
                 print(f"[shadow1-tpu] ensemble: netem event counts "
-                      f"{sorted(set(ev_counts))} -> bucket {bucket}",
+                      f"{sorted(set(ev_counts))} -> bucket {nm_bucket}",
                       file=sys.stderr)
-            built = [build(k, n_events=bucket) for k in range(nw)]
+            built = [build(k, n_events=nm_bucket) for k in range(nw)]
 
         sweep_record = None
         if spec is not None or nw > 1:
@@ -982,6 +996,46 @@ def _run_ensemble_config(args, *, control=None, emit=None,
             if args.sweep:
                 import os
                 sweep_record["file"] = os.path.abspath(args.sweep)
+
+        run_extra = None
+        sup_opts: dict | bool = False
+        world_cmds = None
+        if ck_every_ns:
+            # The replay recipe: solo-shaped flags plus the per-world
+            # override table and netem bucket, so `replay --world K`
+            # can rebuild one member bitwise (replay.rebuild_world).
+            run_extra = {
+                "world": {"kind": "config", "args": world_args(args)},
+                "netem_n_events": nm_bucket,
+            }
+        if supervise_on:
+            from . import supervise as sup_mod
+            sup_mod.install_sigterm()
+            wflag = f" --sweep {args.sweep}" if args.sweep \
+                else f" --worlds {nw}"
+            sup_opts = {
+                "watchdog_s": getattr(args, "watchdog", None),
+                "quiet": args.quiet,
+                "resume_cmd": (
+                    f"shadow1-tpu run {args.config}{wflag} "
+                    f"--auto-resume --checkpoint-every "
+                    f"{args.checkpoint_every:g} "
+                    f"--data-directory {args.data_directory}"),
+            }
+
+            def world_cmds(k, window):
+                # Per-member crash.json commands: replay the bad
+                # window solo, or re-run that world solo from t=0.
+                ov = " ".join(
+                    f"--{key.replace('_', '-')} {val:g}"
+                    for key, val in sorted(overrides[k].items()))
+                cmds = {"rerun": f"shadow1-tpu run {args.config} {ov}"}
+                if window is not None and int(window) >= 0:
+                    cmds["replay"] = (
+                        f"shadow1-tpu replay --data-directory "
+                        f"{args.data_directory} --world {k} "
+                        f"--window {int(window)}")
+                return cmds
 
         t_wall = time.perf_counter()
         try:
@@ -995,18 +1049,53 @@ def _run_ensemble_config(args, *, control=None, emit=None,
                 devices=(args.devices if args.devices > 1 else None),
                 hostnames=list(built[0].asm.hostnames),
                 sweep=sweep_record,
-                quiet=args.quiet)
+                quiet=args.quiet,
+                checkpoint_every=ck_every_ns,
+                supervise=sup_opts,
+                resume=supervise_on,
+                control=control, emit=emit,
+                run_extra=run_extra, world_cmds=world_cmds)
         except EnsembleMismatch as e:
             raise CliError(f"worlds do not stack: {e}")
+        except UnrecoveredFailure as e:
+            print(f"error: {e}", file=sys.stderr)
+            print(json.dumps({"crash": e.crash}))
+            if emit is not None:
+                emit({"event": "crash", "rc": e.rc, "crash": e.crash,
+                      "path": e.path})
+            return e.rc
     except CliError as e:
         print(f"error: {e}", file=sys.stderr)
         return e.rc
 
+    if control is not None and control.outcome is not None:
+        # Park / cancel / timeout decided inside run_ensemble's loop
+        # (identical contract to the solo run_config loop).
+        if control.outcome == "parked":
+            return RC_OK
+        if control.outcome == "cancelled":
+            return RC_FAILED
+        print("error: ensemble stopped: --timeout expired",
+              file=sys.stderr)
+        return RC_USAGE
+
     bad = [s for s in summaries if s["err_flags"]]
-    if not args.quiet or bad:
+    quarantined = [s["world"] for s in summaries
+                   if s.get("quarantined")]
+    summary = {"n_worlds": nw,
+               "simulated_seconds": int(built[0].stop) / SEC,
+               "worlds": summaries}
+    if supervise_on:
+        summary["quarantined"] = quarantined
+    print(json.dumps(summary))
+    if emit is not None:
+        emit({"event": "summary", "summary": summary})
+    if not args.quiet or bad or quarantined:
         for s in summaries:
             flag = (f", ERR=0x{s['err_flags']:x}" if s["err_flags"]
                     else "")
+            if s.get("quarantined"):
+                flag += ", QUARANTINED"
             print(f"[shadow1-tpu] world {s['world']}: "
                   f"{s['events']} events, {s['packets_sent']} packets, "
                   f"{s['drops']} drops{flag}", file=sys.stderr)
@@ -1017,6 +1106,13 @@ def _run_ensemble_config(args, *, control=None, emit=None,
         print(f"error: {len(bad)} world(s) raised invariant-violation "
               f"flags (err_flags above; docs/robustness.md)",
               file=sys.stderr)
+        return RC_INVARIANT
+    if quarantined:
+        print(f"error: world(s) {quarantined} were quarantined "
+              f"(deterministic per-world failure; crash report in "
+              f"{args.data_directory}/crash.json names per-world "
+              f"replay commands); the surviving worlds finished "
+              f"normally", file=sys.stderr)
         return RC_INVARIANT
     return RC_OK
 
@@ -1500,6 +1596,7 @@ def replay_cmd(args) -> int:
     try:
         summary = replay_mod.replay(
             args.data_directory, window=args.window, time_s=args.time,
+            world=args.world,
             out_dir=args.out, devices=args.devices, scope=args.scope,
             lineage=args.trace_packets,
             log_level=args.log_level, pcap=args.pcap,

@@ -45,7 +45,8 @@ def _build_submit(args):
                       "path), --world NAME, or --replay RUN")
     if args.config:
         spec = world_args(args)
-        for k in ("heartbeat_frequency", "quiet", "watchdog"):
+        for k in ("heartbeat_frequency", "quiet", "watchdog",
+                  "worlds", "sweep"):
             spec[k] = getattr(args, k, None)
         spec["progress"] = bool(args.progress)
         return ("config", spec), None

@@ -50,6 +50,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import shapes
 from ..core import engine, rng, simtime
@@ -60,7 +61,21 @@ I64 = jnp.int64
 __all__ = [
     "EnsembleMismatch", "stack", "replicate", "run_until", "run_chunked",
     "world", "world_count", "shard_worlds", "cache_size",
+    "FROZEN_NOW", "freeze_worlds", "frozen_worlds",
 ]
+
+# A frozen (quarantined) world's clock.  The engine window predicate is
+# `(state.now < t_target) & (gmin < t_target)`; parking `now` beyond any
+# reachable target makes the predicate false on the first iteration, so
+# under vmap the lane is select-carried bitwise-untouched: no window
+# bodies run, no sentinel probes fire, conservation checks never see the
+# world again.  2^62 ns is ~146 simulated years -- far past any stop
+# time -- while leaving headroom below the i64 T_NEVER sentinels used by
+# event queues.  The engine tail `state.replace(now=t_target)` rewrites
+# every lane's clock after EACH launch, so the supervisor re-freezes its
+# quarantine set after every launch rather than relying on `now` to
+# stick (checkpoint manifests list frozen worlds for stateless resume).
+FROZEN_NOW = 1 << 62
 
 
 class EnsembleMismatch(ValueError):
@@ -191,9 +206,18 @@ def run_chunked(estate, eparams, app, t_target: int,
     engine.run_chunked with the world axis.  Chunk boundaries are
     absolute times shared by all worlds (each world still advances by
     its own windows inside a launch), so drains see every world at the
-    same boundary."""
+    same boundary.
+
+    Lanes parked at FROZEN_NOW (quarantined worlds) stay parked across
+    chunk boundaries: the engine tail rewrites every lane's clock to
+    the boundary after each launch, which would thaw a frozen lane for
+    the next chunk, so the loop re-parks the lanes that entered frozen.
+    With no frozen lanes this adds nothing -- the launch sequence is
+    byte-for-byte the plain one."""
     from .. import trace
 
+    frozen = estate.now >= FROZEN_NOW
+    refreeze = bool(jnp.any(frozen))
     t = int(jnp.min(estate.now))
     t_target = int(t_target)
     prof = trace.current()
@@ -201,6 +225,10 @@ def run_chunked(estate, eparams, app, t_target: int,
         t = min(t + chunk_ns, t_target)
         with prof.span("device_step", t_ns=t):
             estate = run_until(estate, eparams, app, t)
+            if refreeze:
+                estate = estate.replace(now=jnp.where(
+                    frozen, jnp.asarray(FROZEN_NOW, estate.now.dtype),
+                    estate.now))
             if prof.sync:
                 jax.block_until_ready(estate)
     return estate
@@ -217,6 +245,43 @@ def world(estate, eparams, k: int):
         raise IndexError(f"world {k} out of range [0, {n})")
     return (jax.tree_util.tree_map(lambda x: x[k], estate),
             jax.tree_util.tree_map(lambda x: x[k], eparams))
+
+
+def freeze_worlds(estate, worlds):
+    """Park the listed worlds at `FROZEN_NOW` (quarantine freeze).
+
+    Every other leaf is left bitwise-untouched: with `now` beyond any
+    launch target the engine window predicate is false on iteration
+    one, so vmap select-carries the lane through whole launches -- no
+    window bodies, no sentinel probes, no conservation deltas.  Called
+    by the supervisor's quarantine rung after EVERY launch (the engine
+    tail rewrites `now=t_target` on all lanes).  `worlds` is an
+    iterable of world indices; an empty set is the identity."""
+    worlds = sorted({int(k) for k in worlds})
+    if not worlds:
+        return estate
+    n = world_count(estate)
+    if n is None:
+        raise ValueError("freeze_worlds(): state has no world axis")
+    bad = [k for k in worlds if not 0 <= k < n]
+    if bad:
+        raise IndexError(f"freeze_worlds(): worlds {bad} out of range "
+                         f"[0, {n})")
+    mask = jnp.zeros((n,), dtype=bool).at[jnp.asarray(worlds)].set(True)
+    return estate.replace(
+        now=jnp.where(mask, jnp.asarray(FROZEN_NOW, I64), estate.now))
+
+
+def frozen_worlds(estate):
+    """World indices currently parked at `FROZEN_NOW` (sorted list).
+
+    Quarantine state lives IN the state tree, so a resumed run
+    re-derives its quarantine set from the loaded checkpoint with no
+    side-channel bookkeeping.  Returns [] for a solo state."""
+    if world_count(estate) is None:
+        return []
+    nows = np.asarray(jax.device_get(estate.now)).ravel()
+    return [int(k) for k, t in enumerate(nows) if int(t) >= FROZEN_NOW]
 
 
 def shard_worlds(estate, eparams, mesh=None):

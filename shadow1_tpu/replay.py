@@ -128,6 +128,14 @@ class Checkpointer:
                 self.saved = rebuild_index(data_dir)
 
     def _extra(self, state, params) -> dict:
+        from .core.state import world_count
+        if world_count(state) is not None:
+            # Shape probes read PER-WORLD row counts: num_hosts is a
+            # leading-axis property and would report n_worlds on a
+            # stacked tree.
+            import jax
+            w0 = jax.tree_util.tree_map(lambda x: x[0], (state, params))
+            state, params = w0
         h = int(state.hosts.num_hosts)
         real = self.hosts_real
         if real is None:
@@ -137,8 +145,18 @@ class Checkpointer:
                 "hosts_padded": h, "hosts_real": int(real)}
 
     def save(self, state, params) -> str:
-        w = int(state.n_windows)
-        t = int(state.now)
+        # Stacked states: the filename window is the MAX over worlds
+        # (each world advances by its own gmin) and the cadence clock is
+        # the MIN over active worlds -- a quarantined world parked at
+        # ensemble.FROZEN_NOW must not push `_next` past every future
+        # boundary and silently disable checkpointing.
+        import numpy as np
+        from .ensemble import FROZEN_NOW
+        wins = np.asarray(state.n_windows).ravel()
+        nows = np.asarray(state.now).ravel()
+        w = int(wins.max())
+        active = nows[nows < FROZEN_NOW]
+        t = int(active.min()) if active.size else int(nows.min())
         path = os.path.join(self.dir, f"win_{w}.npz")
         checkpoint.save(path, state, params,
                         manifest=self._extra(state, params))
@@ -243,7 +261,8 @@ def load_windows(path_or_dir: str) -> list:
     return rows
 
 
-def find_checkpoint(data_dir: str, window: int | None):
+def find_checkpoint(data_dir: str, window: int | None,
+                    world: int | None = None):
     """(path, manifest) of the nearest READABLE checkpoint at-or-before
     the global window index `window` (None: the newest checkpoint).
 
@@ -252,7 +271,13 @@ def find_checkpoint(data_dir: str, window: int | None):
     is tried, so one bad file never strands a recoverable run.  Saves
     are atomic (checkpoint.save writes .tmp + os.replace), so a torn
     file under the real name means external damage, not a crashed
-    save."""
+    save.
+
+    `world=K` anchors a single-member replay of a stacked run: the
+    bound is world K's OWN window (`manifest["windows"][K]` -- the
+    filename carries the max over worlds), and snapshots taken after
+    world K was quarantined are skipped (their K-lane is the frozen
+    anchor, not a trajectory point)."""
     cands = []
     for p in glob.glob(os.path.join(data_dir, "ckpt", "win_*.npz")):
         name = os.path.basename(p)
@@ -260,7 +285,10 @@ def find_checkpoint(data_dir: str, window: int | None):
             w = int(name[4:-4])
         except ValueError:
             continue
-        if window is None or w <= window:
+        # With a world slice the filename window is the MAX over
+        # worlds -- world K's own window can be lower, so every file is
+        # a candidate and the bound is checked against the manifest.
+        if world is not None or window is None or w <= window:
             cands.append((w, p))
     if not cands:
         raise FileNotFoundError(
@@ -282,11 +310,30 @@ def find_checkpoint(data_dir: str, window: int | None):
             raise ValueError(
                 f"{p} predates the manifest format and cannot anchor "
                 f"a replay (re-run with --checkpoint-every)")
+        if world is not None:
+            k = int(world)
+            n = int(man.get("n_worlds", 1))
+            if n == 1:
+                raise ValueError(
+                    f"{p}: --world {k} requested but the run's "
+                    f"checkpoints are solo snapshots (n_worlds 1)")
+            if not 0 <= k < n:
+                raise ValueError(
+                    f"{p}: --world {k} is out of range; the run holds "
+                    f"worlds 0..{n - 1}")
+            if k in (man.get("frozen") or []):
+                errors.append(
+                    f"{os.path.basename(p)}: world {k} quarantined")
+                continue
+            wk = int((man.get("windows") or [man["window"]] * n)[k])
+            if window is not None and wk > window:
+                continue
         return p, man
     raise FileNotFoundError(
         f"every checkpoint at or before window {window} under "
-        f"{os.path.join(data_dir, 'ckpt')} is unreadable: "
-        + "; ".join(errors))
+        f"{os.path.join(data_dir, 'ckpt')} is unreadable"
+        + (f" or unusable for world {world}" if world is not None
+           else "") + ": " + "; ".join(errors))
 
 
 def rebuild_world(info: dict, data_dir: str, *, want_mesh: bool = True):
@@ -304,8 +351,13 @@ def rebuild_world(info: dict, data_dir: str, *, want_mesh: bool = True):
         ns = argparse.Namespace(data_directory=data_dir, quiet=True,
                                 heartbeat_frequency=0, progress=False,
                                 **world["args"])
+        # Ensemble runs rebuild every member on the shared netem event
+        # bucket (seed-dependent schedules disagree on the nm shape);
+        # run.json records it so a --world K template stacks up to the
+        # saved arrays.
         w = cli.build_world(ns, quiet=True, want_mesh=want_mesh,
-                            allow_substrate=False)
+                            allow_substrate=False,
+                            netem_n_events=info.get("netem_n_events"))
         st = w.state
         if info.get("sentinel") or info.get("supervise"):
             from . import trace
@@ -439,7 +491,8 @@ def replay(data_dir: str, *, window: int | None = None,
            log_level: str = "off", pcap: bool = False,
            pcap_ring: int = 1 << 17, log_ring: int = 0,
            profile: bool = False, progress: bool = False,
-           verify: bool = True, quiet: bool = True) -> dict:
+           verify: bool = True, quiet: bool = True,
+           world: int | None = None) -> dict:
     """Re-run a span of a checkpointed simulation, bitwise-verified.
 
     Targets the global window index `window` (or the window containing
@@ -453,16 +506,59 @@ def replay(data_dir: str, *, window: int | None = None,
     packet set the original run would have traced -- `log_level`,
     `pcap`, `profile`) is installed AFTER the checkpoint loads;
     outputs land in `out_dir` (default `<data_dir>/replay`).
-    Returns a summary dict."""
+
+    Ensemble runs replay ONE member at a time: `world=K` slices world K
+    out of the stacked anchor into a solo template (the per-world sweep
+    overrides and netem bucket from run.json rebuild exactly member K's
+    world) and verifies against its `"world": K` rows.  The restored
+    trajectory is bitwise the lane the ensemble ran -- vmap solo
+    equivalence, docs/ensemble.md contract 1.  Returns a summary
+    dict."""
     import jax
 
     from . import trace as trace_mod
 
     info = load_run(data_dir)
+    n_worlds = int(info.get("n_worlds") or 1)
+    if world is None and n_worlds > 1:
+        raise ValueError(
+            f"{data_dir}: a {n_worlds}-world ensemble run replays one "
+            f"member at a time; pass --world K (0..{n_worlds - 1})")
+    if world is not None:
+        world = int(world)
+        if n_worlds == 1:
+            raise ValueError(
+                f"{data_dir}: --world {world} requested but the run is "
+                f"solo (no world axis); drop --world")
+        if not 0 <= world < n_worlds:
+            raise ValueError(
+                f"--world {world} is out of range; the run holds "
+                f"worlds 0..{n_worlds - 1}")
+        # Patch the recipe down to member `world`: deep-copy, apply the
+        # per-world sweep overrides (resolved seed/churn), and rebuild
+        # the template whole on one device (world-major sharding never
+        # splits a world, so a member has no shard segmentation).
+        info = json.loads(json.dumps(info))
+        over = (info.get("sweep") or {}).get("worlds") or []
+        wargs = info.get("world", {}).get("args")
+        if wargs is not None:
+            if world < len(over):
+                wargs.update(over[world] or {})
+            if "devices" in wargs:
+                wargs["devices"] = 1
     rows = load_windows(data_dir)
+    if world is not None:
+        # Member K's rows, world column stripped: the solo replay's
+        # flight recorder emits no world column, and verify_against is
+        # a full-dict bitwise compare.
+        rows = [{k: v for k, v in r.items() if k != "world"}
+                for r in rows if r.get("world") == world]
     if not rows:
         raise ValueError(
-            f"{data_dir}/windows.jsonl is empty: nothing to replay")
+            f"{data_dir}/windows.jsonl is empty: nothing to replay"
+            if world is None else
+            f"{data_dir}/windows.jsonl has no rows for world {world}: "
+            f"nothing to replay")
     by_w = {r["window"]: r for r in rows}
 
     if window is None and time_s is None:
@@ -503,9 +599,20 @@ def replay(data_dir: str, *, window: int | None = None,
             f"the ring capacity wrap away between drains -- checkpoint "
             f"more often to keep the record gap-free)")
 
-    ckpt_path, man = find_checkpoint(data_dir, window)
-    k0, t0 = int(man["window"]), int(man["t_ns"])
+    ckpt_path, man = find_checkpoint(data_dir, window, world=world)
+    if world is not None:
+        # World K's OWN anchor coordinates: the top-level window/t_ns
+        # aggregate over worlds (max / active-min).
+        nw = int(man["n_worlds"])
+        k0 = int((man.get("windows") or [man["window"]] * nw)[world])
+        t0 = int((man.get("t_ns_worlds") or [man["t_ns"]] * nw)[world])
+    else:
+        k0, t0 = int(man["window"]), int(man["t_ns"])
     n_dev_orig = int(man.get("devices") or info.get("devices") or 1)
+    if world is not None:
+        # World-major sharding keeps members whole: a sliced member has
+        # no shard segmentation and replays on one device.
+        n_dev_orig = 1
     exec_dev = n_dev_orig if devices is None else int(devices)
     if exec_dev not in (n_dev_orig, 1):
         raise ValueError(
@@ -524,7 +631,7 @@ def replay(data_dir: str, *, window: int | None = None,
             and tmpl_state.sentinel is None:
         tmpl_state = trace_mod.ensure_sentinel(tmpl_state)
     state, params = checkpoint.load(ckpt_path, tmpl_state,
-                                    built["params"])
+                                    built["params"], world=world)
     app, mesh = built["app"], built["mesh"]
     if int(state.now) != t0:
         raise ValueError(
@@ -676,6 +783,8 @@ def replay(data_dir: str, *, window: int | None = None,
             "windows_replayed": len(flight.rows),
             "windows_verified": flight.verified if verify else None,
             "devices": exec_dev,
+            **({"world": world, "n_worlds": n_worlds}
+               if world is not None else {}),
         },
         "err_flags": int(state.err),
     }

@@ -79,7 +79,7 @@ _SHAPE_SPEC_KEYS = (
     "config", "sock_slots", "pool_slab", "tcp_congestion_control",
     "interface_qdisc", "pcap", "pcap_ring", "log_level", "log_ring",
     "bucket", "devices", "scope", "trace_packets", "flight_rows",
-    "digest_every", "digest_rows", "profile")
+    "digest_every", "digest_rows", "profile", "worlds", "sweep")
 
 
 def _shape_hint(kind: str, spec: dict) -> str:
@@ -159,6 +159,7 @@ class Request:
         self.parks = 0           # server-drain parks taken
         self.resumes = 0         # checkpoint resumes (emit "resumed")
         self.recoveries = 0      # ladder rungs taken (emit "recovered")
+        self.quarantines = 0     # worlds quarantined (emit "quarantined")
         self.profiler = None     # per-request trace.Profiler while running
 
     def queue_wait_s(self) -> float:
@@ -204,6 +205,7 @@ class ServerMetrics:
         self.parked = 0
         self.resumes = 0
         self.recoveries = 0
+        self.quarantines = 0
         self.affinity_hits = 0
         self.affinity_misses = 0
         self.queue_high_water = 0
@@ -299,7 +301,8 @@ class ServerMetrics:
                     "readmitted": self.readmitted,
                     "parked": self.parked,
                     "resumes": self.resumes,
-                    "recoveries": self.recoveries},
+                    "recoveries": self.recoveries,
+                    "quarantines": self.quarantines},
                 "recent": list(self.recent),
             }
 
@@ -997,6 +1000,12 @@ class Server:
             elif ev.get("event") == "recovered":
                 req.recoveries += 1
                 self.metrics.event("recoveries")
+            elif ev.get("event") == "quarantined":
+                # Ensemble request: world(s) frozen by the quarantine
+                # rung while the survivors keep running.
+                n = len(ev.get("worlds") or ()) or 1
+                req.quarantines += n
+                self.metrics.event("quarantines", n)
             self._emit(req, ev)
 
         try:
@@ -1222,6 +1231,9 @@ class Server:
             "parks": req.parks,
             "resumes": req.resumes,
             "recoveries": req.recoveries,
+            "quarantines": req.quarantines,
+            "n_worlds": (req.summary or {}).get("n_worlds")
+            if isinstance(req.summary, dict) else None,
             "restarts": req.restarts,
             "submitted": req.submitted,
             "started": req.started,

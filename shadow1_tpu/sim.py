@@ -703,7 +703,10 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
 def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
                  lineage=None, digest=None, heartbeat_s: int = 0,
                  log: bool = False, devices=None, chunk_ns=None,
-                 hostnames=None, sweep=None, quiet: bool = True):
+                 hostnames=None, sweep=None, quiet: bool = True,
+                 checkpoint_every=None, supervise=None, resume=False,
+                 control=None, emit=None, run_extra=None,
+                 world_cmds=None):
     """Run N worlds as one vmapped ensemble (docs/ensemble.md).
 
     `worlds` is a sequence of built (state, params, app) triples -- one
@@ -722,13 +725,25 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
     summary per world.
 
     `devices=N` places worlds world-major across the first N devices
-    (ensemble.shard_worlds; n_worlds must divide).  Checkpointing /
-    supervision / substrate plugins are NOT supported under the world
-    axis (the CLI refuses those combos; checkpoint.world_manifest
-    refuses stacked states).
+    (ensemble.shard_worlds; n_worlds must divide).
+
+    Crash safety mirrors sim.run's checkpointed path
+    (docs/robustness.md "Ensemble resilience"): `checkpoint_every` (ns,
+    requires `data_dir`) saves STACKED anchors -- ckpt/win_<K>.npz with
+    a format-2 manifest carrying per-world windows/clocks -- on the
+    memoryless next_sync grid; `supervise` (True or Supervisor kwargs)
+    runs every launch under supervise.Supervisor with the per-world
+    quarantine rung ahead of the ladder; `resume=True` restores the
+    newest readable stacked anchor, trims windows.jsonl per world, and
+    re-records bitwise.  `control`/`emit` are the run server's hooks,
+    exactly as in sim.run.  `run_extra` merges extra keys into
+    ckpt/run.json (the CLI records its world recipe and netem bucket
+    there so `replay --world K` can rebuild one member); `world_cmds`
+    is forwarded to the Supervisor for crash.json member commands.
 
     Returns (estate, eparams, app, summaries): the final stacked state
-    and one summary dict per world."""
+    and one summary dict per world (with `quarantined` flags under
+    supervision)."""
     import os
     import time as _time
 
@@ -739,6 +754,18 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
 
     worlds = list(worlds)
     nw = len(worlds)
+    if checkpoint_every and not data_dir:
+        raise ValueError(
+            "run_ensemble: checkpoint_every requires data_dir (where "
+            "ckpt/ and windows.jsonl land)")
+    if supervise and not checkpoint_every:
+        raise ValueError(
+            "run_ensemble: supervise requires checkpoint_every "
+            "(recovery is checkpoint-anchored)")
+    if (resume or control is not None) and not checkpoint_every:
+        raise ValueError(
+            "run_ensemble: control/resume require checkpoint_every "
+            "(parking and resuming are checkpoint-anchored)")
 
     def _install(st, p, a):
         if scope is not None and st.scope is None:
@@ -758,6 +785,10 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
             # not per-packet debug floods.
             st = st.replace(log=make_log_ring(),
                             log_level=jnp.ones((h,), jnp.int32))
+        if checkpoint_every and st.fr is None:
+            st = trace.ensure_flight_recorder(st, shards=1)
+        if supervise and st.sentinel is None:
+            st = trace.ensure_sentinel(st)
         return st, p, a
 
     worlds = [_install(*w) for w in worlds]
@@ -767,6 +798,43 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
     until = int(until)
     if chunk_ns is None:
         chunk_ns = engine.CHUNK_NS
+
+    # Auto-resume BEFORE world-major sharding: checkpoint.load wants
+    # the unsharded template, and shard_worlds re-places the loaded
+    # leaves afterwards.  A quarantined world rides the anchor frozen
+    # (now >= ensemble.FROZEN_NOW), so the quarantine set re-derives
+    # statelessly from the loaded state.
+    resumed = None
+    world_starts = None
+    if resume and data_dir is not None:
+        import glob as _glob
+        if _glob.glob(os.path.join(data_dir, "ckpt", "win_*.npz")):
+            try:
+                path, man = replay_mod.find_checkpoint(data_dir, None)
+            except FileNotFoundError:
+                path = None  # all torn: start the run over
+            if path is not None:
+                from . import checkpoint as _ckpt
+                from . import supervise as _sup_mod
+                estate, eparams = _ckpt.load(path, estate, eparams)
+                wins = [int(x) for x in
+                        (man.get("windows") or [man["window"]] * nw)]
+                frozen = {int(k) for k in man.get("frozen") or ()}
+                resumed = {"file": os.path.basename(path),
+                           "window": int(man["window"]),
+                           "t_ns": int(man["t_ns"])}
+                world_starts = dict(enumerate(wins))
+                # Per-world trim: each surviving world re-records from
+                # its OWN anchor window; a quarantined world's trail is
+                # crash evidence a resume never re-records -- keep it.
+                _sup_mod.trim_windows(
+                    os.path.join(data_dir, "windows.jsonl"), None,
+                    world_windows={k: w for k, w in world_starts.items()
+                                   if k not in frozen})
+                if emit is not None:
+                    emit({"event": "resumed", **resumed,
+                          "n_worlds": nw, "windows": wins,
+                          "quarantined": sorted(frozen)})
 
     if devices is not None and int(devices) > 1:
         import jax as _jax
@@ -792,10 +860,10 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
                  [f"host{i}" for i in
                   range(int(worlds[0][0].hosts.num_hosts))])
 
-        def share(fname, want):
+        def share(fname, want, mode="w"):
             if not want:
                 return None
-            f = open(os.path.join(data_dir, fname), "w")
+            f = open(os.path.join(data_dir, fname), mode)
             shared.append(f)
             return f
 
@@ -806,7 +874,10 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
                    and bool(worlds[0][0].scope.sample_links))
         sp = share("spans.jsonl", worlds[0][0].lineage is not None)
         dg = share("digests.jsonl", worlds[0][0].dg is not None)
-        wn = share("windows.jsonl", worlds[0][0].fr is not None)
+        # A resumed run appends to the per-world-trimmed record; each
+        # world's FlightDrain cursor starts at its own anchor window.
+        wn = share("windows.jsonl", worlds[0][0].fr is not None,
+                   mode="a" if resumed else "w")
         for k in range(nw):
             from .observe import LogDrain, Tracker
             tracker = None
@@ -818,7 +889,9 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
                 tracker=tracker,
                 log=(LogDrain(log_f, names, world=k)
                      if log_f is not None else None),
-                flight=(trace.FlightDrain(wn, world=k)
+                flight=(trace.FlightDrain(
+                    wn, world=k,
+                    start=(world_starts or {}).get(k, 0))
                         if wn is not None else None),
                 scope=(trace.ScopeDrain(ff, lf, real_hosts=len(names),
                                         world=k)
@@ -828,7 +901,7 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
                 digests=(trace.DigestDrain(dg, world=k)
                          if dg is not None else None),
             ))
-        replay_mod.write_run_json(data_dir, {
+        info = {
             "n_worlds": nw,
             "sweep": sweep,
             "stop_ns": until,
@@ -836,22 +909,125 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
             "digest": (1 if digest is True else int(digest))
             if digest else None,
             "devices": int(devices) if devices else 1,
-        })
+        }
+        if checkpoint_every:
+            fr0 = worlds[0][0].fr
+            info.update({
+                "hb_ns": None,
+                "every_ns": int(checkpoint_every),
+                "flight_rows": int(fr0.steps.shape[0]),
+                "hosts_real": len(names),
+                "sentinel": bool(supervise),
+                "supervise": bool(supervise),
+            })
+        if run_extra:
+            info.update(run_extra)
+        write_recipe = resumed is None
+        if resumed is not None:
+            # Torn-file hardening parity (docs/robustness.md): a
+            # damaged run.json must not strand a resumable run.
+            import json as _json
+            try:
+                replay_mod.load_run(data_dir)
+                write_recipe = False
+            except (FileNotFoundError, ValueError,
+                    _json.JSONDecodeError):
+                write_recipe = True
+        if write_recipe:
+            replay_mod.write_run_json(data_dir, info)
 
     def drain_all(t):
         for k, dr in enumerate(drains):
             ws = jax.tree_util.tree_map(lambda x: x[k], estate)
             dr.drain_all(ws, t)
 
-    wall0 = _time.monotonic()
-    t = int(jnp.min(estate.now))
-    while t < until:
-        t = min(t + int(chunk_ns), until)
-        estate = ensemble.run_until(estate, eparams, app, t)
-        drain_all(t)
-    jax.block_until_ready(estate)
-    wall = _time.monotonic() - wall0
+    ck = None
+    sup = None
+    if checkpoint_every:
+        ck = replay_mod.Checkpointer(
+            data_dir, int(checkpoint_every),
+            devices=int(devices) if devices else 1,
+            hosts_real=int(worlds[0][0].hosts.num_hosts))
+    if supervise:
+        from . import supervise as sup_mod
+        opts = dict(supervise) if isinstance(supervise, dict) else {}
+        if world_cmds is not None:
+            opts.setdefault("world_cmds", world_cmds)
 
+        def _flush_flights(st):
+            # Evidence flush before a sentinel failure is handled:
+            # every world's flight rows reach windows.jsonl, so the
+            # crash report's replay command has its bad window row.
+            for k, dr in enumerate(drains):
+                if dr.flight is not None:
+                    dr.flight.drain(
+                        jax.tree_util.tree_map(lambda x: x[k], st))
+
+        sup = sup_mod.Supervisor(
+            data_dir, app, mesh=None, chunk_ns=int(chunk_ns),
+            on_violation=_flush_flights, emit=emit, **opts)
+        sup.quarantined = set(ensemble.frozen_worlds(estate))
+
+    import numpy as _np
+
+    def _world_max_window():
+        return int(_np.asarray(estate.n_windows).max())
+
+    wall0 = _time.monotonic()
+    outcome = None
+    try:
+        if ck is not None and resumed is None:
+            ck.save(estate, eparams)  # win_0: an anchor always exists
+        t = int(jnp.min(estate.now))
+        while t < until:
+            act = control.poll() if control is not None else None
+            if act is not None:
+                if act == "park":
+                    ck.save(estate, eparams)
+                    control.outcome = "parked"
+                    if emit is not None:
+                        emit({"event": "parked", "t_ns": int(t),
+                              "window": _world_max_window()})
+                else:
+                    control.outcome = ("cancelled" if act == "cancel"
+                                       else "timed_out")
+                outcome = control.outcome
+                break
+            if ck is not None:
+                t = replay_mod.next_sync(
+                    t, until, every_ns=int(checkpoint_every))
+            else:
+                t = min(t + int(chunk_ns), until)
+            if sup is not None:
+                estate = sup.launch(estate, eparams, t)
+            elif ck is not None:
+                estate = ensemble.run_chunked(estate, eparams, app, t,
+                                              chunk_ns=int(chunk_ns))
+            else:
+                estate = ensemble.run_until(estate, eparams, app, t)
+            drain_all(t)
+            if ck is not None:
+                ck.maybe(estate, eparams, t)
+            if emit is not None:
+                emit({"event": "progress", "t_ns": int(t),
+                      "stop_ns": until,
+                      "line": f"[shadow1-tpu] "
+                              f"{t / simtime.SIMTIME_ONE_SECOND:g}"
+                              f"/{until / simtime.SIMTIME_ONE_SECOND:g}"
+                              f"s\n"})
+        jax.block_until_ready(estate)
+    finally:
+        wall = _time.monotonic() - wall0
+        for dr in drains:
+            for ring in (dr.log, dr.flight, dr.scope, dr.spans,
+                         dr.digests):
+                if ring is not None:
+                    ring.close()
+        for f in shared:
+            f.close()
+
+    quarantined = sorted(sup.quarantined) if sup is not None \
+        else sorted(ensemble.frozen_worlds(estate))
     summaries = []
     ev = jnp.asarray(estate.n_events)
     err = jnp.asarray(estate.err)
@@ -867,20 +1043,25 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
             "drops": int(drop[k]),
             "err_flags": int(err[k]),
             "windows": int(jnp.asarray(estate.n_windows)[k]),
+            **({"quarantined": k in quarantined}
+               if sup is not None else {}),
         })
-    for dr in drains:
-        for ring in (dr.log, dr.flight, dr.scope, dr.spans, dr.digests):
-            if ring is not None:
-                ring.close()
-    for f in shared:
-        f.close()
     if data_dir is not None:
         import json as _json
+        top = {"n_worlds": nw, "wall_seconds": round(wall, 3),
+               "simulated_seconds":
+               until / simtime.SIMTIME_ONE_SECOND,
+               "sweep": sweep, "worlds": summaries}
+        if sup is not None:
+            top["supervise"] = {
+                "recoveries": int(sup.recoveries),
+                "quarantined": quarantined,
+                "ladder": sup.ladder,
+            }
+        if outcome is not None:
+            top["outcome"] = outcome
         with open(os.path.join(data_dir, "summary.json"), "w") as f:
-            _json.dump({"n_worlds": nw, "wall_seconds": round(wall, 3),
-                        "simulated_seconds":
-                        until / simtime.SIMTIME_ONE_SECOND,
-                        "sweep": sweep, "worlds": summaries}, f, indent=2)
+            _json.dump(top, f, indent=2)
     if not quiet:
         print(f"[shadow1-tpu] ensemble: {nw} worlds, "
               f"{until / simtime.SIMTIME_ONE_SECOND:.3f}s simulated in "

@@ -27,6 +27,17 @@ rung: they reproduce bitwise, so only an execution-strategy change
 could dodge a backend bug, and if none does the crash is real and the
 ladder surrenders with the evidence.
 
+Stacked (ensemble) runs add ONE rung ahead of the ladder: a
+deterministic failure confined to world k quarantines that world --
+reload the newest anchor, park world k at `ensemble.FROZEN_NOW` so the
+vmapped window predicate select-carries its lane untouched (inert,
+conservation-exempt), and relaunch.  The surviving N-1 worlds finish
+bitwise-identical to a clean run (frozen lanes never feed back), and
+crash.json records the quarantined worlds with per-world resume /
+`replay --world K` commands while the run CONTINUES.  Infrastructure
+failures (oom/hung/kill) walk the existing rungs unchanged -- they are
+not a property of any world.
+
 crash.json is the surrender report: failure class and message, the
 window index and sim time, the sentinel row (if the sentinel fired),
 the nearest checkpoint, the ladder rungs taken, and the exact replay
@@ -151,12 +162,19 @@ def install_sigterm() -> bool:
         return False
 
 
-def trim_windows(path: str, before_window: int) -> int:
+def trim_windows(path: str, before_window: int | None,
+                 world_windows: dict | None = None) -> int:
     """Drop flight-recorder rows at-or-after `before_window` from a
     windows.jsonl (atomically).  Auto-resume rewinds to a checkpoint at
     window K and re-records every window >= K bitwise; trimming first
     keeps the file one contiguous, duplicate-free record.  Returns the
-    number of rows dropped."""
+    number of rows dropped.
+
+    Ensemble resumes cut PER WORLD: `world_windows` maps world index ->
+    that world's anchor window (checkpoint manifest `windows[k]`), and
+    only rows of the listed worlds are candidates -- a quarantined
+    world's trail (its crash evidence, which a resume never re-records)
+    is kept by omitting it from the map."""
     if not os.path.exists(path):
         return 0
     kept, dropped = [], 0
@@ -166,11 +184,17 @@ def trim_windows(path: str, before_window: int) -> int:
             if not s:
                 continue
             try:
-                w = json.loads(s).get("window")
+                row = json.loads(s)
             except json.JSONDecodeError:
                 dropped += 1  # torn tail line from a crashed writer
                 continue
-            if w is not None and int(w) >= int(before_window):
+            w = row.get("window")
+            if world_windows is not None:
+                k = row.get("world")
+                cut = None if k is None else world_windows.get(int(k))
+            else:
+                cut = before_window
+            if cut is not None and w is not None and int(w) >= int(cut):
                 dropped += 1
             else:
                 kept.append(s)
@@ -219,7 +243,7 @@ class Supervisor:
     def __init__(self, data_dir: str, app, *, mesh=None, chunk_ns=None,
                  watchdog_s: float | None = None, quiet: bool = False,
                  resume_cmd: str | None = None, on_violation=None,
-                 emit=None):
+                 emit=None, world_cmds=None):
         from . import trace
         self.data_dir = data_dir
         self.app = app
@@ -230,12 +254,17 @@ class Supervisor:
         self.resume_cmd = resume_cmd
         self.on_violation = on_violation
         self.emit = emit  # ladder-rung event callback (run server)
+        # crash.json member commands: world_cmds(k, window) -> dict of
+        # per-world "resume"/"replay" strings (the CLI knows the flags).
+        self.world_cmds = world_cmds
         self.sentinel = trace.SentinelDrain()
         self.megakernel_off = False
         self.ladder = []       # crash.json trail: rungs taken/skipped
         self.recoveries = 0    # rungs actually taken
+        self.quarantined = set()  # frozen world indices (ensemble runs)
         self._rung = 0         # next RUNGS index to consider
         self._warm = False     # a launch of the current graph completed
+        self._graph_worlds = None  # n_worlds the current graph compiled
 
     # -- public ----------------------------------------------------------
 
@@ -246,6 +275,13 @@ class Supervisor:
         while True:
             try:
                 out = self._attempt(state, params, t_next)
+                if self.quarantined:
+                    # The engine tail rewrites now=t_target on EVERY
+                    # vmap lane; re-park the quarantine set so frozen
+                    # worlds stay inert through the next launch.  Their
+                    # other leaves were select-carried untouched.
+                    from . import ensemble
+                    out = ensemble.freeze_worlds(out, self.quarantined)
                 try:
                     self.sentinel.check(out)
                 except trace.SentinelViolation:
@@ -273,12 +309,30 @@ class Supervisor:
     # -- execution -------------------------------------------------------
 
     def _attempt(self, state, params, t_next):
+        from .core.state import world_count
+        n_worlds = world_count(state)
+        if n_worlds != self._graph_worlds:
+            # A different world count is a different compiled graph
+            # (vmapped graphs compile slower than solo ones): re-open
+            # the compile grace window so the cold ensemble compile
+            # never counts against the watchdog deadline, mirroring the
+            # megakernel_off / gather_single rungs.
+            self._graph_worlds = n_worlds
+            self._warm = False
         exec_params = params
         if self.megakernel_off and bool(getattr(params, "megakernel",
                                                 False)):
             exec_params = params.replace(megakernel=False)
 
         def go():
+            if n_worlds is not None:
+                # Stacked run: the vmapped chunk loop.  World-major
+                # sharding (ensemble.shard_worlds) propagates through
+                # the jit inputs, so no mesh dispatch is needed.
+                from . import ensemble
+                return ensemble.run_chunked(state, exec_params, self.app,
+                                            t_next,
+                                            chunk_ns=self.chunk_ns)
             if self.mesh is not None:
                 from .parallel import mesh as pmesh
                 return pmesh.mesh_run_chunked(
@@ -323,6 +377,19 @@ class Supervisor:
     # -- the ladder ------------------------------------------------------
 
     def _recover(self, exc, cls, state, params, row):
+        from .core.state import world_count
+        n = world_count(state)
+        if cls in DETERMINISTIC and n is not None:
+            # Per-world quarantine rung: a deterministic failure
+            # confined to some worlds freezes THOSE worlds and lets the
+            # survivors finish.  Only when every world is bad (or the
+            # sentinel cannot name the offenders) does the batch walk
+            # the ordinary ladder.
+            bad = {int(k) for k in (row or {}).get("bad_worlds") or ()}
+            fresh = sorted(bad - self.quarantined)
+            if fresh and len(self.quarantined) + len(fresh) < int(n):
+                return self._quarantine(exc, cls, state, params, row,
+                                        fresh)
         while self._rung < len(RUNGS):
             rung = RUNGS[self._rung]
             self._rung += 1
@@ -356,6 +423,39 @@ class Supervisor:
                       f"window {ck['window']} (t={ck['t_ns']} ns)")
             return state
         raise self._surrender(exc, cls, state, row) from exc
+
+    def _quarantine(self, exc, cls, state, params, row, fresh):
+        """Freeze the offending worlds and rejoin the loop: reload the
+        newest anchor (its sentinel is clean), park each bad world at
+        ensemble.FROZEN_NOW, record the rung + a crash.json evidence
+        report, and hand the surviving batch back to launch()."""
+        from . import ensemble
+        try:
+            state, ck = self._reload(state, params)
+        except (FileNotFoundError, ValueError, OSError) as e:
+            raise self._surrender(
+                exc, cls, state, row,
+                note=f"quarantine rung could not reload a "
+                     f"checkpoint: {e}") from exc
+        self.quarantined.update(fresh)
+        state = ensemble.freeze_worlds(state, self.quarantined)
+        self.ladder.append({"rung": "quarantine_world", "action": "taken",
+                            "failure": cls, "worlds": list(fresh),
+                            "checkpoint": ck})
+        self.recoveries += 1
+        if self.emit is not None:
+            self.emit({"event": "quarantined", "failure": cls,
+                       "worlds": list(fresh), "window": ck["window"]})
+        self._say(f"supervise: quarantined world(s) {fresh} ({cls}); "
+                  f"resuming the surviving worlds from window "
+                  f"{ck['window']} (t={ck['t_ns']} ns)")
+        # crash.json doubles as the quarantine record: same schema as a
+        # surrender, failure.note says the run is continuing, and the
+        # "worlds" block carries per-member resume/replay commands.
+        self._write_crash(exc, cls, row,
+                          note="world(s) quarantined; surviving worlds "
+                               "continuing")
+        return state
 
     def _skip_reason(self, rung, cls, state, params):
         if rung == "retry" and cls in DETERMINISTIC:
@@ -406,9 +506,34 @@ class Supervisor:
 
     # -- surrender -------------------------------------------------------
 
-    def _surrender(self, exc, cls, state, row, touch_state=True,
-                   note=None):
-        """Write crash.json and return the UnrecoveredFailure to raise."""
+    def _worlds_schema(self, row):
+        """The crash.json `worlds` block: the quarantine roster with
+        per-member coordinates and resume/replay commands."""
+        subs = {int(r.get("world")): r
+                for r in (row or {}).get("worlds") or ()
+                if r.get("world") is not None}
+        members = []
+        for k in sorted(self.quarantined):
+            sub = subs.get(k)
+            m = {"world": k,
+                 "sentinel": _json_safe(sub) if sub else None}
+            w = None if sub is None else sub.get("first_bad_window")
+            if self.world_cmds is not None:
+                try:
+                    m.update(self.world_cmds(k, w) or {})
+                except Exception:
+                    pass  # never let hints mask the failure
+            elif w is not None and int(w) >= 0:
+                m["replay"] = (f"shadow1-tpu replay --data-directory "
+                               f"{self.data_dir} --world {k} "
+                               f"--window {int(w)}")
+            members.append(m)
+        n = self._graph_worlds
+        return {"n_worlds": None if n is None else int(n),
+                "quarantined": sorted(self.quarantined),
+                "members": members}
+
+    def _crash_dict(self, exc, cls, state, row, touch_state, note):
         from . import replay
         crash = {
             "version": CRASH_VERSION,
@@ -430,7 +555,15 @@ class Supervisor:
             try:
                 import jax
                 w, t = jax.device_get((state.n_windows, state.now))
-                crash["window"], crash["t_ns"] = int(w), int(t)
+                import numpy as np
+                # Stacked states: the batch coordinate is the max
+                # window / min active clock, matching the manifests.
+                from .ensemble import FROZEN_NOW
+                w = np.asarray(w).ravel()
+                t = np.asarray(t).ravel()
+                act = t[t < FROZEN_NOW]
+                crash["window"] = int(w.max())
+                crash["t_ns"] = int(act.min() if act.size else t.min())
             except Exception:
                 pass  # never let evidence collection mask the failure
         try:
@@ -441,10 +574,33 @@ class Supervisor:
                 "t_ns": None if man is None else int(man["t_ns"])}
         except Exception:
             pass
+        if self.quarantined:
+            crash["worlds"] = self._worlds_schema(row)
         if crash["window"] is not None:
+            wflag = ""
+            if row is not None and row.get("world") is not None:
+                wflag = f" --world {int(row['world'])}"
             crash["replay"] = (f"shadow1-tpu replay --data-directory "
-                               f"{self.data_dir} --window "
+                               f"{self.data_dir}{wflag} --window "
                                f"{crash['window']}")
+        return crash
+
+    def _write_crash(self, exc, cls, row, note=None):
+        """Atomically write crash.json WITHOUT surrendering (the
+        quarantine rung's evidence record; the run continues)."""
+        crash = self._crash_dict(exc, cls, None, row,
+                                 touch_state=False, note=note)
+        out = os.path.join(self.data_dir, "crash.json")
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(crash, f, indent=1, sort_keys=True)
+        os.replace(tmp, out)
+        return out
+
+    def _surrender(self, exc, cls, state, row, touch_state=True,
+                   note=None):
+        """Write crash.json and return the UnrecoveredFailure to raise."""
+        crash = self._crash_dict(exc, cls, state, row, touch_state, note)
         out = os.path.join(self.data_dir, "crash.json")
         tmp = out + ".tmp"
         with open(tmp, "w") as f:

@@ -845,16 +845,21 @@ class SentinelViolation(RuntimeError):
     packet conservation, window-time monotonicity, a stage/queue/cursor
     bound, or finiteness of its float islands.  Raised by
     SentinelDrain.check(); carries the full sentinel row (the same dict
-    the supervisor stamps into crash.json)."""
+    the supervisor stamps into crash.json).  Ensemble rows name the
+    offending world and point the replay hint at `--world K`."""
 
     def __init__(self, row: dict):
         self.row = row
         names = sentinel_classes(row.get("violations", 0))
+        w = row.get("world")
+        where = f" in world {w}" if w is not None else ""
+        wflag = f" --world {w}" if w is not None else ""
         super().__init__(
-            f"sentinel violation ({'+'.join(names) or 'unknown'}) first "
-            f"at window {row.get('first_bad_window')} "
+            f"sentinel violation ({'+'.join(names) or 'unknown'}){where} "
+            f"first at window {row.get('first_bad_window')} "
             f"(t={row.get('first_bad_t')} ns); replay it with "
-            f"`shadow1-tpu replay --window {row.get('first_bad_window')}`"
+            f"`shadow1-tpu replay{wflag} --window "
+            f"{row.get('first_bad_window')}`"
         )
 
 
@@ -863,10 +868,37 @@ class SentinelDrain:
     block's scalars at chunk boundaries (riding the existing sync
     points, like FlightDrain).  `drain` returns the current row;
     `check` additionally raises SentinelViolation the moment any sticky
-    violation bit is set, which is what the supervisor catches."""
+    violation bit is set, which is what the supervisor catches.
+
+    Stacked states drain per world (the sentinel block vmaps like any
+    other leaf, so the sticky bits/first_bad_window/first_bad_t are
+    already per-world): the returned row aggregates -- checks summed,
+    violation bits OR'd -- and carries the earliest-failing world's
+    coordinates plus `world` / `bad_worlds` / `worlds` (one sub-row per
+    offending world), which is what the supervisor's quarantine rung
+    and crash.json consume."""
+
+    _FIELDS = ("checks", "violations", "last_violation",
+               "first_bad_window", "first_bad_t", "last_we",
+               "resid_low", "resid_high", "nonfinite")
 
     def __init__(self):
         self.row = None
+
+    @staticmethod
+    def _row(checks, bits, last, fw, ft, lwe, rlo, rhi, nf):
+        return {
+            "checks": checks,
+            "violations": bits,
+            "classes": sentinel_classes(bits),
+            "last_violation": last,
+            "first_bad_window": fw,
+            "first_bad_t": ft,
+            "last_we": lwe,
+            "resid_low": rlo,
+            "resid_high": rhi,
+            "nonfinite": nf,
+        }
 
     def drain(self, state, profiler=None):
         sn = getattr(state, "sentinel", None)
@@ -881,19 +913,32 @@ class SentinelDrain:
                                    sn.resid_low, sn.resid_high,
                                    sn.nonfinite))
             p.transfer(8 * len(vals), count=1)
-        (checks, bits, last, fw, ft, lwe, rlo, rhi, nf) = map(int, vals)
-        self.row = {
-            "checks": checks,
+        import numpy as np
+        if np.ndim(vals[0]) == 0:
+            self.row = self._row(*map(int, vals))
+            return self.row
+        arrs = [np.asarray(v).ravel() for v in vals]
+        n = arrs[0].size
+        per = [self._row(*(int(a[k]) for a in arrs)) for k in range(n)]
+        bad = [k for k in range(n) if per[k]["violations"]]
+        # The headline coordinates are the earliest failure's (smallest
+        # first_bad_t, ties to the lowest world index).
+        lead = min(bad, key=lambda k: (per[k]["first_bad_t"], k)) \
+            if bad else None
+        row = dict(per[lead if lead is not None else 0])
+        bits = 0
+        for r in per:
+            bits |= r["violations"]
+        row.update({
+            "checks": sum(r["checks"] for r in per),
             "violations": bits,
             "classes": sentinel_classes(bits),
-            "last_violation": last,
-            "first_bad_window": fw,
-            "first_bad_t": ft,
-            "last_we": lwe,
-            "resid_low": rlo,
-            "resid_high": rhi,
-            "nonfinite": nf,
-        }
+            "world": lead,
+            "n_worlds": n,
+            "bad_worlds": bad,
+            "worlds": [dict(per[k], world=k) for k in bad],
+        })
+        self.row = row
         return self.row
 
     def check(self, state, profiler=None):

@@ -18,10 +18,16 @@ docs/ensemble.md promises for the ensemble subsystem
   independent PURPOSE_WORLD-folded keys, reproducible solo by passing
   the folded key as the builder seed.
 * Loud refusals: stack() names the first mismatched block/static and
-  points at --bucket; checkpoint.world_manifest refuses stacked
-  states; checkpoint.load refuses ensemble-stamped files;
-  shadow1-tpu diff refuses ensemble digest records and points at
-  tools/parse.py ensemble.
+  points at --bucket; checkpoint.load refuses MISMATCHED world counts
+  by name (stacked checkpoints otherwise round-trip, and world=K
+  slices one member solo, bitwise); shadow1-tpu diff refuses ensemble
+  digest records and points at tools/parse.py ensemble.
+* Ensemble resilience (docs/robustness.md "Ensemble resilience"):
+  stacked anchors resume bitwise per world; a deterministic failure
+  confined to world k quarantines exactly that world (frozen at
+  FROZEN_NOW across chunk boundaries) while survivors finish bitwise;
+  crash.json carries the per-world roster; replay --world K replays
+  one member off the stacked anchors.
 """
 
 import json
@@ -206,10 +212,51 @@ def test_world_count_probe():
     assert world_count(estate) == 3
 
 
-def test_checkpoint_refuses_stacked_state():
+def test_checkpoint_stacked_round_trip(tmp_path):
+    # Checkpoint v2: stacked states save with per-world manifest
+    # coordinates and load back bitwise into an equal-count template.
+    estate, eparams, app = ensemble.stack([_phold(1), _phold(2)])
+    estate = ensemble.run_until(estate, eparams, app, SEC)
+    path = str(tmp_path / "w.npz")
+    checkpoint.save(path, estate, eparams)
+    man = checkpoint.read_manifest(path)
+    assert man["n_worlds"] == 2
+    assert len(man["windows"]) == 2 and len(man["t_ns_worlds"]) == 2
+    assert man["frozen"] == []
+    tes, tep, _ = ensemble.stack([_phold(1), _phold(2)])
+    ls, lp = checkpoint.load(path, tes, tep)
+    assert not _mismatched_leaves((estate, eparams), (ls, lp))
+
+
+def test_checkpoint_load_world_slice_bitwise(tmp_path):
+    # load(world=K) slices member K solo, bitwise ensemble.world's view
+    # (the anchor `replay --world K` restores).
+    estate, eparams, app = ensemble.stack([_phold(1), _phold(2)])
+    estate = ensemble.run_until(estate, eparams, app, SEC)
+    path = str(tmp_path / "w.npz")
+    checkpoint.save(path, estate, eparams)
+    s, p, _ = _phold(2)
+    ws, wp = checkpoint.load(path, s, p, world=1)
+    ref_s, ref_p = ensemble.world(estate, eparams, 1)
+    assert not _mismatched_leaves((ref_s, ref_p), (ws, wp))
+    assert bool(wp.megakernel) is False  # stack() forced it off
+
+
+def test_checkpoint_load_refuses_world_mismatch(tmp_path):
+    # Mismatched world counts are refused by NAME, both directions.
     estate, eparams, _ = ensemble.stack([_phold(1), _phold(2)])
-    with pytest.raises(ValueError, match="ensemble"):
-        checkpoint.world_manifest(estate, eparams)
+    path = str(tmp_path / "w.npz")
+    checkpoint.save(path, estate, eparams)
+    s, p, _ = _phold(1)
+    with pytest.raises(ValueError, match="--worlds 2"):
+        checkpoint.load(path, s, p)          # solo template
+    t3 = ensemble.stack([_phold(1), _phold(2), _phold(3)])
+    with pytest.raises(ValueError, match="--worlds 2"):
+        checkpoint.load(path, t3[0], t3[1])  # 3-world template
+    solo = str(tmp_path / "solo.npz")
+    checkpoint.save(solo, s, p)
+    with pytest.raises(ValueError, match="solo"):
+        checkpoint.load(solo, s, p, world=0)  # world slice of a solo
 
 
 def test_checkpoint_load_refuses_ensemble_stamp(tmp_path):
@@ -299,3 +346,190 @@ def test_cli_sweep_spec_refusals(tmp_path):
         run({"worlds": [{"seed": 1, "pool_slab": 9}]})
     with pytest.raises(cli.CliError, match="--worlds 3"):
         run({"seeds": [1, 2]}, worlds=3)
+
+
+# ------------------------------------------- ensemble resilience
+#
+# docs/robustness.md "Ensemble resilience": stacked checkpoints,
+# per-world sentinel verdicts, Supervisor world quarantine, and
+# --auto-resume for ensembles.  tools/faultdrill.py's `ensemble`
+# drill covers the real-SIGKILL subprocess version; these tests pin
+# the same contracts in-process.
+
+# Bit pattern of a float64 NaN, written into the INTEGER srtt leaf --
+# the sentinel's nonfinite probe trips on it (the timer-plausibility
+# ceiling is far below; same mechanism as faultdrill's nan drills).
+NAN_BITS = 9221120237041090560
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _installed_phold(seed):
+    """A _phold world carrying the blocks run_ensemble installs for a
+    checkpointed + supervised run -- the template a stacked anchor of
+    such a run loads back into."""
+    from shadow1_tpu import trace
+    s, p, a = _phold(seed)
+    s = trace.ensure_flight_recorder(s, shards=1)
+    s = trace.ensure_sentinel(s)
+    return s, p, a
+
+
+def _newest_anchor(data_dir):
+    import glob
+    paths = glob.glob(os.path.join(data_dir, "ckpt", "win_*.npz"))
+    assert paths
+    return max(paths,
+               key=lambda p: int(os.path.basename(p)[4:-4]))
+
+
+def _world_rows(path):
+    """windows.jsonl rows keyed by world column -- per-world byte
+    comparison (cross-world interleave is drain-order, not part of
+    the bitwise contract once a quarantine flush perturbs it)."""
+    rows = {}
+    with open(path, "rb") as f:
+        for line in f:
+            if line.strip():
+                rows.setdefault(json.loads(line)["world"],
+                                []).append(line)
+    return rows
+
+
+def test_run_chunked_keeps_frozen_lanes_parked():
+    # A quarantined lane is parked at FROZEN_NOW; the engine tail
+    # rewrites `now` after every inner chunk, so run_chunked must
+    # re-freeze at each boundary or the lane thaws mid-attempt.
+    estate, eparams, app = ensemble.stack([_phold(1), _phold(2)])
+    half = ensemble.run_until(estate, eparams, app, SEC)
+    frozen = ensemble.freeze_worlds(half, [0])
+    out = ensemble.run_chunked(frozen, eparams, app, 2 * SEC,
+                               chunk_ns=SEC // 4)
+    assert ensemble.frozen_worlds(out) == [0]
+    # The parked lane carried nothing but its (re-frozen) clock.
+    diff = _mismatched_leaves(ensemble.world(frozen, eparams, 0),
+                              ensemble.world(out, eparams, 0))
+    assert all("now" in d for d in diff), diff
+    # The survivor is bitwise the never-frozen chunked run.
+    ref = ensemble.run_chunked(half, eparams, app, 2 * SEC,
+                               chunk_ns=SEC // 4)
+    assert not _mismatched_leaves(ensemble.world(ref, eparams, 1),
+                                  ensemble.world(out, eparams, 1))
+
+
+@pytest.mark.tier0
+def test_run_ensemble_auto_resume_bitwise(tmp_path):
+    # Tier-0 pin: an interrupted supervised 4-world run resumed from
+    # its newest stacked anchor finishes leaf-for-leaf bitwise equal,
+    # per world, to the uninterrupted ensemble, and windows.jsonl
+    # re-records the same per-world rows.
+    seeds = (3, 5, 7, 11)
+    kw = dict(checkpoint_every=SEC, supervise=True)
+    ref_dir = str(tmp_path / "ref")
+    ref = sim.run_ensemble([_phold(s) for s in seeds], until=3 * SEC,
+                           data_dir=ref_dir, **kw)
+    res_dir = str(tmp_path / "res")
+    # "Kill": abandon mid-flight past the 1s anchor -- anchors plus a
+    # windows.jsonl trail are all a SIGKILL leaves behind.
+    sim.run_ensemble([_phold(s) for s in seeds],
+                     until=SEC + SEC // 2, data_dir=res_dir, **kw)
+    out = sim.run_ensemble([_phold(s) for s in seeds], until=3 * SEC,
+                           data_dir=res_dir, resume=True, **kw)
+    for k in range(len(seeds)):
+        assert not _mismatched_leaves(
+            ensemble.world(ref[0], ref[1], k),
+            ensemble.world(out[0], out[1], k)), f"world {k}"
+    assert _world_rows(os.path.join(ref_dir, "windows.jsonl")) == \
+        _world_rows(os.path.join(res_dir, "windows.jsonl"))
+    info = json.load(open(os.path.join(res_dir, "ckpt", "run.json")))
+    assert info["n_worlds"] == len(seeds)
+
+
+def test_run_ensemble_quarantines_poisoned_world(tmp_path):
+    # A deterministic failure confined to world 2 (NaN bits planted
+    # in its srtt lane in the newest stacked anchor) quarantines that
+    # world -- frozen at FROZEN_NOW -- while the survivors finish;
+    # crash.json doubles as the per-world evidence roster.
+    seeds = (3, 5, 7, 11)
+    data = str(tmp_path / "run")
+    kw = dict(checkpoint_every=SEC, supervise=True)
+    sim.run_ensemble([_phold(s) for s in seeds], until=SEC,
+                     data_dir=data, **kw)
+    path = _newest_anchor(data)
+    tes, tep, _ = ensemble.stack([_installed_phold(s) for s in seeds])
+    man = checkpoint.read_manifest(path)
+    ls, lp = checkpoint.load(path, tes, tep)
+    srtt = np.asarray(ls.socks.srtt).copy()
+    srtt[2, 0, 1] = np.int64(NAN_BITS)
+    ls = ls.replace(socks=ls.socks.replace(srtt=srtt))
+    checkpoint.save(path, ls, lp, manifest=man)
+
+    estate, eparams, app, summaries = sim.run_ensemble(
+        [_phold(s) for s in seeds], until=2 * SEC, data_dir=data,
+        resume=True, **kw)
+    assert ensemble.frozen_worlds(estate) == [2]
+    assert [s["quarantined"] for s in summaries] == \
+        [False, False, True, False]
+    assert all(s["events"] > 0 for k, s in enumerate(summaries)
+               if k != 2)
+
+    summary = json.load(open(os.path.join(data, "summary.json")))
+    assert summary["supervise"]["quarantined"] == [2]
+    crash = json.load(open(os.path.join(data, "crash.json")))
+    roster = crash["worlds"]
+    assert roster["n_worlds"] == len(seeds)
+    assert roster["quarantined"] == [2]
+    (member,) = roster["members"]
+    assert member["world"] == 2
+    assert "--world 2" in member["replay"]
+
+
+class TestCliEnsembleResilience:
+    CONFIG = os.path.join(REPO, "examples", "tgen-2host",
+                          "shadow.config.xml")
+
+    def test_flag_validation_names_the_knob(self, capsys, tmp_path):
+        from shadow1_tpu import cli
+        from shadow1_tpu.supervise import RC_USAGE
+        rc = cli.main(["run", self.CONFIG, "--worlds", "2",
+                       "--auto-resume"])
+        assert rc == RC_USAGE
+        assert "--checkpoint-every" in capsys.readouterr().err
+        rc = cli.main(["run", self.CONFIG, "--worlds", "2",
+                       "--checkpoint-every", "2"])
+        assert rc == RC_USAGE
+        assert "--data-directory" in capsys.readouterr().err
+        rc = cli.main(["run", self.CONFIG, "--worlds", "2",
+                       "--checkpoint-every", "2", "--data-directory",
+                       str(tmp_path), "--watchdog", "60"])
+        assert rc == RC_USAGE
+        assert "--auto-resume" in capsys.readouterr().err
+
+    def test_replay_world_member_and_refusals(self, tmp_path, capsys):
+        from shadow1_tpu import cli
+        from shadow1_tpu.supervise import RC_OK, RC_USAGE
+        d = str(tmp_path / "ens")
+        assert cli.main(["run", self.CONFIG, "--worlds", "2",
+                         "--checkpoint-every", "2", "--stop-time", "4",
+                         "--data-directory", d, "--auto-resume",
+                         "--quiet"]) == RC_OK
+        capsys.readouterr()
+        # One member replays solo off the stacked anchors, verified
+        # bitwise against its own windows.jsonl rows.
+        assert cli.main(["replay", "--data-directory", d,
+                         "--world", "1", "--quiet"]) == RC_OK
+        capsys.readouterr()
+        # Ensemble run without --world: refused by name.
+        rc = cli.main(["replay", "--data-directory", d, "--quiet"])
+        assert rc == RC_USAGE
+        assert "--world" in capsys.readouterr().err
+        # Solo run with --world: refused by name.
+        solo = str(tmp_path / "solo")
+        assert cli.main(["run", self.CONFIG, "--checkpoint-every", "2",
+                         "--stop-time", "4", "--data-directory", solo,
+                         "--auto-resume", "--quiet"]) == RC_OK
+        capsys.readouterr()
+        rc = cli.main(["replay", "--data-directory", solo,
+                       "--world", "0", "--quiet"])
+        assert rc == RC_USAGE
+        assert "solo" in capsys.readouterr().err

@@ -467,3 +467,43 @@ class TestClientCli:
         rc = cli.main(["cancel", "r0001"])
         assert rc == RC_USAGE
         assert "--server" in capsys.readouterr().err
+
+
+class TestServedEnsemble:
+    CONFIG = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "tgen-2host", "shadow.config.xml")
+
+    def test_config_worlds_round_trip(self, tmp_path):
+        # A --worlds submit runs under the same per-request
+        # supervision as any config request (the server forces
+        # --auto-resume + checkpointing), and request_metrics.json
+        # stamps the ensemble shape for servescope.
+        data = tmp_path / "data"
+        srv = _start(data)
+        sock = protocol.default_socket(str(data))
+        try:
+            evs = []
+            for ev in protocol.stream(
+                    sock, {"op": "submit", "kind": "config",
+                           "spec": {"config": self.CONFIG,
+                                    "worlds": 2, "stop_time": 3.0,
+                                    "checkpoint_every": 1.0},
+                           "wait": True, "progress": True}):
+                evs.append(ev)
+                if not ev.get("ok", True) or \
+                        ev.get("event") in ("done", "parked"):
+                    break
+            done = evs[-1]
+            assert done.get("event") == "done" and done["rc"] == RC_OK
+            rid = evs[0]["id"]
+            run_dir = os.path.join(str(data), "runs", rid)
+            info = json.load(open(os.path.join(
+                run_dir, "ckpt", "run.json")))
+            assert info["n_worlds"] == 2
+            metrics = json.load(open(os.path.join(
+                run_dir, "request_metrics.json")))
+            assert metrics["n_worlds"] == 2
+            assert metrics["quarantines"] == 0
+        finally:
+            srv.shutdown()

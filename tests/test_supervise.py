@@ -313,6 +313,39 @@ class TestSupervisedRun:
         assert json.loads((tmp_path / "crash.json").read_text())[
             "failure"]["class"] == "hung"
 
+    def test_watchdog_world_count_grace(self, tmp_path):
+        # A launch whose n_worlds differs from the previous graph's
+        # re-opens the compile grace: a vmapped ensemble graph compiles
+        # slower than the solo one it follows, and that cold compile
+        # must not classify as hung (mirrors the megakernel_off /
+        # gather_single grace).
+        from shadow1_tpu import ensemble
+        state, params, app = _bulk()
+        sup = supervise.Supervisor(str(tmp_path), app, quiet=True,
+                                   watchdog_s=0.2)
+        real, ereal = engine.run_chunked, ensemble.run_chunked
+        try:
+            slow = lambda st, *a, **kw: (time.sleep(0.6), st)[1]
+            engine.run_chunked = slow
+            ensemble.run_chunked = slow
+            sup.launch(state, params, SEC)
+            assert sup._warm is True and sup._graph_worlds is None
+            # Stack 2 worlds: a NEW graph, so the slow cold launch
+            # must complete despite the armed 0.2s deadline.
+            estate, eparams, _ = ensemble.stack([_bulk(), _bulk()])
+            out = sup.launch(estate, eparams, SEC)
+            assert out is estate
+            assert sup._warm is True and sup._graph_worlds == 2
+            # The SAME slow ensemble launch warm is a genuine hang.
+            with pytest.raises(supervise.UnrecoveredFailure) as ei:
+                sup.launch(estate, eparams, 2 * SEC)
+        finally:
+            engine.run_chunked = real
+            ensemble.run_chunked = ereal
+        assert ei.value.rc == supervise.RC_FAILED
+        assert json.loads((tmp_path / "crash.json").read_text())[
+            "failure"]["class"] == "hung"
+
 
 class TestReplayReproduces:
     def test_replay_reports_sentinel_violation(self, tmp_path):

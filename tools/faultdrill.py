@@ -36,6 +36,15 @@ Drills (--drill, default "all"):
   derived server/schedule.jsonl reconstructs each request's full
   lifecycle -- no lost transitions, the readmission present, exactly
   one terminal finish.
+* ensemble -- the robustness ladder with a world axis
+  (docs/robustness.md "Ensemble resilience"), three sub-drills against
+  one N-world --worlds reference: SIGKILL + --auto-resume off a
+  STACKED checkpoint (byte-identical per-world windows rows), the same
+  with the newest stacked checkpoint torn (anchors one older), and a
+  NaN poison in ONE world's srtt lane -- the resumed run must
+  quarantine exactly that world (rc 1, crash.json `worlds` roster with
+  per-member replay commands) while every surviving world finishes
+  byte-identical to the reference.
 
 Why NaN and not a counter poison: the conservation sentinel is
 delta-based (it snapshots counters at window open), so corruption
@@ -262,6 +271,190 @@ def drill_nan(config, wd, ref_dir, every, stop):
                     f"sentinel:\n{err2}")
     else:
         print(f"  replay reproduced the violation (rc 1)")
+    return errs
+
+
+# --- the ensemble drill -----------------------------------------------------
+
+ENSEMBLE_WORLDS = 8
+
+
+def _ens_cmd(config: str, data_dir: str, *, every: float, stop: int,
+             worlds: int, resume: bool) -> list:
+    argv = [sys.executable, "-m", "shadow1_tpu", "run", config,
+            "--worlds", str(worlds),
+            "--checkpoint-every", f"{every:g}", "--stop-time", str(stop),
+            "--data-directory", data_dir, "--quiet"]
+    if resume:
+        argv.append("--auto-resume")
+    return argv
+
+
+def _world_rows(path: str) -> dict:
+    """windows.jsonl bytes keyed per world.  Row interleave across
+    worlds is drain-order and legitimately perturbed by the quarantine
+    rung's evidence flush, so ensemble comparisons are per world."""
+    per = {}
+    with open(path, "rb") as f:
+        for line in f:
+            k = json.loads(line).get("world")
+            per.setdefault(k, []).append(line)
+    return {k: b"".join(v) for k, v in per.items()}
+
+
+def _poison_ens_checkpoint(data_dir: str, world_k: int) -> dict:
+    """NaN-poison world `world_k`'s srtt lane in a mid-run STACKED
+    checkpoint and drop every later one, so --auto-resume must anchor
+    on the poisoned state.  Returns the chosen index entry."""
+    import numpy as np
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from shadow1_tpu import checkpoint, ensemble, replay
+
+    ckdir = os.path.join(data_dir, "ckpt")
+    idx_path = os.path.join(ckdir, "index.json")
+    with open(idx_path) as f:
+        idx = json.load(f)
+    entries = sorted(idx["checkpoints"], key=lambda e: e["window"])
+    if len(entries) < 3:
+        raise RuntimeError(
+            f"need >= 3 checkpoints to pick a mid-run one, have "
+            f"{len(entries)} -- lower --checkpoint-every")
+    victim = entries[1]
+    for e in entries[2:]:
+        os.remove(os.path.join(ckdir, e["file"]))
+    idx["checkpoints"] = entries[:2]
+    with open(idx_path, "w") as f:
+        json.dump(idx, f, indent=1)
+
+    # Rebuild every member off the recorded recipe (the per-world sweep
+    # overrides carry the resolved seeds) and stack them into the
+    # template the stacked anchor restores into -- exactly what
+    # `replay --world K` does, minus the slice.
+    info = replay.load_run(data_dir)
+    over = (info.get("sweep") or {}).get("worlds") or []
+    nw = int(info.get("n_worlds") or 1)
+    members = []
+    for k in range(nw):
+        mi = json.loads(json.dumps(info))
+        mi["world"]["args"].update(over[k] if k < len(over) else {})
+        mi["world"]["args"]["devices"] = 1
+        b = replay.rebuild_world(mi, data_dir, want_mesh=False)
+        members.append((b["state"], b["params"], b["app"]))
+    ts, tp, _ = ensemble.stack(members)
+    path = os.path.join(ckdir, victim["file"])
+    man = checkpoint.read_manifest(path)
+    state, params = checkpoint.load(path, ts, tp)
+    srtt = np.asarray(state.socks.srtt).copy()
+    srtt[world_k, 0, 1] = np.int64(NAN_BITS)
+    state = state.replace(socks=state.socks.replace(srtt=srtt))
+    checkpoint.save(path, state, params, manifest=man)
+    return victim
+
+
+def drill_ensemble(config, wd, every, stop, n_worlds=ENSEMBLE_WORLDS):
+    """Ensemble resilience (docs/robustness.md "Ensemble resilience"),
+    three sub-drills against one n-world reference:
+
+    * kill -- SIGKILL the stacked run after its second checkpoint,
+      --auto-resume, expect rc 0 and windows.jsonl byte-identical.
+    * torn -- same kill, newest STACKED checkpoint truncated; resume
+      must anchor one checkpoint older and still match byte-for-byte.
+    * nan -- poison ONE world's srtt lane in a mid-run stacked anchor.
+      Resume must quarantine exactly that world (rc 1, crash.json
+      `worlds` roster naming it with per-member commands) while every
+      SURVIVING world finishes with windows.jsonl rows byte-identical
+      to the reference.
+    """
+    errs = []
+    ref = os.path.join(wd, "ens_ref")
+    print(f"  ensemble reference run ({n_worlds} worlds) ...")
+    rc, out, err = _run(_ens_cmd(config, ref, every=every, stop=stop,
+                                 worlds=n_worlds, resume=True))
+    if rc != 0:
+        return [f"ensemble: reference run failed rc {rc}\n{err}"]
+    ref_sum = _summary(out)
+    ref_rows = _world_rows(os.path.join(ref, "windows.jsonl"))
+
+    for sub in ("kill", "torn"):
+        d = os.path.join(wd, f"ens_{sub}")
+        argv = _ens_cmd(config, d, every=every, stop=stop,
+                        worlds=n_worlds, resume=True)
+        _kill_after_checkpoints(argv, os.path.join(d, "ckpt"))
+        if sub == "torn":
+            files = glob.glob(os.path.join(d, "ckpt", "win_*.npz"))
+            newest = max(files, key=os.path.getmtime)
+            size = os.path.getsize(newest)
+            with open(newest, "r+b") as f:
+                f.truncate(size // 2)
+            print(f"  tore {os.path.basename(newest)} "
+                  f"({size} -> {size // 2} bytes)")
+        rc, out, err = _run(argv)
+        if rc != 0:
+            errs.append(f"ensemble-{sub}: resume exited rc {rc}\n{err}")
+            continue
+        s = _summary(out)
+        if s.get("worlds") != ref_sum.get("worlds"):
+            errs.append(f"ensemble-{sub}: per-world summaries diverged "
+                        f"from reference")
+        got = _world_rows(os.path.join(d, "windows.jsonl"))
+        bad = [k for k in ref_rows if got.get(k) != ref_rows[k]]
+        if bad:
+            errs.append(f"ensemble-{sub}: windows rows diverged for "
+                        f"world(s) {sorted(bad)}")
+        else:
+            print(f"  ensemble-{sub}: resumed bitwise "
+                  f"({n_worlds} worlds)")
+
+    # nan -> quarantine
+    bad_world = n_worlds // 2
+    d = os.path.join(wd, "ens_nan")
+    os.makedirs(d)
+    shutil.copytree(os.path.join(ref, "ckpt"), os.path.join(d, "ckpt"))
+    shutil.copy(os.path.join(ref, "windows.jsonl"),
+                os.path.join(d, "windows.jsonl"))
+    victim = _poison_ens_checkpoint(d, bad_world)
+    print(f"  poisoned srtt[{bad_world},0,1] in {victim['file']} "
+          f"(window {victim['window']})")
+    rc, out, err = _run(_ens_cmd(config, d, every=every, stop=stop,
+                                 worlds=n_worlds, resume=True))
+    if rc != 1:
+        errs.append(f"ensemble-nan: expected rc 1 (quarantined world "
+                    f"-> invariant rc), got {rc}\n{err}")
+        return errs
+    s = _summary(out)
+    if s.get("quarantined") != [bad_world]:
+        errs.append(f"ensemble-nan: summary quarantined "
+                    f"{s.get('quarantined')}, expected [{bad_world}]")
+    crash_path = os.path.join(d, "crash.json")
+    if not os.path.exists(crash_path):
+        errs.append("ensemble-nan: no crash.json written")
+    else:
+        with open(crash_path) as f:
+            crash = json.load(f)
+        w = crash.get("worlds") or {}
+        if w.get("quarantined") != [bad_world]:
+            errs.append(f"ensemble-nan: crash.json quarantined "
+                        f"{w.get('quarantined')}, expected "
+                        f"[{bad_world}]")
+        members = {m.get("world"): m for m in w.get("members") or ()}
+        if bad_world not in members:
+            errs.append(f"ensemble-nan: crash.json members lack world "
+                        f"{bad_world}: {sorted(members)}")
+        elif not any("--world" in str(v)
+                     for v in members[bad_world].values()):
+            errs.append(f"ensemble-nan: member {bad_world} carries no "
+                        f"per-world command: {members[bad_world]}")
+    got = _world_rows(os.path.join(d, "windows.jsonl"))
+    survivors = [k for k in ref_rows if k != bad_world]
+    diverged = [k for k in survivors if got.get(k) != ref_rows[k]]
+    if diverged:
+        errs.append(f"ensemble-nan: SURVIVING world(s) "
+                    f"{sorted(diverged)} diverged from reference")
+    elif not any(e.startswith("ensemble-nan") for e in errs):
+        print(f"  ensemble-nan: world {bad_world} quarantined, "
+              f"{len(survivors)} survivors bitwise")
     return errs
 
 
@@ -551,8 +744,12 @@ def main(argv=None) -> int:
     ap.add_argument("config", help="shadow.config.xml to drill with "
                     "(the server drill uses a built-in phold world)")
     ap.add_argument("--drill",
-                    choices=("all", "kill", "torn", "nan", "server"),
+                    choices=("all", "kill", "torn", "nan", "server",
+                             "ensemble"),
                     default="all")
+    ap.add_argument("--worlds", type=int, default=ENSEMBLE_WORLDS,
+                    metavar="N",
+                    help="world count for the ensemble drill")
     ap.add_argument("--checkpoint-every", type=float, default=2.0,
                     metavar="SECONDS")
     ap.add_argument("--stop-time", type=int, default=8,
@@ -566,14 +763,17 @@ def main(argv=None) -> int:
     config = os.path.abspath(args.config)
     wd = args.workdir or tempfile.mkdtemp(prefix="faultdrill_")
     os.makedirs(wd, exist_ok=True)
-    drills = (("kill", "torn", "nan", "server") if args.drill == "all"
-              else (args.drill,))
+    drills = (("kill", "torn", "nan", "server", "ensemble")
+              if args.drill == "all" else (args.drill,))
 
     ref_sum = None
     ref_dir = os.path.join(wd, "ref")
     for name in drills:
         shutil.rmtree(os.path.join(wd, name), ignore_errors=True)
-    if set(drills) - {"server"}:
+    if "ensemble" in drills:
+        for sub in ("ens_ref", "ens_kill", "ens_torn", "ens_nan"):
+            shutil.rmtree(os.path.join(wd, sub), ignore_errors=True)
+    if set(drills) - {"server", "ensemble"}:
         print(f"faultdrill: reference run ({args.stop_time}s sim, "
               f"checkpoint every {args.checkpoint_every:g}s) ...")
         # A stale ref from an earlier --keep run would auto-resume (and
@@ -605,6 +805,14 @@ def main(argv=None) -> int:
                                     args.stop_time)
             except RuntimeError as e:
                 errs = [f"server: {e}"]
+        elif name == "ensemble":
+            try:
+                errs = drill_ensemble(config, wd,
+                                      args.checkpoint_every,
+                                      args.stop_time,
+                                      n_worlds=args.worlds)
+            except RuntimeError as e:
+                errs = [f"ensemble: {e}"]
         else:
             errs = drill_nan(config, wd, ref_dir,
                              args.checkpoint_every, args.stop_time)

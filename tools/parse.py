@@ -345,9 +345,15 @@ def parse_ensemble(data_dir: str) -> dict | None:
         "sweep": summary.get("sweep"),
         "worlds": worlds,
     }
+    if summary.get("supervise") is not None:
+        # Supervised ensembles (docs/robustness.md "Ensemble
+        # resilience"): surface the quarantine roster and ladder walk.
+        out["supervise"] = summary["supervise"]
+    if summary.get("outcome"):
+        out["outcome"] = summary["outcome"]
     if not rows:
-        out["note"] = ("no digests.jsonl: first-divergence columns "
-                       "need a --digest-every run")
+        out["note"] = ("no digests -- first-divergence unavailable, "
+                       "rerun with --digest-every")
     return out
 
 
