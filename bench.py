@@ -236,6 +236,17 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
             # host-side npz wall time, so benchdiff refuses a cadence
             # mismatch; bench.py never checkpoints.
             "checkpoint_every": None,
+            # Pipeline stamp: the async window pipeline overlaps host
+            # drains with device windows on the checkpointed path, so
+            # pipelined and sequential wall-clocks measure different
+            # launch loops -- benchdiff refuses a both-stamped
+            # mismatch.  bench.py never checkpoints, so no pipeline.
+            "pipeline": None,
+            # Batching stamp: continuous batching packs concurrent
+            # server requests onto one vmapped train, so a batched
+            # round's walls are not comparable to solo ones.  The solo
+            # probe never batches.
+            "batched": False,
             # Sentinel/supervise stamps: the sentinel block adds in-loop
             # invariant counters to the traced graph, and supervision
             # adds host-side checks per launch, so benchdiff refuses a
@@ -365,6 +376,8 @@ def main_ensemble(n_worlds: int, gate_against: str | None = None) -> int:
             "lineage": None,
             "digest": None,
             "checkpoint_every": None,
+            "pipeline": None,
+            "batched": False,
             "sentinel": False,
             "supervise": False,
             "serve": False,
@@ -393,17 +406,20 @@ def main_ensemble(n_worlds: int, gate_against: str | None = None) -> int:
 
 # SERVED rung (--serve K): the Servescope observability probe.  K
 # identical phold builder requests go through a live resident run
-# server (one worker, so requests queue and the affinity path is
-# exercised); the aggregate queue-wait, affinity hit rate, and
-# requests/s land in a "server" block built from each run's
-# request_metrics.json.  A much smaller world than the solo probe --
-# the rung measures the scheduler, not the engine.
+# server (one worker, so requests queue); with max_lanes > 1 the
+# compatible requests co-batch onto one vmapped lane train
+# (shadow1_tpu/batch.py), so the rung measures the packed schedule:
+# aggregate queue-wait, affinity hit rate, batched picks, per-request
+# walls, and host-drain overlap land in a "server" block built from
+# each run's request_metrics.json.  A much smaller world than the solo
+# probe -- the rung measures the scheduler, not the engine.
 SERVE_HOSTS = 1024
 SERVE_SIM_SECONDS = 1
 
 
 def main_served(k: int, queue_limit: int,
-                gate_against: str | None = None) -> int:
+                gate_against: str | None = None,
+                max_lanes: int = 4) -> int:
     import tempfile
     import threading
 
@@ -436,7 +452,7 @@ def main_served(k: int, queue_limit: int,
             as data_dir:
         srv = server.Server(data_dir, workers=1,
                             queue_limit=max(queue_limit, k),
-                            quiet=True).start()
+                            max_lanes=max_lanes, quiet=True).start()
         try:
             t0 = time.perf_counter()
             threads = [threading.Thread(target=_submit, args=(i,))
@@ -462,6 +478,10 @@ def main_served(k: int, queue_limit: int,
     hits = sum(1 for m in per_req if m.get("affinity_hit"))
     events = sum(m["events"] for m in per_req
                  if m.get("events") is not None)
+    walls = [m.get("wall_s") for m in per_req
+             if m.get("wall_s") is not None]
+    overlaps = [m.get("host_drain_overlap_pct") for m in per_req
+                if m.get("host_drain_overlap_pct") is not None]
     result = {
         "metric": "phold_events_per_sec",
         "value": round(events / span, 2),
@@ -479,6 +499,16 @@ def main_served(k: int, queue_limit: int,
             # Served runs checkpoint on the server's cadence (the
             # crash-safety contract), unlike the solo probe.
             "checkpoint_every": 2.0,
+            # Served runs go through sim.run's checkpointed path, whose
+            # async window pipeline is on by default; benchdiff refuses
+            # to compare against a --no-pipeline round.
+            "pipeline": True,
+            # Continuous batching: with max_lanes > 1 the K concurrent
+            # same-shape requests share one vmapped train, so the
+            # per-request walls below measure the packed schedule --
+            # not comparable to a solo (max_lanes=1) round.
+            "batched": max_lanes > 1,
+            "max_lanes": max_lanes,
             "sentinel": False,
             "supervise": True,
             "serve": True,
@@ -503,6 +533,20 @@ def main_served(k: int, queue_limit: int,
             "queue_wait_max_s": round(max(waits), 4),
             "affinity_hits": hits,
             "affinity_hit_rate": round(hits / k, 4),
+            # Continuous batching evidence: how many requests were
+            # packed onto a live train, each request's own wall, and
+            # the per-request host-drain overlap (the pipeline's
+            # hide-the-drain-wall metric).  A batched round's
+            # request_wall_max_s sits far below K x the solo wall.
+            "batched_picks": sum(1 for m in per_req
+                                 if m.get("pick_reason") == "batched"),
+            "request_wall_s": [round(w, 4) for w in walls],
+            "request_wall_mean_s": round(sum(walls) / len(walls), 4)
+            if walls else None,
+            "request_wall_max_s": round(max(walls), 4) if walls
+            else None,
+            "host_drain_overlap_pct_mean": round(
+                sum(overlaps) / len(overlaps), 2) if overlaps else None,
             "compiles_total": sum(m.get("compiles") or 0
                                   for m in per_req),
             "events": events,
@@ -655,6 +699,8 @@ def main_multichip(n_devices: int, gate_against: str | None = None) -> int:
             "lineage": None,
             "digest": None,
             "checkpoint_every": None,
+            "pipeline": None,
+            "batched": False,
             "sentinel": False,
             "supervise": False,
             "serve": False,
@@ -730,6 +776,12 @@ if __name__ == "__main__":
                     help="admission-queue bound for --serve (raised to "
                          "K when smaller; stamped in the config block "
                          "so benchdiff buckets served rounds by it)")
+    ap.add_argument("--max-lanes", type=int, default=4, metavar="N",
+                    help="continuous-batching width for --serve: up to "
+                         "N compatible requests share one vmapped lane "
+                         "train (1 disables batching; stamped in the "
+                         "config block so benchdiff refuses a batched "
+                         "vs solo compare)")
     ap.add_argument("--worlds", type=int, default=None, metavar="N",
                     help="ENSEMBLE rung: run N phold worlds as one "
                          "vmapped batch (shadow1_tpu/ensemble, one "
@@ -745,7 +797,8 @@ if __name__ == "__main__":
     if ns.worlds:
         sys.exit(main_ensemble(ns.worlds, ns.gate_against))
     if ns.serve:
-        sys.exit(main_served(ns.serve, ns.queue_limit, ns.gate_against))
+        sys.exit(main_served(ns.serve, ns.queue_limit, ns.gate_against,
+                             max_lanes=ns.max_lanes))
     if ns.devices:
         sys.exit(main_multichip(ns.devices, ns.gate_against))
     # The TPU tunnel's compile service occasionally drops a request

@@ -1,0 +1,398 @@
+"""Continuous batching: concurrent server requests share one live
+vmapped ensemble (docs/robustness.md "Continuous batching").
+
+K submitted builder requests with the same shape hint (server.py
+_shape_hint: builder name + shape-determining kwargs) describe worlds
+in ONE shape bucket -- each would compile and launch the same graph.
+Running them back-to-back serializes K device trains; running them as
+K worker threads contends for the device.  This module instead packs
+them onto the leading world axis of one ensemble (ensemble.stack) and
+drives every lane to its OWN launch target per global launch
+(ensemble.run_until_lanes), so K requests cost one launch train.
+
+The train is CONTINUOUS: lanes join and leave while it runs.  A lane
+that reaches its stop time (or parks, cancels, times out, or trips the
+sentinel) is frozen at ensemble.FROZEN_NOW -- the quarantine parking
+mechanics -- and its slot becomes claimable; newly queued compatible
+requests are claimed into free slots at launch boundaries
+(LaneTrain.claim_more) and start mid-train without a recompile (the
+lane targets are traced, not static).
+
+Bitwise identity with solo runs is the load-bearing contract: lane j
+advances `min(tau_j + CHUNK_NS, next_sync(tau_j, stop_j, every_ns_j))`
+per global launch -- exactly the launch-target sequence
+engine.run_chunked walks for the same world solo on the same
+checkpoint grid -- and window ends clip at launch targets, so every
+lane's windows.jsonl, checkpoints, and summary are byte-identical to
+the same request run alone (the tier-0 pin in tests/test_batch.py).
+Lanes never wait for each other's sim time: a lane at t=3s and a lane
+at t=9s ride the same compiled graph.
+
+Failure handling differs from the solo path in ONE documented way:
+batched lanes have no per-request Supervisor, so a sentinel violation
+surrenders immediately (crash.json + rc 1 + lane freeze) instead of
+walking the degradation ladder -- the other lanes keep running, which
+is the same isolation the ensemble quarantine rung provides.  Host
+exceptions fail the whole train (every unsettled lane settles rc 3),
+matching a solo run's worker behavior.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+
+from .core import engine
+from .core.simtime import SIMTIME_ONE_SECOND
+from .supervise import RC_INVARIANT, RC_OK
+
+SEC = SIMTIME_ONE_SECOND
+
+
+class Lane:
+    """One request riding the train: its solo-built world, its drains
+    and checkpointer, and its launch-grid bookkeeping.  `state` holds
+    the solo pytree only until the lane is inserted onto the ensemble
+    axis; after that the train's stacked state is the ground truth and
+    per-lane slices are taken at boundaries (ensemble.world)."""
+
+    def __init__(self, req, run_dir, control, emit, state, params, app,
+                 stop_ns, every_ns, flight, ck, sentinel, resumed=None):
+        self.req = req
+        self.run_dir = run_dir
+        self.control = control
+        self.emit = emit
+        self.state = state       # solo state, until inserted
+        self.params = params     # solo params (original statics)
+        self.app = app
+        self.stop_ns = int(stop_ns)
+        self.every_ns = int(every_ns)
+        self.flight = flight
+        self.ck = ck
+        self.sentinel = sentinel
+        self.resumed = resumed
+        self.tau = int(state.now)
+        self.boundary = None     # next_sync target, set per launch
+        self.done = False
+        self.rc = None
+        self.summary = None
+        self.settled = False     # server settled this lane's request
+
+    def close(self):
+        try:
+            self.flight.close()
+        except Exception:
+            pass
+
+
+def prepare(req, run_dir, control, emit, *, default_ck_s=2.0):
+    """Build one request's lane exactly as sim._run_checkpointed would
+    build the solo run: builder world, flight recorder + sentinel
+    blocks, auto-resume from the newest readable checkpoint (trim +
+    append windows.jsonl), ckpt/run.json recipe, and the win_0 anchor.
+    The run.json recipe is identical to the solo server path's, so
+    `shadow1-tpu replay` rebuilds batched-run checkpoints with the
+    same template."""
+    from . import replay as replay_mod
+    from . import sim, trace
+
+    spec = req.spec
+    name = spec["name"]
+    kwargs = dict(spec.get("kwargs") or {})
+    ck_s = float(spec.get("checkpoint_every") or default_ck_s)
+    every_ns = int(ck_s * SEC)
+    state, params, app = getattr(sim, f"build_{name}")(**kwargs)
+    hosts_real = int(state.hosts.num_hosts)
+    stop_ns = int(params.stop_time)
+    state = trace.ensure_flight_recorder(state, shards=1)
+    state = trace.ensure_sentinel(state)
+    os.makedirs(run_dir, exist_ok=True)
+
+    resumed = None
+    if glob.glob(os.path.join(run_dir, "ckpt", "win_*.npz")):
+        try:
+            path, man = replay_mod.find_checkpoint(run_dir, None)
+        except FileNotFoundError:
+            path = None          # all torn: start the run over
+        if path is not None:
+            from . import checkpoint as _ckpt
+            from . import supervise as _sup_mod
+            state, params = _ckpt.load(path, state, params)
+            resumed = {"file": os.path.basename(path),
+                       "window": int(man["window"]),
+                       "t_ns": int(man["t_ns"])}
+            _sup_mod.trim_windows(
+                os.path.join(run_dir, "windows.jsonl"),
+                resumed["window"])
+            if emit is not None:
+                emit({"event": "resumed", **resumed})
+
+    flight = trace.FlightDrain(
+        os.path.join(run_dir, "windows.jsonl"),
+        start=resumed["window"] if resumed else 0,
+        mode="a" if resumed else "w")
+    ck = replay_mod.Checkpointer(run_dir, every_ns, devices=1,
+                                 bucket=False, hosts_real=hosts_real)
+    write_recipe = resumed is None
+    if resumed is not None:
+        try:
+            replay_mod.load_run(run_dir)
+            write_recipe = False
+        except (FileNotFoundError, ValueError, json.JSONDecodeError):
+            write_recipe = True
+    if write_recipe:
+        replay_mod.write_run_json(run_dir, {
+            "world": {"kind": "builder", "name": name,
+                      "kwargs": kwargs},
+            "hb_ns": None, "every_ns": every_ns, "stop_ns": stop_ns,
+            "chunk_ns": engine.CHUNK_NS, "devices": 1,
+            "bucket": False, "hosts_real": hosts_real,
+            "scope": None, "profile": False,
+            "flight_rows": int(state.fr.steps.shape[0]),
+            "lineage": None, "digest": None, "digest_rows": None,
+            "sentinel": True, "supervise": True})
+    if resumed is None:
+        ck.save(state, params)   # win_0: a replay anchor always exists
+
+    return Lane(req, run_dir, control, emit, state, params, app,
+                stop_ns, every_ns, flight, ck,
+                trace.SentinelDrain(), resumed=resumed)
+
+
+def _insert(estate, eparams, j, lane):
+    """Place a prepared lane's solo world at ensemble slot j.  The
+    static `megakernel` flag is forced off to match the stacked
+    params' pytree structure (ensemble.stack does the same); the
+    lane's OWN params keep the original statics, and since params
+    arrays never change on device, checkpoints saved from lane.params
+    are byte-identical to the solo run's."""
+    import jax
+    st, pp = lane.state, lane.params.replace(megakernel=False)
+    estate = jax.tree_util.tree_map(
+        lambda e, x: e.at[j].set(x), estate, st)
+    eparams = jax.tree_util.tree_map(
+        lambda e, x: e.at[j].set(x), eparams, pp)
+    return estate, eparams
+
+
+class LaneTrain:
+    """The shared launch train: a fixed-width ensemble (max_lanes
+    slots) whose occupied lanes advance on their own solo launch grids
+    through one compiled graph (ensemble.run_until_lanes -- one jit
+    cache entry serves every co-batched request;
+    ensemble.lanes_cache_size is the graph-count pin).
+
+    `claim_more(n)` (optional) is called whenever slots are free --
+    at start, at every boundary that retired a lane, and when the
+    train would otherwise stop -- and returns up to n newly prepared
+    Lanes to insert; the server wires it to its queue so compatible
+    requests join mid-flight.  `on_retire(lane)` (optional) fires the
+    moment a lane leaves the train (finished, parked, cancelled,
+    timed out, or sentinel-tripped), with lane.rc / control.outcome
+    already set -- the server settles the request there, so early
+    finishers report without waiting for the train."""
+
+    def __init__(self, max_lanes=4, claim_more=None, on_retire=None):
+        self.max_lanes = max(1, int(max_lanes))
+        self.claim_more = claim_more
+        self.on_retire = on_retire
+        self.lanes = []          # every lane ever aboard, join order
+
+    def _retire(self, lane):
+        lane.done = True
+        lane.close()
+        if self.on_retire is not None:
+            self.on_retire(lane)
+
+    def _boundary(self, lane, estate, eparams, j):
+        """Per-lane launch-boundary work, identical in order to the
+        solo loop: sentinel check, flight drain, checkpoint cadence,
+        progress emit, control poll, stop-time finish.  Returns True
+        when the lane retired (caller freezes slot j)."""
+        import jax.numpy as jnp
+
+        from . import ensemble, trace
+        ls, _lp = ensemble.world(estate, eparams, j)
+        prof = lane.req.profiler
+        try:
+            lane.sentinel.check(ls, prof)
+        except trace.SentinelViolation as e:
+            # No per-request Supervisor on the train: surrender this
+            # lane immediately (evidence drain + crash.json + rc 1)
+            # rather than walking the ladder; the other lanes keep
+            # running -- quarantine-style isolation.
+            try:
+                lane.flight.drain(ls, prof)
+            except Exception:
+                pass             # evidence must not mask the failure
+            self._surrender(lane, e)
+            self._retire(lane)
+            return True
+        lane.flight.drain(ls, prof)
+        lane.ck.maybe(ls, lane.params, lane.tau)
+        if lane.emit is not None:
+            lane.emit({"event": "progress", "t_ns": int(lane.tau),
+                       "stop_ns": int(lane.stop_ns),
+                       "line": f"[shadow1-tpu] "
+                               f"{lane.tau / SEC:g}"
+                               f"/{lane.stop_ns / SEC:g}s\n"})
+        act = lane.control.poll() if lane.control is not None else None
+        if act is not None:
+            if act == "park":
+                lane.ck.save(ls, lane.params)
+                lane.control.outcome = "parked"
+                if lane.emit is not None:
+                    lane.emit({"event": "parked", "t_ns": int(lane.tau),
+                               "window": int(ls.n_windows)})
+            else:
+                lane.control.outcome = ("cancelled" if act == "cancel"
+                                        else "timed_out")
+            lane.rc = RC_OK      # the server maps the outcome, not rc
+            self._retire(lane)
+            return True
+        if lane.tau >= lane.stop_ns:
+            lane.summary = {
+                "simulated_seconds": int(ls.now) / SEC,
+                "windows": int(ls.n_windows),
+                "packets_sent": int(jnp.sum(ls.hosts.pkts_sent)),
+                "err_flags": int(ls.err)}
+            if lane.emit is not None:
+                lane.emit({"event": "summary", "summary": lane.summary})
+            lane.rc = RC_OK if int(ls.err) == 0 else RC_INVARIANT
+            self._retire(lane)
+            return True
+        return False
+
+    def _surrender(self, lane, exc):
+        """crash.json for a sentinel-tripped lane: same failure schema
+        as the Supervisor's surrender (failure class + sentinel row +
+        replay hint), with `ladder: []` recording that no rungs exist
+        on a batched lane."""
+        row = exc.row
+        crash = {
+            "failure": {"class": "sentinel",
+                        "type": type(exc).__name__,
+                        "message": str(exc),
+                        "note": "batched lane: no degradation ladder; "
+                                "resubmit solo to walk the rungs"},
+            "window": int(row.get("first_bad_window", -1)),
+            "t_ns": int(row.get("first_bad_t", -1)),
+            "sentinel": row,
+            "checkpoint": None,
+            "ladder": [],
+        }
+        try:
+            from . import replay as replay_mod
+            path, man = replay_mod.find_checkpoint(lane.run_dir, None)
+            crash["checkpoint"] = {
+                "file": os.path.basename(path),
+                "window": None if man is None else int(man["window"]),
+                "t_ns": None if man is None else int(man["t_ns"])}
+        except Exception:
+            pass
+        if crash["window"] >= 0:
+            crash["replay"] = (f"shadow1-tpu replay --data-directory "
+                               f"{lane.run_dir} --window "
+                               f"{crash['window']}")
+        out = os.path.join(lane.run_dir, "crash.json")
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(crash, f, indent=1, sort_keys=True, default=str)
+        os.replace(tmp, out)
+        lane.req.error = str(exc)
+        lane.rc = RC_INVARIANT
+        if lane.emit is not None:
+            lane.emit({"event": "crash", "path": out, "crash": crash})
+
+    def run(self, lanes):
+        """Drive the train until every lane has retired and claim_more
+        yields nothing.  `lanes` are the initially co-picked requests
+        (1..max_lanes, already prepared)."""
+        import jax
+
+        from . import ensemble, replay as replay_mod
+
+        w = self.max_lanes
+        assert lanes and len(lanes) <= w
+        self.lanes = list(lanes)
+        # Pad empty slots with copies of lane 0's world; they start
+        # frozen and no window bodies ever run in them (the engine
+        # predicate is false at FROZEN_NOW), so they are pure shape
+        # ballast until a joiner claims the slot.
+        slots = list(lanes) + [None] * (w - len(lanes))
+        estate, eparams, app = ensemble.stack(
+            [(ln.state, ln.params, ln.app) for ln in lanes]
+            + [(lanes[0].state, lanes[0].params, lanes[0].app)]
+            * (w - len(lanes)))
+        if w > len(lanes):
+            estate = ensemble.freeze_worlds(
+                estate, list(range(len(lanes), w)))
+        for ln in lanes:
+            ln.state = None      # the ensemble axis owns it now
+
+        def _claim(freeable):
+            nonlocal estate, eparams
+            if self.claim_more is None or not freeable:
+                return False
+            joined = self.claim_more(len(freeable)) or []
+            for ln in joined:
+                j = freeable.pop(0)
+                estate, eparams = _insert(estate, eparams, j, ln)
+                slots[j] = ln
+                ln.state = None
+                self.lanes.append(ln)
+            return bool(joined)
+
+        _claim([j for j, ln in enumerate(slots)
+                if ln is None or ln.done])
+        while True:
+            active = [j for j, ln in enumerate(slots)
+                      if ln is not None and not ln.done]
+            if not active:
+                if not _claim([j for j, ln in enumerate(slots)
+                               if ln is None or ln.done]):
+                    return
+                continue
+            targets = []
+            for j, ln in enumerate(slots):
+                if ln is None or ln.done:
+                    # Frozen lanes re-park themselves: the engine tail
+                    # rewrite now=t_target keeps now at FROZEN_NOW.
+                    targets.append(ensemble.FROZEN_NOW)
+                    continue
+                ln.boundary = replay_mod.next_sync(
+                    ln.tau, ln.stop_ns, every_ns=ln.every_ns)
+                targets.append(min(ln.tau + engine.CHUNK_NS,
+                                   ln.boundary))
+            t0 = time.perf_counter()
+            estate = ensemble.run_until_lanes(estate, eparams, app,
+                                              targets)
+            jax.block_until_ready(estate)
+            t1 = time.perf_counter()
+            froze = []
+            for j in active:
+                ln = slots[j]
+                ln.tau = int(targets[j])
+                if ln.req.profiler is not None:
+                    ln.req.profiler.add_span("device_window", t0, t1,
+                                             t_ns=ln.tau, lane=j)
+                if ln.tau < ln.boundary:
+                    continue     # mid-grid chunk, no boundary work
+                if self._boundary(ln, estate, eparams, j):
+                    froze.append(j)
+            if froze:
+                estate = ensemble.freeze_worlds(estate, froze)
+                _claim(froze)
+
+    def abort(self, error):
+        """A host exception killed the train: close and fail every
+        lane that has not already settled (the server maps these to
+        rc 3, exactly as a solo worker crash would)."""
+        for ln in self.lanes:
+            if not ln.done:
+                ln.done = True
+                ln.close()
+                if ln.req.error is None:
+                    ln.req.error = error

@@ -280,6 +280,16 @@ def _add_run_flags(r, *, config_required: bool = True):
                         "only after the first launch completes: a cold "
                         "graph's compile time never counts against the "
                         "deadline (docs/robustness.md)")
+    r.add_argument("--no-pipeline", action="store_true",
+                   help="disable the async window pipeline and restore "
+                        "the sequential launch -> block -> drain order "
+                        "at every window boundary.  The pipeline is "
+                        "host-side only -- the compiled graphs and "
+                        "every artifact row are byte-identical either "
+                        "way (docs/observability.md \"Async window "
+                        "pipeline\") -- so this is an escape hatch for "
+                        "debugging wall-clock interleavings, not a "
+                        "semantics switch")
 
 
 def _add_client_flags(p):
@@ -438,6 +448,13 @@ def _parser():
                          "strict warm-graph affinity; raise it when "
                          "the accelerator has memory for concurrent "
                          "worlds)")
+    sv.add_argument("--max-lanes", type=int, default=4, metavar="N",
+                    help="continuous-batching width (default 4): up "
+                         "to N concurrent same-shape builder requests "
+                         "share one vmapped launch train, each lane "
+                         "bitwise-identical to the same request run "
+                         "solo (docs/robustness.md 'Continuous "
+                         "batching'); 1 disables batching")
     sv.add_argument("--checkpoint-every", type=float, default=2.0,
                     metavar="SECONDS",
                     help="default checkpoint cadence applied to "
@@ -1054,7 +1071,8 @@ def _run_ensemble_config(args, *, control=None, emit=None,
                 supervise=sup_opts,
                 resume=supervise_on,
                 control=control, emit=emit,
-                run_extra=run_extra, world_cmds=world_cmds)
+                run_extra=run_extra, world_cmds=world_cmds,
+                pipeline=not getattr(args, "no_pipeline", False))
         except EnsembleMismatch as e:
             raise CliError(f"worlds do not stack: {e}")
         except UnrecoveredFailure as e:
@@ -1385,11 +1403,34 @@ def run_config(args, *, control=None, emit=None, profiler=None) -> int:
     # heartbeat, event log, counters, flight / scope / lineage / digest
     # rings -- the checkpointed sim.run loop drains through the same
     # helper, so a new ring slots into both loops in one place.
-    from .sim import Drains
+    from .sim import Drains, WindowPipeline
     drains = Drains(tracker=tracker, log=drain, flight=flight,
                     scope=scope, spans=spans, digests=digests,
                     profiler=profiler)
+    # The async window pipeline (sim.WindowPipeline,
+    # docs/observability.md): dispatch window N+1 before draining
+    # window N, with byte-identical artifacts.  The substrate bridge
+    # owns its own launch/sync cadence (managed-process RPCs ARE host
+    # work between launches), so bridged runs stay sequential.
+    pipe = None
+    prev_sync = None
+    if not getattr(args, "no_pipeline", False) and substrate is None:
+        pipe = WindowPipeline(profiler)
+        if profiler is not None and profiler.sync:
+            # --profile syncs per chunk inside the engine loop, which
+            # would serialize the pipeline; the pipeline records its
+            # own dispatch->ready device_window spans instead.
+            prev_sync = True
+            profiler.sync = False
+
     def _close_drains():
+        if pipe is not None:
+            try:
+                pipe.flush()  # best-effort: land the pending window
+            except Exception:
+                pass
+        if prev_sync and profiler is not None:
+            profiler.sync = True
         for closer in (flight, drain, spans, digests, scope):
             if closer is not None:
                 try:
@@ -1404,6 +1445,8 @@ def run_config(args, *, control=None, emit=None, profiler=None) -> int:
                 # The run server asked this request to stop at a launch
                 # boundary: park (checkpoint now, resume on the next
                 # --auto-resume life), cancel, or a --timeout expiry.
+                if pipe is not None:
+                    pipe.flush()  # the last window's drains land first
                 if act == "park":
                     if ck is not None:
                         ck.save(state, params)
@@ -1431,21 +1474,41 @@ def run_config(args, *, control=None, emit=None, profiler=None) -> int:
             # ends clip at launch targets, so the flight-recorder
             # record depends on this schedule).
             t_next = next_sync(t, int(stop), hb_ns, ck_every_ns)
+            t0 = time.perf_counter()
             if substrate is not None:
                 state = _bridge.run(substrate, state, params, app, t_next)
             elif sup is not None:
-                state = sup.launch(state, params, t_next)
+                state = sup.launch(
+                    state, params, t_next,
+                    overlap=pipe.settle if pipe is not None else None)
             elif mesh is not None:
                 state = parallel_mod.mesh_run_chunked(state, params, app,
                                                       t_next, mesh=mesh)
             else:
                 state = engine.run_chunked(state, params, app, t_next)
             t = t_next
-            drains.drain_all(state, t)
-            if ck is not None:
-                ck.maybe(state, params, t)
-            if progress is not None:
-                progress.update(state, t)
+            if pipe is None:
+                drains.drain_all(state, t)
+                if ck is not None:
+                    ck.maybe(state, params, t)
+                if progress is not None:
+                    progress.update(state, t)
+                continue
+            if sup is None:
+                # Drain window N while window N+1 executes (supervised
+                # launches ran this via the overlap hook, between
+                # dispatch and their watchdog-bounded block).
+                pipe.settle()
+
+            def _boundary(st=state, ts=t):
+                drains.drain_all(st, ts)
+                if ck is not None:
+                    ck.maybe(st, params, ts)
+                if progress is not None:
+                    progress.update(st, ts)
+            # Supervised launches block (and span) internally; t0=None
+            # keeps the pipeline from re-recording their window.
+            pipe.push(state, _boundary, t0 if sup is None else None)
     except UnrecoveredFailure as e:
         _close_drains()
         print(f"error: {e}", file=sys.stderr)
@@ -1454,6 +1517,10 @@ def run_config(args, *, control=None, emit=None, profiler=None) -> int:
             emit({"event": "crash", "rc": e.rc, "crash": e.crash,
                   "path": e.path})
         return e.rc
+    if pipe is not None:
+        pipe.flush()  # the drain point of the final window
+    if prev_sync and profiler is not None:
+        profiler.sync = True
     if progress is not None:
         progress.update(state, t, force=True)
     jax.block_until_ready(state)

@@ -60,6 +60,7 @@ I64 = jnp.int64
 
 __all__ = [
     "EnsembleMismatch", "stack", "replicate", "run_until", "run_chunked",
+    "run_until_lanes", "lanes_cache_size",
     "world", "world_count", "shard_worlds", "cache_size",
     "FROZEN_NOW", "freeze_worlds", "frozen_worlds",
 ]
@@ -198,6 +199,43 @@ def cache_size() -> int:
     """Compiled-graph count of the ensemble runner (ladder rung 10
     asserts one graph serves the whole ensemble)."""
     return _run_until._cache_size()
+
+
+@functools.partial(jax.jit, static_argnames=("app",))
+def _run_until_lanes(estate, eparams, t_targets, *, app):
+    return jax.vmap(
+        lambda s, p, tt: engine.run_until_impl(s, p, app, tt)
+    )(estate, eparams, t_targets)
+
+
+def run_until_lanes(estate, eparams, app, t_targets):
+    """Run every lane to its OWN launch target: `t_targets` is an [N]
+    i64 vector vmapped alongside the state, so lanes at different sim
+    times advance on their own grids inside one compiled graph -- the
+    continuous-batching launch primitive (batch.LaneTrain,
+    docs/robustness.md "Continuous batching").
+
+    The targets are traced, not static: varying them never recompiles,
+    and the graph is distinct from `run_until`'s (a separate jit cache,
+    so ensemble graph-count pins are unaffected).  An idle or finished
+    lane must first be PARKED at `FROZEN_NOW` (freeze_worlds -- its
+    `now` leaf rewritten, exactly the quarantine mechanics) and then
+    passed `FROZEN_NOW` as its target: the window predicate is false on
+    iteration one (no window bodies run) and the engine tail rewrite
+    `now=t_target` re-parks the lane, so the freeze is self-maintaining
+    across launches with no per-launch re-park.  Passing FROZEN_NOW as
+    the target of an UNFROZEN lane would instead run it to the end of
+    time -- park first, then target.  A lane passed its own current
+    `now` is carried through unchanged (zero windows run and the tail
+    rewrite is the identity)."""
+    return _run_until_lanes(estate, eparams,
+                            jnp.asarray(t_targets, I64), app=app)
+
+
+def lanes_cache_size() -> int:
+    """Compiled-graph count of the per-lane runner (the batched-server
+    pin asserts one graph serves every co-batched request)."""
+    return _run_until_lanes._cache_size()
 
 
 def run_chunked(estate, eparams, app, t_target: int,

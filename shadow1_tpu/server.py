@@ -318,13 +318,15 @@ class Server:
     def __init__(self, data_dir: str, *, queue_limit: int = 8,
                  workers: int = 1, checkpoint_every: float = 2.0,
                  watchdog: float | None = None, auto_resume: bool = False,
-                 metrics_every: float = 2.0, quiet: bool = True):
+                 metrics_every: float = 2.0, quiet: bool = True,
+                 max_lanes: int = 4):
         self.data_dir = data_dir
         self.sdir = os.path.join(data_dir, "server")
         self.runs_dir = os.path.join(data_dir, "runs")
         self.sock_path = protocol.default_socket(data_dir)
         self.queue_limit = int(queue_limit)
         self.workers = max(1, int(workers))
+        self.max_lanes = max(1, int(max_lanes))
         self.checkpoint_every = float(checkpoint_every)
         self.watchdog = watchdog
         self.auto_resume = bool(auto_resume)
@@ -912,12 +914,15 @@ class Server:
                     self._cond.wait(0.25)
                 if self._stopping:
                     return
-                req = self._pick_locked(widx)
-                if req is None:
+                batch = self._pick_batch_locked(widx)
+                if not batch:
                     continue
-            self.metrics.worker_start(widx, req.id)
+            self.metrics.worker_start(widx, batch[0].id)
             try:
-                self._execute(req)
+                if len(batch) == 1:
+                    self._execute(batch[0])
+                else:
+                    self._execute_batch(widx, batch)
             finally:
                 self.metrics.worker_done(widx)
 
@@ -946,7 +951,60 @@ class Server:
         self.metrics.pick(req.affinity_hit)
         return req
 
-    def _execute(self, req: Request) -> None:
+    def _batchable(self, req) -> bool:
+        """A request the lane train can carry: a builder world with
+        none of the per-request instrumentation/layout knobs that
+        change the state pytree or need a solo run loop (devices,
+        bucket, scope, lineage, digests)."""
+        if req.kind != "builder":
+            return False
+        spec = req.spec
+        return not any(spec.get(k) for k in
+                       ("devices", "bucket", "scope", "trace_packets",
+                        "digest_every"))
+
+    def _claim_batchable_locked(self, hint, worker, n) -> list:
+        """Pop up to n queued batchable requests whose shape hint
+        matches `hint` (they share the train's compiled graph by
+        construction).  Caller holds the lock."""
+        out = []
+        i = 0
+        while i < len(self._queue) and len(out) < n:
+            r = self._reqs[self._queue[i]]
+            if self._batchable(r) and r.shape_hint == hint:
+                self._queue.pop(i)
+                r.worker = worker
+                r.affinity_hit = True
+                r.pick_reason = "batched"
+                self.metrics.pick(True)
+                out.append(r)
+            else:
+                i += 1
+        return out
+
+    def _pick_batch_locked(self, worker: int) -> list:
+        """One scheduling decision: the affinity/FIFO pick, plus -- when
+        it is batchable and compatible peers are queued -- up to
+        max_lanes-1 of them, co-batched onto one lane train
+        (docs/robustness.md "Continuous batching").  A lone batchable
+        request still runs solo (the solo compiled graph stays warm
+        for affinity); trains form when >= 2 compatible requests are
+        queued together, and accept later joiners mid-flight."""
+        req = self._pick_locked(worker)
+        if req is None:
+            return []
+        batch = [req]
+        if self.max_lanes > 1 and self._batchable(req):
+            batch += self._claim_batchable_locked(
+                req.shape_hint, worker, self.max_lanes - 1)
+        return batch
+
+    def _begin_exec(self, req: Request):
+        """Move a picked request into RUNNING: close its queued
+        segment, refuse it if it timed out while queued (returns
+        None), then stamp control/profiler/journal and return
+        (run_dir, emit) -- the per-request evidence-harvesting emit
+        closure shared by the solo and batched paths."""
         from . import trace
         now = time.time()
         with self._lock:
@@ -960,7 +1018,7 @@ class Server:
                 f"request {req.id} spent {now - req.submitted:.1f}s "
                 f"queued, past its --timeout {req.timeout:g}s; raise "
                 f"--timeout or submit to a less loaded server"))
-            return
+            return None
         deadline = None
         if req.timeout:
             deadline = time.monotonic() + (req.timeout
@@ -1008,20 +1066,14 @@ class Server:
                 self.metrics.event("quarantines", n)
             self._emit(req, ev)
 
-        try:
-            rc = self._dispatch(req, run_dir, req.control, emit)
-        except BaseException as e:  # noqa: BLE001 -- worker must survive
-            req.error = f"{type(e).__name__}: {e}"
-            if not self.quiet:
-                traceback.print_exc()
-            rc = RC_FAILED
-        finally:
-            # The run loop installs req.profiler process-globally; drop
-            # it so later requests (or the warm thread) can't attribute
-            # their compiles to a finished request.  Best-effort under
-            # workers>1 -- the install slot is global by design.
-            if trace.current() is req.profiler:
-                trace.install(None)
+        return run_dir, emit
+
+    def _settle_exec(self, req: Request, rc: int) -> None:
+        """Map a finished execution onto the request's terminal (or
+        parked) state -- the shared tail of the solo and batched
+        paths.  The control outcome outranks rc: park re-journals for
+        the next --auto-resume life, cancel/timeout carry their own
+        exit codes."""
         outcome = req.control.outcome
         if outcome == "parked":
             with self._lock:
@@ -1043,6 +1095,104 @@ class Server:
                 f"boundary; raise --timeout for longer scenarios"))
         else:
             self._finish(req, rc)
+
+    def _execute(self, req: Request) -> None:
+        from . import trace
+        begun = self._begin_exec(req)
+        if begun is None:
+            return
+        run_dir, emit = begun
+        try:
+            rc = self._dispatch(req, run_dir, req.control, emit)
+        except BaseException as e:  # noqa: BLE001 -- worker must survive
+            req.error = f"{type(e).__name__}: {e}"
+            if not self.quiet:
+                traceback.print_exc()
+            rc = RC_FAILED
+        finally:
+            # The run loop installs req.profiler process-globally; drop
+            # it so later requests (or the warm thread) can't attribute
+            # their compiles to a finished request.  Best-effort under
+            # workers>1 -- the install slot is global by design.
+            if trace.current() is req.profiler:
+                trace.install(None)
+        self._settle_exec(req, rc)
+
+    def _begin_lane(self, req: Request):
+        """_begin_exec + batch.prepare for one train member; maps
+        preparation failures (bad builder name/kwargs) onto the same
+        exit codes _dispatch would give them.  Returns the prepared
+        batch.Lane, or None when the request settled already."""
+        from . import batch as batch_mod
+        begun = self._begin_exec(req)
+        if begun is None:
+            return None
+        run_dir, emit = begun
+        try:
+            return batch_mod.prepare(
+                req, run_dir, req.control, emit,
+                default_ck_s=self.checkpoint_every)
+        except (ValueError, FileNotFoundError, KeyError, TypeError,
+                AttributeError, json.JSONDecodeError) as e:
+            req.error = f"{type(e).__name__}: {e}"
+            self._settle_exec(req, RC_USAGE)
+            return None
+        except BaseException as e:  # noqa: BLE001 -- worker must survive
+            req.error = f"{type(e).__name__}: {e}"
+            if not self.quiet:
+                traceback.print_exc()
+            self._settle_exec(req, RC_FAILED)
+            return None
+
+    def _execute_batch(self, widx: int, reqs: list) -> None:
+        """Run co-picked compatible requests as ONE lane train
+        (batch.LaneTrain): each request is a lane of a live vmapped
+        ensemble, advancing on its own solo launch grid through one
+        compiled graph, with per-request checkpoints/windows.jsonl/
+        metrics byte-identical to solo runs.  Queued compatible
+        requests join free lanes at launch boundaries; each lane
+        settles the moment it retires."""
+        from . import batch as batch_mod
+        from . import trace
+        hint = reqs[0].shape_hint
+        lanes = [ln for ln in (self._begin_lane(r) for r in reqs)
+                 if ln is not None]
+        if not lanes:
+            return
+
+        def claim_more(n):
+            with self._lock:
+                if self._draining or self._stopping:
+                    return []
+                claimed = self._claim_batchable_locked(hint, widx, n)
+            return [ln for ln in (self._begin_lane(r) for r in claimed)
+                    if ln is not None]
+
+        def on_retire(lane):
+            if not lane.settled:
+                lane.settled = True
+                self._settle_exec(lane.req, lane.rc
+                                  if lane.rc is not None else RC_FAILED)
+
+        # Compiles during the train attribute to the primary request's
+        # profiler; per-lane spans/drains go to each request's own.
+        trace.install(lanes[0].req.profiler)
+        train = batch_mod.LaneTrain(self.max_lanes,
+                                    claim_more=claim_more,
+                                    on_retire=on_retire)
+        try:
+            train.run(lanes)
+        except BaseException as e:  # noqa: BLE001 -- worker must survive
+            if not self.quiet:
+                traceback.print_exc()
+            train.abort(f"{type(e).__name__}: {e}")
+            for lane in train.lanes:
+                if not lane.settled:
+                    lane.settled = True
+                    self._settle_exec(lane.req, RC_FAILED)
+        finally:
+            if trace.current() is lanes[0].req.profiler:
+                trace.install(None)
 
     def _dispatch(self, req, run_dir, control, emit) -> int:
         from .cli import CliError
@@ -1219,7 +1369,11 @@ class Server:
             "wall_s": wall,
             "compiles": m.get("compiles", 0),
             "compile_ms": m.get("compile_ms", 0.0),
-            "device_step_ms": phase_ms(("device_step",)),
+            # Pipelined runs record dispatch->ready walls as
+            # device_window spans (the engine's per-chunk device_step
+            # spans are dispatch-only blips); prefer them when present.
+            "device_step_ms": phase_ms(("device_window",))
+            or phase_ms(("device_step",)),
             "drain_ms": phase_ms(trace._HOST_DRAIN_PHASES),
             "host_drain_overlap_pct": m.get("host_drain_overlap_pct",
                                             0.0),
@@ -1322,7 +1476,8 @@ def serve(args) -> int:
                  checkpoint_every=args.checkpoint_every,
                  watchdog=args.watchdog,
                  auto_resume=args.auto_resume,
-                 quiet=args.quiet)
+                 quiet=args.quiet,
+                 max_lanes=getattr(args, "max_lanes", 4))
     try:
         srv.start()
     except (OSError, RuntimeError) as e:

@@ -324,10 +324,63 @@ class Drains:
                 ring.drain(state, self.profiler)
 
 
+class WindowPipeline:
+    """Double-buffered launch-boundary state: the async window pipeline
+    (docs/observability.md "Async window pipeline").
+
+    The sequential loops do launch -> block -> drain at every boundary,
+    so every host drain serializes with the device and
+    host_drain_overlap_pct sits at ~0.  Pipelined, the loop dispatches
+    window N+1 BEFORE draining window N: JAX's asynchronous dispatch
+    returns as soon as the launch is enqueued, the host then drains
+    window N's rings (reading window N's retained device buffers, which
+    are final -- the N+1 launch wrote fresh ones) while the device
+    executes window N+1, and the block_until_ready moves one boundary
+    later, to the drain point (`settle`).  Every drain still sees
+    exactly the state it saw synchronously, at the same sim time, so
+    heartbeat/windows/scope/lineage/digest rows and checkpoint files
+    are byte-identical; only the wall-clock interleaving changes.
+
+    `push(state, boundary, t0)` hands over a freshly dispatched
+    window: its un-awaited output and the zero-argument callable that
+    runs its boundary work (drains + checkpoint + progress).  `settle`
+    is the drain point -- block on the pending window, record its
+    dispatch->ready `device_window` span (when `t0` was given), run its
+    boundary work -- and is idempotent, so control actions (park /
+    cancel), supervisor retries, failures, and the end of the run can
+    all call it (or `flush`, its alias) first and lose nothing."""
+
+    def __init__(self, profiler=None):
+        self.profiler = profiler
+        self._pending = None
+
+    def push(self, state, boundary, t0_wall=None):
+        assert self._pending is None, "push() without settle()"
+        self._pending = (state, boundary, t0_wall)
+
+    def settle(self):
+        if self._pending is None:
+            return
+        state, boundary, t0 = self._pending
+        self._pending = None
+        import time as _time
+
+        import jax
+        jax.block_until_ready(state)
+        if self.profiler is not None and t0 is not None:
+            self.profiler.add_span("device_window", t0,
+                                   _time.perf_counter())
+        boundary()
+
+    def flush(self):
+        self.settle()
+
+
 def run(state, params, app, until=None, profiler=None, devices=None,
         bucket=False, scope=None, lineage=None, digest=None,
         checkpoint_every=None, checkpoint_dir=None, checkpoint_world=None,
-        supervise=None, control=None, emit=None, resume=False):
+        supervise=None, control=None, emit=None, resume=False,
+        pipeline=True):
     """Run to `until` (default: params.stop_time).
 
     With `profiler` (a trace.Profiler), the run is profiled: the
@@ -421,6 +474,14 @@ def run(state, params, app, until=None, profiler=None, devices=None,
     `checkpoint_dir` (if any) before running, trimming windows.jsonl
     to the resume window and appending from there -- the same bitwise
     trim-and-append contract as the CLI's --auto-resume.
+
+    `pipeline` (default True) enables the async window pipeline on the
+    checkpointed path: window N+1 is dispatched before window N's
+    drains run, so the host drain wall hides under device execution
+    (WindowPipeline; docs/observability.md).  Artifacts are
+    byte-identical either way -- `pipeline=False` (the CLI's
+    --no-pipeline) restores the sequential launch->block->drain order
+    without changing any compiled graph.
     """
     h_real = int(state.hosts.num_hosts)
     if bucket:
@@ -438,7 +499,7 @@ def run(state, params, app, until=None, profiler=None, devices=None,
             digest=digest, every_ns=int(checkpoint_every),
             ckdir=checkpoint_dir, world=checkpoint_world,
             hosts_real=h_real, supervise=supervise, control=control,
-            emit=emit, resume=resume)
+            emit=emit, resume=resume, pipeline=pipeline)
     if supervise:
         raise ValueError(
             "sim.run: supervise requires checkpoint_every and "
@@ -517,7 +578,8 @@ def run(state, params, app, until=None, profiler=None, devices=None,
 def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
                       scope, every_ns, ckdir, world, hosts_real,
                       lineage=None, digest=None, supervise=None,
-                      control=None, emit=None, resume=False):
+                      control=None, emit=None, resume=False,
+                      pipeline=True):
     """run()'s checkpointing path: same block installs as the plain
     paths (mesh pad, then scope/counters -- replay._rebuild_builder
     mirrors this order exactly), plus a flight recorder, a windows.jsonl
@@ -525,9 +587,11 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
     (replay.next_sync with hb_ns=None).  `resume` restores the newest
     readable checkpoint first (fully-built template, then load, then
     trim-and-append); `control`/`emit` are the run server's park/
-    cancel/timeout and progress-relay hooks (see run's docstring)."""
+    cancel/timeout and progress-relay hooks (see run's docstring);
+    `pipeline` double-buffers windows (WindowPipeline)."""
     import json
     import os
+    import time as _time
 
     from . import replay as replay_mod
     from . import trace
@@ -647,6 +711,15 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
             emit=emit, **opts)
     drains = Drains(flight=flight, spans=spans, digests=digests,
                     profiler=profiler)
+    pipe = WindowPipeline(profiler) if pipeline else None
+    prev_sync = None
+    if pipe is not None and profiler is not None and profiler.sync:
+        # --profile runs sync per chunk inside the engine loop, which
+        # would serialize the pipeline; the pipeline records its own
+        # dispatch->ready device_window spans instead, so per-chunk
+        # blocking is turned off for the duration of this run.
+        prev_sync = True
+        profiler.sync = False
     try:
         if resumed is None:
             ck.save(state, params)      # win_0: a replay anchor always exists
@@ -659,6 +732,8 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
                 # and resumes on the next --auto-resume life; cancel
                 # and timeout just stop (the worker maps the outcome
                 # to its rc).
+                if pipe is not None:
+                    pipe.flush()  # the last window's drains land first
                 if act == "park":
                     ck.save(state, params)
                     control.outcome = "parked"
@@ -670,23 +745,63 @@ def _run_checkpointed(state, params, app, t, *, profiler, devices, bucket,
                                        else "timed_out")
                 return state
             tt = replay_mod.next_sync(tt, int(t), every_ns=every_ns)
+            t0 = _time.perf_counter()
             if sup is not None:
-                state = sup.launch(state, params, tt)
+                state = sup.launch(
+                    state, params, tt,
+                    overlap=pipe.settle if pipe is not None else None)
             elif mesh is not None:
                 from . import parallel
                 state = parallel.mesh_run_chunked(state, params, app, tt,
                                                   mesh=mesh)
             else:
                 state = engine.run_chunked(state, params, app, tt)
-            drains.drain_all(state)
-            ck.maybe(state, params, tt)
-            if emit is not None:
-                emit({"event": "progress", "t_ns": int(tt),
-                      "stop_ns": int(t),
-                      "line": f"[shadow1-tpu] {tt / simtime.SIMTIME_ONE_SECOND:g}"
-                              f"/{int(t) / simtime.SIMTIME_ONE_SECOND:g}s\n"})
+            if pipe is None:
+                drains.drain_all(state)
+                ck.maybe(state, params, tt)
+                if emit is not None:
+                    emit({"event": "progress", "t_ns": int(tt),
+                          "stop_ns": int(t),
+                          "line": f"[shadow1-tpu] "
+                                  f"{tt / simtime.SIMTIME_ONE_SECOND:g}"
+                                  f"/{int(t) / simtime.SIMTIME_ONE_SECOND:g}"
+                                  f"s\n"})
+                continue
+            if sup is None:
+                # Drain window N while window N+1 executes (supervised
+                # launches ran this via the overlap hook, between their
+                # dispatch and their watchdog-bounded block).
+                pipe.settle()
+
+            def _boundary(st=state, ts=tt):
+                drains.drain_all(st)
+                ck.maybe(st, params, ts)
+                if emit is not None:
+                    emit({"event": "progress", "t_ns": int(ts),
+                          "stop_ns": int(t),
+                          "line": f"[shadow1-tpu] "
+                                  f"{ts / simtime.SIMTIME_ONE_SECOND:g}"
+                                  f"/{int(t) / simtime.SIMTIME_ONE_SECOND:g}"
+                                  f"s\n"})
+            # Supervised launches block (and span) internally, so the
+            # pipeline must not re-record their window; t0=None skips it.
+            pipe.push(state, _boundary, t0 if sup is None else None)
+        if pipe is not None:
+            pipe.flush()  # the drain point of the final window
         return state
     finally:
+        if pipe is not None:
+            try:
+                # Already settled on every non-exception path (flush is
+                # idempotent); after a launch failure this lands the
+                # last good window's rows before the files close, and
+                # best-effort is right -- a drain error must not mask
+                # the failure being handled.
+                pipe.flush()
+            except Exception:
+                pass
+        if prev_sync and profiler is not None:
+            profiler.sync = True
         flight.close()
         if spans is not None:
             spans.close()
@@ -706,7 +821,7 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
                  hostnames=None, sweep=None, quiet: bool = True,
                  checkpoint_every=None, supervise=None, resume=False,
                  control=None, emit=None, run_extra=None,
-                 world_cmds=None):
+                 world_cmds=None, pipeline=True):
     """Run N worlds as one vmapped ensemble (docs/ensemble.md).
 
     `worlds` is a sequence of built (state, params, app) triples -- one
@@ -740,6 +855,10 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
     ckpt/run.json (the CLI records its world recipe and netem bucket
     there so `replay --world K` can rebuild one member); `world_cmds`
     is forwarded to the Supervisor for crash.json member commands.
+
+    `pipeline` (default True) double-buffers windows exactly as in
+    sim.run: window N's per-world drains run while window N+1 executes
+    on the device (WindowPipeline), with byte-identical artifacts.
 
     Returns (estate, eparams, app, summaries): the final stacked state
     and one summary dict per world (with `quarantined` flags under
@@ -936,9 +1055,9 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
         if write_recipe:
             replay_mod.write_run_json(data_dir, info)
 
-    def drain_all(t):
+    def drain_all(st, t):
         for k, dr in enumerate(drains):
-            ws = jax.tree_util.tree_map(lambda x: x[k], estate)
+            ws = jax.tree_util.tree_map(lambda x: x[k], st)
             dr.drain_all(ws, t)
 
     ck = None
@@ -975,6 +1094,7 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
 
     wall0 = _time.monotonic()
     outcome = None
+    pipe = WindowPipeline() if pipeline else None
     try:
         if ck is not None and resumed is None:
             ck.save(estate, eparams)  # win_0: an anchor always exists
@@ -982,6 +1102,8 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
         while t < until:
             act = control.poll() if control is not None else None
             if act is not None:
+                if pipe is not None:
+                    pipe.flush()  # the last window's drains land first
                 if act == "park":
                     ck.save(estate, eparams)
                     control.outcome = "parked"
@@ -999,24 +1121,57 @@ def run_ensemble(worlds, until=None, *, data_dir=None, scope=None,
             else:
                 t = min(t + int(chunk_ns), until)
             if sup is not None:
-                estate = sup.launch(estate, eparams, t)
+                estate = sup.launch(
+                    estate, eparams, t,
+                    overlap=pipe.settle if pipe is not None else None)
             elif ck is not None:
                 estate = ensemble.run_chunked(estate, eparams, app, t,
                                               chunk_ns=int(chunk_ns))
             else:
                 estate = ensemble.run_until(estate, eparams, app, t)
-            drain_all(t)
-            if ck is not None:
-                ck.maybe(estate, eparams, t)
-            if emit is not None:
-                emit({"event": "progress", "t_ns": int(t),
-                      "stop_ns": until,
-                      "line": f"[shadow1-tpu] "
-                              f"{t / simtime.SIMTIME_ONE_SECOND:g}"
-                              f"/{until / simtime.SIMTIME_ONE_SECOND:g}"
-                              f"s\n"})
+            if pipe is None:
+                drain_all(estate, t)
+                if ck is not None:
+                    ck.maybe(estate, eparams, t)
+                if emit is not None:
+                    emit({"event": "progress", "t_ns": int(t),
+                          "stop_ns": until,
+                          "line": f"[shadow1-tpu] "
+                                  f"{t / simtime.SIMTIME_ONE_SECOND:g}"
+                                  f"/{until / simtime.SIMTIME_ONE_SECOND:g}"
+                                  f"s\n"})
+                continue
+            if sup is None:
+                # Drain window N while window N+1 executes (supervised
+                # launches ran this via their overlap hook already).
+                pipe.settle()
+
+            def _boundary(st=estate, ts=t):
+                drain_all(st, ts)
+                if ck is not None:
+                    ck.maybe(st, eparams, ts)
+                if emit is not None:
+                    emit({"event": "progress", "t_ns": int(ts),
+                          "stop_ns": until,
+                          "line": f"[shadow1-tpu] "
+                                  f"{ts / simtime.SIMTIME_ONE_SECOND:g}"
+                                  f"/{until / simtime.SIMTIME_ONE_SECOND:g}"
+                                  f"s\n"})
+            pipe.push(estate, _boundary)
+        if pipe is not None:
+            pipe.flush()
         jax.block_until_ready(estate)
     finally:
+        if pipe is not None:
+            try:
+                # Already settled on every non-exception path (flush is
+                # idempotent); after a launch failure this lands the
+                # last good window's rows before the files close, and
+                # best-effort is right -- a drain error must not mask
+                # the failure being handled.
+                pipe.flush()
+            except Exception:
+                pass
         wall = _time.monotonic() - wall0
         for dr in drains:
             for ring in (dr.log, dr.flight, dr.scope, dr.spans,

@@ -61,6 +61,7 @@ import json
 import os
 import sys
 import threading
+import time
 
 from .core import engine
 from .core.simtime import SIMTIME_ONE_SECOND
@@ -268,13 +269,20 @@ class Supervisor:
 
     # -- public ----------------------------------------------------------
 
-    def launch(self, state, params, t_next):
-        """Advance `state` to sim time `t_next` under supervision."""
+    def launch(self, state, params, t_next, overlap=None):
+        """Advance `state` to sim time `t_next` under supervision.
+
+        `overlap`, when given, is a zero-argument callable run between
+        the (asynchronous) dispatch of this launch and the
+        block_until_ready that completes it -- the window pipeline
+        passes its settle() here so the PREVIOUS window's host drains
+        execute while this window runs on the device.  It must be
+        idempotent: a retried launch calls it again."""
         from . import trace
         t_next = int(t_next)
         while True:
             try:
-                out = self._attempt(state, params, t_next)
+                out = self._attempt(state, params, t_next, overlap)
                 if self.quarantined:
                     # The engine tail rewrites now=t_target on EVERY
                     # vmap lane; re-park the quarantine set so frozen
@@ -308,7 +316,7 @@ class Supervisor:
 
     # -- execution -------------------------------------------------------
 
-    def _attempt(self, state, params, t_next):
+    def _attempt(self, state, params, t_next, overlap=None):
         from .core.state import world_count
         n_worlds = world_count(state)
         if n_worlds != self._graph_worlds:
@@ -341,21 +349,23 @@ class Supervisor:
             return engine.run_chunked(state, exec_params, self.app,
                                       t_next, chunk_ns=self.chunk_ns)
 
-        if not self.watchdog_s or not self._warm:
-            # The watchdog is armed only after the first launch of the
-            # current graph completes: a cold launch pays XLA
-            # compilation, whose wall-clock says nothing about a wedged
-            # device, so it never counts against the deadline.  Rungs
-            # that change the graph (megakernel_off, gather_single)
-            # re-open the grace window.
+        import jax
+        from . import trace
+        t0 = time.perf_counter()
+        if not self.watchdog_s:
+            # Unsupervised wall-clock: no watchdog thread at all.
             out = go()
+            if overlap is not None:
+                overlap()
+            jax.block_until_ready(out)
             self._warm = True
+            trace.current().add_span("device_window", t0,
+                                     time.perf_counter(), t_ns=t_next)
             return out
         box = {}
 
         def work():
             try:
-                import jax
                 out = go()
                 jax.block_until_ready(out)  # async dispatch would hide
                 box["out"] = out            # a wedged device
@@ -365,13 +375,34 @@ class Supervisor:
         th = threading.Thread(target=work, daemon=True,
                               name="shadow1-supervised-launch")
         th.start()
-        th.join(self.watchdog_s)
-        if th.is_alive():
-            raise HungLaunch(
-                f"device launch did not complete within "
-                f"{self.watchdog_s:g}s wall-clock")
+        # The overlap hook -- the window pipeline's drain point for the
+        # PREVIOUS window -- runs on the calling thread while the device
+        # executes this window in the watchdog thread.  The deadline
+        # (th.join below) is measured from AFTER the hook returns:
+        # hung-run detection clocks drain-point completion, not
+        # dispatch, so a deep pipeline's deferred host work is never
+        # misclassified as a wedged device.
+        if overlap is not None:
+            overlap()
+        if not self._warm:
+            # The watchdog is armed only after the first launch of the
+            # current graph completes: a cold launch pays XLA
+            # compilation, whose wall-clock says nothing about a wedged
+            # device, so it never counts against the deadline.  Rungs
+            # that change the graph (megakernel_off, gather_single)
+            # re-open the grace window.
+            th.join()
+        else:
+            th.join(self.watchdog_s)
+            if th.is_alive():
+                raise HungLaunch(
+                    f"device launch did not complete within "
+                    f"{self.watchdog_s:g}s wall-clock")
         if "exc" in box:
             raise box["exc"]
+        self._warm = True
+        trace.current().add_span("device_window", t0,
+                                 time.perf_counter(), t_ns=t_next)
         return box["out"]
 
     # -- the ladder ------------------------------------------------------

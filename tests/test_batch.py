@@ -1,0 +1,196 @@
+"""Continuous batching: concurrent same-shape requests share one live
+vmapped ensemble (shadow1_tpu/batch.py; docs/robustness.md
+"Continuous batching").
+
+The contract under test:
+
+* A batched lane's artifacts are bitwise the solo server run's: same
+  windows.jsonl, same checkpoint set, same run.json -- each lane
+  advances on its own solo launch grid, so joining a train changes
+  the throughput, never the trajectory (the tier-0 pin).
+* One compiled graph serves every lane of the train
+  (ensemble.lanes_cache_size), whatever mix of stop times rides it.
+* Scheduling is stamped: the primary keeps its solo pick_reason, every
+  co-picked or mid-flight joiner records pick_reason "batched" in
+  request_metrics.json.
+
+tools/faultdrill.py's `server-batch` drill covers the real-SIGKILL
+mid-flight version through subprocesses; these tests stay in-process.
+"""
+
+import glob
+import json
+import os
+import time
+
+import pytest
+
+from shadow1_tpu import ensemble, protocol, server, sim, trace
+from shadow1_tpu.core import simtime
+
+SEC = simtime.SIMTIME_ONE_SECOND
+
+# Two shape-compatible phold worlds (same ShapeKey: only seed and stop
+# time differ) plus a mid-flight joiner.
+KW_A = dict(num_hosts=8, msgs_per_host=2, seed=3, stop_time=3 * SEC)
+KW_B = dict(num_hosts=8, msgs_per_host=2, seed=7, stop_time=5 * SEC)
+KW_J = dict(num_hosts=8, msgs_per_host=2, seed=11, stop_time=4 * SEC)
+CK_S = 1.0
+
+
+def _solo_ref(out_dir, kw):
+    """The solo reference: sim.run with exactly the flags the server
+    applies to a builder request."""
+    state, params, app = sim.build_phold(**kw)
+    return sim.run(state, params, app,
+                   checkpoint_every=int(CK_S * SEC),
+                   checkpoint_dir=str(out_dir),
+                   checkpoint_world=("phold", dict(kw)),
+                   supervise={"watchdog_s": None, "quiet": True},
+                   profiler=trace.Profiler(sync=False, counters=False),
+                   resume=True)
+
+
+def _spec(kw):
+    return {"name": "phold", "kwargs": dict(kw),
+            "checkpoint_every": CK_S}
+
+
+def _enqueue_locked(srv, specs):
+    """Enqueue all specs under one lock hold with one notify, so the
+    single worker co-picks them as a train deterministically."""
+    ids = []
+    with srv._lock:
+        for spec in specs:
+            rid = f"r{srv._counter:04d}"
+            srv._counter += 1
+            req = server.Request(rid, "builder", spec)
+            srv._log({"ev": "submit", "id": rid, "kind": "builder",
+                      "spec": spec, "timeout": None,
+                      "t": req.submitted})
+            srv._reqs[rid] = req
+            srv._queue.append(rid)
+            ids.append(rid)
+        srv._cond.notify_all()
+    return ids
+
+
+def _wait_done(sock, rid, timeout=600):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        rec = protocol.request(sock, {"op": "status", "id": rid})["run"]
+        if rec["state"] in (protocol.DONE, protocol.FAILED,
+                            protocol.CANCELLED):
+            return rec
+        time.sleep(0.2)
+    raise AssertionError(f"timeout waiting for {rid}")
+
+
+def _windows(d):
+    with open(os.path.join(str(d), "windows.jsonl"), "rb") as f:
+        return f.read()
+
+
+def _ckpts(d):
+    return sorted(os.path.basename(p) for p in
+                  glob.glob(os.path.join(str(d), "ckpt", "*.npz")))
+
+
+def _metrics(data, rid):
+    with open(os.path.join(str(data), "runs", rid,
+                           "request_metrics.json")) as f:
+        return json.load(f)
+
+
+class TestBatchedRoundTripPin:
+    def test_cobatched_requests_bitwise_solo(self, tmp_path):
+        # The batching pin: two co-queued compatible requests share
+        # one train and each produces the byte-identical artifacts of
+        # its solo server run.  (Tier-1: the solo references plus the
+        # train cost ~3 min, too heavy for the tier-0 budget --
+        # tools/smoke.py carries the pipeline pin instead.)
+        _solo_ref(tmp_path / "refA", KW_A)
+        _solo_ref(tmp_path / "refB", KW_B)
+        data = tmp_path / "data"
+        srv = server.Server(str(data), workers=1, max_lanes=4,
+                            queue_limit=4, quiet=True).start()
+        sock = protocol.default_socket(str(data))
+        graphs0 = ensemble.lanes_cache_size()
+        try:
+            ids = _enqueue_locked(srv, [_spec(KW_A), _spec(KW_B)])
+            recs = [_wait_done(sock, rid) for rid in ids]
+            for rec in recs:
+                assert rec["state"] == protocol.DONE
+                assert rec["rc"] == 0
+                assert rec["summary"]["err_flags"] == 0
+            # Scheduling stamps: primary fifo, co-pick batched.
+            assert _metrics(data, ids[0])["pick_reason"] == "fifo"
+            assert _metrics(data, ids[1])["pick_reason"] == "batched"
+            # Bitwise solo, per lane: drains, checkpoint set, recipe.
+            for rid, ref in ((ids[0], "refA"), (ids[1], "refB")):
+                run_dir = data / "runs" / rid
+                assert _windows(run_dir) == _windows(tmp_path / ref)
+                assert _ckpts(run_dir) == _ckpts(tmp_path / ref)
+                with open(run_dir / "ckpt" / "run.json") as f:
+                    got = json.load(f)
+                with open(tmp_path / ref / "ckpt" / "run.json") as f:
+                    assert got == json.load(f)
+            # The whole train ran through one compiled lane graph.
+            assert ensemble.lanes_cache_size() - graphs0 <= 1
+            resp = protocol.request(sock, {"op": "shutdown",
+                                           "drain": True})
+            assert resp["ok"]
+            srv.wait()
+        finally:
+            srv.shutdown()
+
+
+class TestMidFlightJoin:
+    def test_joiner_joins_live_train(self, tmp_path, monkeypatch):
+        # A compatible request that arrives while a train is in flight
+        # joins at the next window boundary instead of waiting for the
+        # train to finish -- and is still bitwise its solo run.
+        _solo_ref(tmp_path / "refJ", KW_J)
+        # Slow the lane launches so the train is reliably alive when
+        # the joiner's submit lands (trajectory untouched).
+        real = ensemble.run_until_lanes
+
+        def slow(*a, **kw):
+            time.sleep(0.3)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(ensemble, "run_until_lanes", slow)
+        data = tmp_path / "data"
+        srv = server.Server(str(data), workers=1, max_lanes=4,
+                            queue_limit=4, quiet=True).start()
+        sock = protocol.default_socket(str(data))
+        try:
+            ids = _enqueue_locked(srv, [_spec(KW_A), _spec(KW_B)])
+            # Wait for the train to anchor, then submit the joiner.
+            t0 = time.time()
+            while time.time() - t0 < 300:
+                rec = protocol.request(sock, {"op": "status",
+                                              "id": ids[0]})["run"]
+                if rec["state"] == protocol.RUNNING:
+                    break
+                assert rec["state"] == protocol.QUEUED
+                time.sleep(0.05)
+            resp = protocol.request(sock, {"op": "submit",
+                                           "kind": "builder",
+                                           "spec": _spec(KW_J)})
+            assert resp["ok"]
+            ids.append(resp["id"])
+            recs = [_wait_done(sock, rid) for rid in ids]
+            for rec in recs:
+                assert rec["state"] == protocol.DONE and rec["rc"] == 0
+            m = _metrics(data, ids[2])
+            assert m["pick_reason"] == "batched"
+            assert m["affinity_hit"] is True
+            assert _windows(data / "runs" / ids[2]) == \
+                _windows(tmp_path / "refJ")
+            resp = protocol.request(sock, {"op": "shutdown",
+                                           "drain": True})
+            assert resp["ok"]
+            srv.wait()
+        finally:
+            srv.shutdown()

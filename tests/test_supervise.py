@@ -346,6 +346,41 @@ class TestSupervisedRun:
         assert json.loads((tmp_path / "crash.json").read_text())[
             "failure"]["class"] == "hung"
 
+    def test_watchdog_overlap_grace(self, tmp_path):
+        # Regression for the async window pipeline: launch() runs the
+        # overlap hook -- the pipeline's drain point for the PREVIOUS
+        # window -- on the calling thread while the device executes,
+        # and the watchdog deadline is measured from AFTER the hook
+        # returns.  A host-side drain longer than --watchdog says
+        # nothing about a wedged device and must not rc-3.
+        state, params, app = _bulk()
+        sup = supervise.Supervisor(str(tmp_path), app, quiet=True,
+                                   watchdog_s=0.2)
+        sup._warm = True  # armed: no compile grace in play
+        drained = []
+        real = engine.run_chunked
+        try:
+            engine.run_chunked = lambda st, *a, **kw: st
+            out = sup.launch(state, params, SEC,
+                             overlap=lambda: (drained.append(1),
+                                              time.sleep(0.6)))
+        finally:
+            engine.run_chunked = real
+        assert out is state and drained == [1]
+        assert not (tmp_path / "crash.json").exists()
+        # A genuinely wedged device is still caught with a hook
+        # present: the hook only moves the measurement point.
+        try:
+            engine.run_chunked = lambda *a, **kw: time.sleep(30)
+            with pytest.raises(supervise.UnrecoveredFailure) as ei:
+                sup.launch(state, params, 2 * SEC,
+                           overlap=lambda: time.sleep(0.3))
+        finally:
+            engine.run_chunked = real
+        assert ei.value.rc == supervise.RC_FAILED
+        assert json.loads((tmp_path / "crash.json").read_text())[
+            "failure"]["class"] == "hung"
+
 
 class TestReplayReproduces:
     def test_replay_reports_sentinel_violation(self, tmp_path):

@@ -264,6 +264,33 @@ def _queue_limit_config(d: dict):
     return cfg["queue_limit"]
 
 
+def _pipeline_config(d: dict):
+    """Whether a run used the async window pipeline: the
+    config.pipeline stamp (True/False, None when the run never
+    checkpoints so no pipeline was in play), or _UNSTAMPED for files
+    written before bench.py stamped it.  The pipeline overlaps host
+    drains with device windows, so pipelined and sequential
+    (--no-pipeline) wall-clocks measure different launch loops; legacy
+    files stay comparable (the checkpoint rule)."""
+    cfg = d.get("config")
+    if not isinstance(cfg, dict) or "pipeline" not in cfg:
+        return _UNSTAMPED
+    return cfg["pipeline"]
+
+
+def _batched_config(d: dict):
+    """Whether a served round ran with continuous batching: the
+    config.batched stamp (bool), or _UNSTAMPED for pre-stamp files.
+    With batching, concurrent same-shape requests share one vmapped
+    lane train, so per-request walls and requests/s measure the packed
+    schedule -- not comparable to a solo-execution round; legacy files
+    stay comparable (the checkpoint rule)."""
+    cfg = d.get("config")
+    if not isinstance(cfg, dict) or "batched" not in cfg:
+        return _UNSTAMPED
+    return bool(cfg["batched"])
+
+
 def _kernel_world(d: dict):
     """The fixed-world config a kernelcount report was measured on:
     (backend, world dict) for a standalone tools/kernelcount.py JSON or
@@ -517,6 +544,30 @@ def main(argv=None) -> int:
               f"queue limits (old queue_limit={ql_old!r}, "
               f"new queue_limit={ql_new!r}); re-record with matching "
               f"--queue-limit settings", file=sys.stderr)
+        return 2
+    pl_old, pl_new = _pipeline_config(old), _pipeline_config(new)
+    if pl_old is not _UNSTAMPED and pl_new is not _UNSTAMPED \
+            and pl_old != pl_new:
+        # The async window pipeline hides host drain wall under device
+        # windows, so pipelined and --no-pipeline rounds measure
+        # different launch loops -- the supervise rule.  Unstamped
+        # legacy files pass.
+        print(f"benchdiff: refusing to compare runs with different "
+              f"window-pipeline configs (old pipeline={pl_old!r}, "
+              f"new pipeline={pl_new!r}); re-record with matching "
+              f"--no-pipeline settings", file=sys.stderr)
+        return 2
+    ba_old, ba_new = _batched_config(old), _batched_config(new)
+    if ba_old is not _UNSTAMPED and ba_new is not _UNSTAMPED \
+            and ba_old != ba_new:
+        # Continuous batching packs concurrent requests onto one lane
+        # train: per-request walls measure the packed schedule, not
+        # solo execution -- the queue-limit rule.  Unstamped legacy
+        # files pass.
+        print(f"benchdiff: refusing to compare a batched served round "
+              f"against a solo-execution one (old batched={ba_old!r}, "
+              f"new batched={ba_new!r}); re-record with matching "
+              f"--max-lanes settings", file=sys.stderr)
         return 2
     if args.kernels:
         wo, wn = _kernel_world(old), _kernel_world(new)

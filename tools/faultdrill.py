@@ -36,6 +36,16 @@ Drills (--drill, default "all"):
   derived server/schedule.jsonl reconstructs each request's full
   lifecycle -- no lost transitions, the readmission present, exactly
   one terminal finish.
+* server-batch -- SIGKILL a server mid-BATCHED-flight
+  (docs/robustness.md "Continuous batching").  A non-batchable blocker
+  occupies the single worker while three same-shape phold submissions
+  queue up, so the scheduler deterministically co-batches them onto
+  ONE lane train (`--max-lanes`); once every lane has a mid-run
+  checkpoint the server is SIGKILLed, restarted with --auto-resume,
+  and the trio is re-admitted and re-batched.  Passes when every
+  request exits rc 0 with windows.jsonl byte-identical to its solo
+  reference, at least K-1 requests carry pick_reason "batched", and
+  the schedule/queue-wait checks of the solo-server drill hold.
 * ensemble -- the robustness ladder with a world axis
   (docs/robustness.md "Ensemble resilience"), three sub-drills against
   one N-world --worlds reference: SIGKILL + --auto-resume off a
@@ -523,10 +533,13 @@ def _wait_socket(data_dir: str, proc, timeout_s: float = 120.0):
     raise RuntimeError(f"serve socket never appeared at {sock}")
 
 
-def _serve(data_dir: str, *, resume: bool):
+def _serve(data_dir: str, *, resume: bool, workers: int | None = None,
+           extra: tuple = ()):
     argv = [sys.executable, "-m", "shadow1_tpu", "serve",
             "--data-directory", data_dir, "--no-warm", "--quiet",
-            "--workers", str(len(_SERVER_SEEDS))]
+            "--workers",
+            str(workers if workers is not None else len(_SERVER_SEEDS)),
+            *extra]
     if resume:
         argv.append("--auto-resume")
     p = subprocess.Popen(argv, cwd=REPO, stdout=subprocess.DEVNULL,
@@ -738,6 +751,142 @@ def drill_server(wd, every, stop):
     return errs
 
 
+def drill_server_batch(wd, every, stop):
+    """SIGKILL a server mid-BATCHED-flight (docs/robustness.md
+    "Continuous batching"): K same-shape builder requests co-batched
+    onto one lane train (workers=1 forces the co-pick; a non-batchable
+    blocker request holds the worker while the batch queues up), the
+    server SIGKILLed while every lane is mid-window, then a
+    --auto-resume restart re-admits and re-batches all K -- each
+    request's windows.jsonl must come out byte-identical to its solo
+    reference, exactly as in the solo-server drill."""
+    d = os.path.join(wd, "server-batch")
+    data = os.path.join(d, "data")
+    os.makedirs(data, exist_ok=True)
+
+    print(f"  solo references (seeds {_SERVER_SEEDS}) ...")
+    refs = {s: _solo_ref(d, s, every, stop) for s in _SERVER_SEEDS}
+
+    srv = _serve(data, resume=False, workers=1,
+                 extra=("--max-lanes", str(len(_SERVER_SEEDS)),
+                        "--queue-limit", str(len(_SERVER_SEEDS) + 1)))
+    ids = {}
+    try:
+        # The blocker: a DIFFERENT-shape world that occupies the single
+        # worker while the batchable trio lands in the queue, making
+        # the co-pick deterministic (no race against the worker's
+        # wakeup).  Its shape hint differs, so it is never claimed
+        # into the trio's train.
+        rc, out, err = _client(
+            data, "submit", "--world", "phold",
+            "--world-kwargs", json.dumps(
+                {"num_hosts": 16, "msgs_per_host": 2, "seed": 99,
+                 "stop_time": 2 * SEC}),
+            "--checkpoint-every", f"{every:g}", "--no-wait")
+        if rc != 0:
+            return [f"server-batch: blocker submit refused rc "
+                    f"{rc}\n{err}"]
+        for seed in _SERVER_SEEDS:
+            rc, out, err = _client(
+                data, "submit", "--world", "phold",
+                "--world-kwargs", json.dumps(_server_kw(seed, stop)),
+                "--checkpoint-every", f"{every:g}", "--no-wait")
+            if rc != 0:
+                return [f"server-batch: submit (seed {seed}) refused "
+                        f"rc {rc}\n{err}"]
+            ids[json.loads(out.strip().splitlines()[-1])["id"]] = seed
+        print(f"  submitted {sorted(ids)} behind a blocker; waiting "
+              f"for the co-batched train to anchor ...")
+
+        # With ONE worker, all K can only be RUNNING at once if they
+        # share the train; wait for that plus a win_>0 anchor each.
+        deadline = time.time() + 600.0
+        while True:
+            if time.time() > deadline:
+                return ["server-batch: the trio never co-ran with "
+                        "mid-run checkpoints; lower --checkpoint-every"]
+            states = {}
+            for rid in ids:
+                rj = os.path.join(data, "runs", rid, "request.json")
+                if os.path.exists(rj):
+                    with open(rj) as f:
+                        states[rid] = json.load(f).get("state")
+            if any(s in ("done", "failed", "cancelled")
+                   for s in states.values()):
+                return [f"server-batch: a run finished before the kill "
+                        f"({states}); raise --stop-time"]
+            if all(s == "running" for s in states.values()) \
+                    and len(states) == len(ids) \
+                    and all(any(int(os.path.basename(p)[4:-4]) > 0
+                                for p in glob.glob(
+                                    os.path.join(data, "runs", rid,
+                                                 "ckpt", "win_*.npz")))
+                            for rid in ids):
+                break
+            time.sleep(0.1)
+        srv.send_signal(signal.SIGKILL)
+        srv.wait()
+        print("  SIGKILLed the server mid-batched-flight; restarting "
+              "with --auto-resume ...")
+    finally:
+        if srv.poll() is None:
+            srv.kill()
+            srv.wait()
+
+    srv = _serve(data, resume=True, workers=1,
+                 extra=("--max-lanes", str(len(_SERVER_SEEDS)),
+                        "--queue-limit", str(len(_SERVER_SEEDS) + 1)))
+    errs = []
+    try:
+        batched = 0
+        for rid, seed in sorted(ids.items()):
+            rc, out, err = _client(data, "status", rid, "--wait")
+            if rc != 0:
+                errs.append(f"server-batch: {rid} (seed {seed}) "
+                            f"settled rc {rc}, expected 0\n{err}")
+                continue
+            rec = json.loads(out)
+            if not rec.get("restarts"):
+                errs.append(f"server-batch: {rid} restarts == 0 after "
+                            f"a kill")
+            with open(os.path.join(refs[seed], "windows.jsonl"),
+                      "rb") as f:
+                want = f.read()
+            with open(os.path.join(data, "runs", rid,
+                                   "windows.jsonl"), "rb") as f:
+                got = f.read()
+            if want != got:
+                errs.append(f"server-batch: {rid} windows.jsonl is "
+                            f"not byte-identical to the seed-{seed} "
+                            f"solo reference ({len(want)} vs "
+                            f"{len(got)} bytes)")
+            else:
+                print(f"  {rid}: rc 0, windows.jsonl byte-identical "
+                      f"to solo reference (restarts="
+                      f"{rec.get('restarts')})")
+            mpath = os.path.join(data, "runs", rid,
+                                 "request_metrics.json")
+            if os.path.exists(mpath):
+                with open(mpath) as f:
+                    if json.load(f).get("pick_reason") == "batched":
+                        batched += 1
+        if batched < len(ids) - 1:
+            errs.append(f"server-batch: only {batched} request(s) "
+                        f"carry pick_reason 'batched' (expected at "
+                        f"least {len(ids) - 1}: everyone but the "
+                        f"train's primary)")
+        errs.extend(_check_schedule(data, ids))
+        srv.terminate()  # SIGTERM: drain (nothing left in flight)
+        if srv.wait(timeout=60) != 0:
+            errs.append(f"server-batch: drained serve exited rc "
+                        f"{srv.returncode}, expected 0")
+    finally:
+        if srv.poll() is None:
+            srv.kill()
+            srv.wait()
+    return errs
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="fault-injection drills for supervised runs")
@@ -745,7 +894,7 @@ def main(argv=None) -> int:
                     "(the server drill uses a built-in phold world)")
     ap.add_argument("--drill",
                     choices=("all", "kill", "torn", "nan", "server",
-                             "ensemble"),
+                             "server-batch", "ensemble"),
                     default="all")
     ap.add_argument("--worlds", type=int, default=ENSEMBLE_WORLDS,
                     metavar="N",
@@ -763,7 +912,8 @@ def main(argv=None) -> int:
     config = os.path.abspath(args.config)
     wd = args.workdir or tempfile.mkdtemp(prefix="faultdrill_")
     os.makedirs(wd, exist_ok=True)
-    drills = (("kill", "torn", "nan", "server", "ensemble")
+    drills = (("kill", "torn", "nan", "server", "server-batch",
+               "ensemble")
               if args.drill == "all" else (args.drill,))
 
     ref_sum = None
@@ -805,6 +955,12 @@ def main(argv=None) -> int:
                                     args.stop_time)
             except RuntimeError as e:
                 errs = [f"server: {e}"]
+        elif name == "server-batch":
+            try:
+                errs = drill_server_batch(wd, args.checkpoint_every,
+                                          args.stop_time)
+            except RuntimeError as e:
+                errs = [f"server-batch: {e}"]
         elif name == "ensemble":
             try:
                 errs = drill_ensemble(config, wd,

@@ -29,6 +29,14 @@ pin per subsystem:
                                        bitwise leaf-for-leaf (phold
                                        rx_batch 1/2, lossy bulk TCP,
                                        per-world netem churn)
+  - pipeline     test_pipeline.py      every drain artifact (flight,
+                                       lineage, statescope) byte-
+                                       identical sync vs pipelined
+                                       window launches
+
+(The continuous-batching pin -- two co-batched server requests each
+bitwise their solo run, tests/test_batch.py -- needs ~3 min of solo
+references plus a train and lives in tier-1 instead.)
 
 Together they run in well under five minutes on the virtual 8-device
 CPU mesh, giving a fast did-I-break-determinism signal before paying
