@@ -218,6 +218,12 @@ def main(churn: float | None = None, churn_downtime_s: float = 5.0,
             # a both-stamped mismatch; legacy unstamped rounds compare
             # against anything.
             "megakernel": bool(params.megakernel),
+            # Persistent-window-kernel stamp: also a ShapeKey static
+            # (the whole window compiles into one Pallas region), so a
+            # both-stamped mismatch measures a different dispatch
+            # structure -- benchdiff refuses it; legacy unstamped
+            # rounds compare against anything.
+            "persistent": bool(params.persistent),
             "netem": netem_cfg,
             # Flowscope stamp: benchdiff refuses a sampled-vs-unsampled
             # compare (the ring writes change the traced graph), like
@@ -371,6 +377,9 @@ def main_ensemble(n_worlds: int, gate_against: str | None = None) -> int:
             # stack() pins megakernel off (no vmap batching rule for
             # the Pallas kernel; docs/ensemble.md).
             "megakernel": bool(eparams.megakernel),
+            # With megakernel pinned off, the persistent window kernel
+            # never engages on the ensemble axis.
+            "persistent": False,
             "netem": None,
             "scope": None,
             "lineage": None,
@@ -492,6 +501,7 @@ def main_served(k: int, queue_limit: int,
             "msgs_per_host": MSGS_PER_HOST,
             "sim_seconds": SERVE_SIM_SECONDS,
             "megakernel": True,
+            "persistent": True,
             "netem": None,
             "scope": None,
             "lineage": None,
@@ -690,6 +700,10 @@ def main_multichip(n_devices: int, gate_against: str | None = None) -> int:
             "rx_batch": 2,
             "engine": "mesh_run_until",
             "megakernel": True,
+            # Mesh worlds carry halo offsets (hoff), so the persistent
+            # window kernel defers to the per-phase fused path there --
+            # stamped False to match what actually compiled.
+            "persistent": False,
             "netem": None,
             # Recorder shape: benchdiff refuses to compare a run whose
             # flight config differs (recorder on/off changes the traced

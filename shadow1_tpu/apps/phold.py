@@ -71,20 +71,21 @@ class Phold:
     app_tx_lanes = 4
     wants_window_end = True
     # NOTE: on_tick is row-local over hosts (every read/write is row-
-    # wise, global identity only through host_ids(state)), but it must
-    # NOT run inside a megakernel block: XLA CPU compiles f32
-    # transcendentals to ulp-DIFFERENT results depending on the
+    # wise, global identity only through host_ids(state)), but f32
+    # transcendentals inside it would be fusion-context-sensitive: XLA
+    # CPU compiles them to ulp-DIFFERENT results depending on the
     # surrounding fusion context (measured: jit vs eager of the
     # identical reference window loop disagree by 1-2ns per draw with
     # an f32 log1p).  The exponential-delay draw therefore promotes to
     # f64 before the log1p -- f64 transcendentals lower to a libm call
-    # whose value is independent of fusion context -- which is also
-    # what keeps a vmapped ensemble world bitwise equal to the same
-    # world run solo (vmap restructures the engine graph and with it
-    # every f32 fusion neighborhood; see docs/ensemble.md).  Bitwise
-    # megakernel-vs-reference equality still requires the tick to stay
-    # in the main XLA graph -- see the "f32 stability" section of
-    # docs/megakernel.md.
+    # whose value is independent of fusion context -- which is what
+    # keeps a vmapped ensemble world bitwise equal to the same world
+    # run solo (vmap restructures the engine graph and with it every
+    # f32 fusion neighborhood; see docs/ensemble.md), and what lets
+    # the tick run BETWEEN the per-phase megakernels ("f32 stability")
+    # and INSIDE the persistent window kernel ("Persistent window
+    # kernel", in-kernel contract) without the trajectory moving --
+    # both pinned bitwise in tests/test_megakernel.py.
 
     def __init__(self, mean_delay_ns: int, sock_slot: int = 0,
                  rx_batch: int = 1):

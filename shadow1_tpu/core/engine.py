@@ -2331,6 +2331,45 @@ def microstep(state: SimState, params, app, t_h, window_end):
     return _microstep_core(state, params, app, t_h, window_end)
 
 
+def _window_body_ref(state: SimState, params, app, t_target):
+    """One whole conservative window, reference implementations only:
+    boundary exchange -> per-window scan -> window bounds -> netem
+    advance -> hoisted window ctx -> the micro-step while loop -> window
+    close.  This is the interior of K_WINDOW
+    (megakernel.window_fused): it runs INSIDE a Pallas region, so it
+    must not launch nested kernels (fused=False throughout) and must
+    not touch the window-close instrumentation blocks (scope/sentinel/
+    dg ride outside the kernel; fr/tr ride through because the exchange
+    writes them with integer scatter-adds).  Off-mesh only -- the
+    loop-driving pmin collectives cannot live inside a kernel.
+
+    Returns (state, t_h, gmin, ws, we); the op sequence per phase is
+    the same one the main-graph window body traces, which is what the
+    persistent path's bitwise contract rests on (docs/megakernel.md,
+    "Persistent window kernel")."""
+    st = _exchange(state, params, fused=False)
+    t_h, gmin = _scan_all(st, params, app)
+    ws = jnp.maximum(st.now, gmin)
+    we = jnp.minimum(ws + params.min_latency_ns, t_target)
+    if st.nm is not None:
+        st = st.replace(nm=netem_apply.advance(st.nm, we))
+    ctx = _window_ctx(st, params)
+
+    def icond(icarry):
+        _s, _th, g = icarry
+        return g < we
+
+    def ibody(icarry):
+        s, th, _ = icarry
+        s = _microstep_core(s, params, app, th, we, ctx=ctx)
+        th2, g2 = _scan_all(s, params, app)
+        return s, th2, g2
+
+    st, t_h, gmin = jax.lax.while_loop(icond, ibody, (st, t_h, gmin))
+    st = st.replace(now=we, n_windows=st.n_windows + 1)
+    return st, t_h, gmin, ws, we
+
+
 @functools.partial(jax.jit, static_argnames=("app",))
 def run_until(state: SimState, params, app, t_target):
     """Run windows until simulated time reaches t_target (jitted whole)."""
@@ -2371,6 +2410,7 @@ def run_until_impl(state: SimState, params, app, t_target):
     t_target = jnp.asarray(t_target, I64)
     mesh = _on_mesh(state)
     fused = mk.enabled(state, params, app)
+    persistent = mk.persistent_enabled(state, params, app)
 
     def scan(s):
         t_h, gmin = _scan_all(s, params, app)
@@ -2397,6 +2437,33 @@ def run_until_impl(state: SimState, params, app, t_target):
             # Conservation ledger at window open, before the exchange
             # (which thins acks and drops data mid-identity).
             sn_snap = _sentinel_counters(st)
+        if persistent:
+            # K_WINDOW: the whole window -- exchange, scan, bounds,
+            # netem advance, and the micro-step while loop -- as ONE
+            # Pallas region (megakernel.window_fused), so the window
+            # costs O(1) kernel launches.  The window-close
+            # instrumentation blocks are only touched here, outside the
+            # fused region: scope/sentinel/dg are stripped around the
+            # call (the kernel never reads them) and their hooks run on
+            # the ws/we scalars the kernel emits; fr/tr ride through
+            # because the exchange writes them inside (integer
+            # scatter-adds, fusion-context stable).  The scope ctx is
+            # recomputed from the post-advance overlay -- netem factors
+            # are all-integer, so the recompute is bitwise.
+            scope_b, sent_b, dg_b = st.scope, st.sentinel, st.dg
+            core = st.replace(scope=None, sentinel=None, dg=None)
+            core, t_h, gmin, ws, we = mk.window_fused(
+                core, params, app, t_target)
+            st = core.replace(scope=scope_b, sentinel=sent_b, dg=dg_b)
+            if st.fr is not None:
+                st = _fr_record(st, fr_snap, ws, we)
+            if st.scope is not None:
+                st = _scope_sample(st, _window_ctx(st, params), we)
+            if st.sentinel is not None:
+                st = _sentinel_check(st, sn_snap, ws, we)
+            if st.dg is not None:
+                st = _digest_record(st, we)
+            return st, t_h, gmin, outbox_pending(st)
         # Boundary exchange first: everything in flight becomes visible
         # in the destination slabs before the window's scan.
         st = _exchange(st, params, fused=fused and not mesh)

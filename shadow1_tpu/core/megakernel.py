@@ -77,13 +77,32 @@ _PARAMS_REP = ("route_blk", "host_vertex", "min_latency_ns", "seed_key",
 def enabled(state: SimState, params, app) -> bool:
     """Trace-time static: does this world take the fused path?  The
     log/capture rings and the lineage span ring append at global cursors
-    (cross-row state the kernels do not carry), so observability-
-    instrumented worlds fall back to the reference graph -- they are
-    debug runs by definition (docs/megakernel.md, follow-ups)."""
+    (cross-row state the kernels do not carry), so those worlds fall
+    back to the reference graph.  Every OTHER instrumentation block --
+    flowscope sampling, statescope digests, the sentinel, the flight
+    recorder, trace counters -- is window-close bookkeeping outside the
+    micro-step loop and deliberately does NOT gate: --scope and
+    --digest-every worlds keep the fused (and persistent) op diet,
+    pinned bitwise by tests/test_megakernel.py's instrumented-world
+    battery (docs/megakernel.md, "What gates and what doesn't")."""
     if not getattr(params, "megakernel", False):
         return False
     return state.log is None and state.cap is None \
         and state.lineage is None
+
+
+def persistent_enabled(state: SimState, params, app) -> bool:
+    """Trace-time static: does this world run whole windows through the
+    persistent K_WINDOW region (window_fused)?  Requires the megakernel
+    path to be admissible at all, the params.persistent static, and an
+    off-mesh world: the mesh's loop predicates and exchange are
+    collectives (pmin/all_to_all), which cannot live inside a kernel, so
+    sharded runs keep the per-phase fused kernels per shard."""
+    if not enabled(state, params, app):
+        return False
+    if not getattr(params, "persistent", False):
+        return False
+    return state.hoff is None
 
 
 def _interpret() -> bool:
@@ -268,6 +287,136 @@ def exchange_call(pool, ib, h, params):
     out = jax.tree_util.tree_unflatten(td_out, res)
     return (out["pool"], out["inbox"], out["total"], out["tprot"],
             out["nfree"])
+
+
+# ---------------------------------------------------------------------------
+# Persistent window kernel (K_WINDOW)
+# ---------------------------------------------------------------------------
+
+
+def _call_full(core, inputs):
+    """Run `core(inputs_pytree) -> outputs_pytree` as ONE full-array,
+    single-region pallas call: the exchange_call pattern generalized to
+    arbitrary pytrees.  Every grid step sees the full arrays, the work
+    runs under `pl.when(step == 0)`, and the grid is 2 rather than 1
+    because XLA's while-loop simplifier unrolls trip-count-1 loops --
+    which would dissolve the kernel region back into the surrounding
+    graph.
+
+    0-d leaves are boxed to (1,) across the pallas boundary and
+    zero-size leaves are dropped on the way in / rebuilt as constants on
+    the way out (an empty array carries no data), both transparently.
+    Output leaves whose pytree path matches an input leaf of the same
+    shape/dtype alias that input's buffer (state slabs updated in
+    place), eliding the defensive copy per crossing leaf."""
+    paths_in, td_in = jax.tree_util.tree_flatten_with_path(inputs)
+    flat_in = [l for _p, l in paths_in]
+    in_meta = [(l.ndim == 0, l.size == 0, tuple(l.shape), l.dtype)
+               for l in flat_in]
+    pass_in = []
+    pass_idx = {}              # original leaf index -> passed operand idx
+    for i, l in enumerate(flat_in):
+        if l.size == 0:
+            continue
+        pass_idx[i] = len(pass_in)
+        pass_in.append(l.reshape(1) if l.ndim == 0 else l)
+
+    out_av = jax.eval_shape(core, inputs)
+    out_paths, td_out = jax.tree_util.tree_flatten_with_path(out_av)
+    out_meta = [(a.ndim == 0, a.size == 0, tuple(a.shape), a.dtype)
+                for _p, a in out_paths]
+
+    in_by_path = {jax.tree_util.keystr(p): i
+                  for i, (p, _l) in enumerate(paths_in)}
+    out_shapes = []
+    aliases = {}
+    for (p, _a), (boxed, empty_leaf, shape, dtype) in zip(out_paths,
+                                                          out_meta):
+        if empty_leaf:
+            continue
+        j = len(out_shapes)
+        out_shapes.append(jax.ShapeDtypeStruct((1,) if boxed else shape,
+                                               dtype))
+        i = in_by_path.get(jax.tree_util.keystr(p))
+        if i is not None and i in pass_idx \
+                and in_meta[i][2] == shape and in_meta[i][3] == dtype:
+            aliases[pass_idx[i]] = j
+
+    n_in = len(pass_in)
+
+    def kernel(*refs):
+        @pl.when(pl.program_id(0) == 0)
+        def _work():
+            it = iter(refs[:n_in])
+            vals = []
+            for boxed, empty_leaf, shape, dtype in in_meta:
+                if empty_leaf:
+                    vals.append(jnp.zeros(shape, dtype))
+                else:
+                    v = next(it)[...]
+                    vals.append(v.reshape(()) if boxed else v)
+            tree = jax.tree_util.tree_unflatten(td_in, vals)
+            outs = core(tree)
+            ro = iter(refs[n_in:])
+            for v, (boxed, empty_leaf, _s, _d) in zip(
+                    jax.tree_util.tree_leaves(outs), out_meta):
+                if empty_leaf:
+                    continue
+                r = next(ro)
+                r[...] = jnp.asarray(v)[None] if boxed else v
+
+    in_specs = [pl.BlockSpec(tuple(l.shape),
+                             lambda i, _n=l.ndim: (0,) * _n)
+                for l in pass_in]
+    out_specs = [pl.BlockSpec(tuple(s.shape),
+                              lambda i, _n=len(s.shape): (0,) * _n)
+                 for s in out_shapes]
+    res = pl.pallas_call(
+        kernel, grid=(2,), in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shapes, input_output_aliases=aliases,
+        interpret=_interpret(),
+    )(*pass_in)
+    res = list(res) if isinstance(res, (list, tuple)) else [res]
+    it = iter(res)
+    leaves = []
+    for boxed, empty_leaf, shape, dtype in out_meta:
+        if empty_leaf:
+            leaves.append(jnp.zeros(shape, dtype))
+        else:
+            v = next(it)
+            leaves.append(v.reshape(()) if boxed else v)
+    return jax.tree_util.tree_unflatten(td_out, leaves)
+
+
+def window_fused(state: SimState, params, app, t_target):
+    """One whole conservative window as ONE pallas region (K_WINDOW):
+    the boundary exchange, the per-window scan, the window bounds, the
+    netem advance, and the micro-step while loop with its gmin
+    loop-continue predicate all run inside a single kernel invocation,
+    so a window costs O(1) launches instead of O(steps x phases).
+
+    The body is `engine._window_body_ref` -- reference implementations
+    only (a pallas region cannot nest another pallas_call), with the
+    whole params pytree and t_target riding in as kernel operands
+    (closure-captured tracers are illegal in a kernel body).  The f32
+    contract that makes this bitwise-admissible is the in-kernel one
+    documented in docs/megakernel.md ("Persistent window kernel"):
+    every op inside is integer, exactly-rounded f32, or an f64
+    transcendental that lowers to a context-independent libm call
+    (phold's delay draw moved to f64 log1p in the ensemble round for
+    exactly this property).
+
+    Returns (state, t_h, gmin, ws, we); the caller runs the
+    window-close instrumentation hooks on ws/we outside the region."""
+    t_target = jnp.asarray(t_target, I64)
+
+    def _core(d):
+        st, t_h, gmin, ws, we = engine._window_body_ref(
+            d["st"], d["par"], app, d["tt"])
+        return {"st": st, "t_h": t_h, "gmin": gmin, "ws": ws, "we": we}
+
+    out = _call_full(_core, {"st": state, "par": params, "tt": t_target})
+    return out["st"], out["t_h"], out["gmin"], out["ws"], out["we"]
 
 
 # ---------------------------------------------------------------------------

@@ -125,6 +125,17 @@ class NetParams:
     # reference path (megakernel=False) stays intact as the correctness
     # oracle and lowers byte-identical HLO to pre-megakernel builds.
     megakernel: bool = struct.field(pytree_node=False, default=True)
+    # STATIC: compile the WHOLE conservative window -- the boundary
+    # exchange, the per-window scan, the netem advance, and the
+    # micro-step while loop with its gmin loop predicate -- into one
+    # persistent Pallas region (core/megakernel.py window_fused), so a
+    # window costs O(1) kernel launches instead of O(steps x phases).
+    # Only consulted when the megakernel path is admissible at all
+    # (megakernel.persistent_enabled); off-mesh only -- the mesh's
+    # loop-driving collectives cannot live inside a kernel, so sharded
+    # runs keep the per-phase fused kernels.  persistent=False lowers
+    # byte-identical HLO to pre-persistent builds.
+    persistent: bool = struct.field(pytree_node=False, default=True)
 
     def global_hosts(self):
         """Global host count for app-level draws ("pick a random host"):
@@ -223,6 +234,7 @@ def make_net_params(
     pcap_mask=None,
     cong: str = "reno",
     megakernel: bool = True,
+    persistent: bool = True,
 ) -> NetParams:
     from . import rng
 
@@ -299,4 +311,5 @@ def make_net_params(
         has_loss=bool(jnp.any(rel_m < 1.0)),
         has_jitter=bool(jnp.any(jitter_ns > 0)),
         megakernel=bool(megakernel),
+        persistent=bool(persistent),
     )

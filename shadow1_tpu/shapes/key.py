@@ -11,7 +11,8 @@ tax").  This module makes that tax amortizable:
   block widths (18 UDP-only / 28 TCP), socket slots, the routing vertex
   count V (route_blk is [V*V, 5]), the static NetParams flags
   (cong/has_iface_buf/pds_trail/has_loss/has_jitter/kernel_diet/
-  megakernel, with route_narrow implied by has_jitter), and which
+  megakernel/persistent, with route_narrow implied by has_jitter), and
+  which
   present-or-None blocks
   ride the state (nm/cap/log/log_level/tr/fr/hoff) with their leaf
   shapes.
@@ -76,6 +77,7 @@ class ShapeKey:
     has_jitter: bool
     kernel_diet: bool
     megakernel: bool
+    persistent: bool
     cong: str
     has_iface_buf: bool
     pds_trail: bool
@@ -114,6 +116,7 @@ def shape_key(state, params) -> ShapeKey:
         has_jitter=bool(params.has_jitter),
         kernel_diet=bool(params.kernel_diet),
         megakernel=bool(params.megakernel),
+        persistent=bool(params.persistent),
         cong=str(params.cong),
         has_iface_buf=bool(params.has_iface_buf),
         pds_trail=bool(params.pds_trail),

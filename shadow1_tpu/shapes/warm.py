@@ -109,12 +109,15 @@ def warm_buckets(buckets=None, apps=("phold", "bulk"), log=None):
             state, params, app = _canonical_world(app_name, int(hb))
             real = int(state.hosts.num_hosts)
             state, params = pad_world_to_bucket(state, params)
-            # Warm BOTH megakernel paths: the flag is a ShapeKey static
-            # (a fused world and its reference oracle trace different
-            # graphs), and benchdiff --kernels compares expect both to
-            # be hot.
-            for mk in (True, False):
-                pmk = params.replace(megakernel=mk)
+            # Warm every compiled flavor: megakernel AND persistent are
+            # ShapeKey statics (a fused world, its persistent-window
+            # variant and the reference oracle all trace different
+            # graphs), and benchdiff --kernels compares expect each to
+            # be hot.  persistent=True without megakernel never
+            # compiles (persistent_enabled requires the fused gate), so
+            # three flavors cover the space.
+            for mk, ps in ((True, True), (True, False), (False, False)):
+                pmk = params.replace(megakernel=mk, persistent=ps)
                 t0 = time.perf_counter()
                 lowered = engine.run_until.lower(
                     state, pmk, app, simtime.SIMTIME_ONE_SECOND)
@@ -123,6 +126,7 @@ def warm_buckets(buckets=None, apps=("phold", "bulk"), log=None):
                 t2 = time.perf_counter()
                 rec = {"app": app_name, "bucket_hosts": int(hb),
                        "real_hosts": real, "megakernel": bool(mk),
+                       "persistent": bool(ps),
                        "lower_s": round(t1 - t0, 3),
                        "compile_s": round(t2 - t1, 3)}
                 records.append(rec)

@@ -79,8 +79,11 @@ _GRAPH_ZERO = ("compiles",)
 # they gate at a much tighter default threshold than wall times -- but
 # ONLY under --kernels, because two files may legitimately differ in
 # graph size (different jax version, different backend) when the
-# comparison is about throughput.
-_KERNEL_SPECIAL = ("microstep_ops", "microstep_fusions")
+# comparison is about throughput.  "launches" is the top-level op count
+# of the run_until while-body (tools/kernelcount.py): the per-iteration
+# dispatch surface the persistent window kernel collapses, gated at the
+# same tight threshold.
+_KERNEL_SPECIAL = ("microstep_ops", "microstep_fusions", "launches")
 
 # Only the aggregate graph size gates; the per-opcode breakdown
 # (n_gather, n_conditional, ...) shows WHERE a graph changed but must
@@ -199,6 +202,18 @@ def _megakernel_config(d: dict):
     return bool(cfg["megakernel"])
 
 
+def _persistent_config(d: dict):
+    """The persistent-window-kernel flag a run was recorded with:
+    True/False from the config stamp, None for files written before
+    bench.py stamped it.  Legacy (unstamped) files stay comparable
+    against anything -- the megakernel rule: only a both-stamped
+    mismatch is a cross-graph compare."""
+    cfg = d.get("config")
+    if not isinstance(cfg, dict) or "persistent" not in cfg:
+        return None
+    return bool(cfg["persistent"])
+
+
 def _checkpoint_config(d: dict):
     """The checkpoint cadence a run was recorded with: the
     config.checkpoint_every stamp (seconds, None when off), or _UNSTAMPED
@@ -313,9 +328,10 @@ def _worlds_match(wo, wn) -> bool:
     if wo[0] != wn[0]:
         return False
     a, b = dict(wo[1]), dict(wn[1])
-    if ("megakernel" in a) != ("megakernel" in b):
-        a.pop("megakernel", None)
-        b.pop("megakernel", None)
+    for flag in ("megakernel", "persistent"):
+        if (flag in a) != (flag in b):
+            a.pop(flag, None)
+            b.pop(flag, None)
     return a == b
 
 
@@ -485,6 +501,18 @@ def main(argv=None) -> int:
               f"megakernel configs (old megakernel={mk_old!r}, "
               f"new megakernel={mk_new!r}); re-record with matching "
               f"paths", file=sys.stderr)
+        return 2
+    ps_old, ps_new = _persistent_config(old), _persistent_config(new)
+    if ps_old is not None and ps_new is not None and ps_old != ps_new:
+        # The persistent flag is a ShapeKey static: with it on, a whole
+        # window (micro-step loop + bookkeeping) compiles into one
+        # Pallas region, so launch/op counts measure a different
+        # dispatch structure than the per-phase path.  Unstamped legacy
+        # files pass -- the megakernel rule.
+        print(f"benchdiff: refusing to compare runs with different "
+              f"persistent-window-kernel configs (old "
+              f"persistent={ps_old!r}, new persistent={ps_new!r}); "
+              f"re-record with matching paths", file=sys.stderr)
         return 2
     ck_old, ck_new = _checkpoint_config(old), _checkpoint_config(new)
     if ck_old is not _UNSTAMPED and ck_new is not _UNSTAMPED \

@@ -78,6 +78,10 @@ _CALL_RE = re.compile(
 # count is the static grid, stamped into backend_config.  Dynamic engine
 # loops (window / micro-step / netem cursor) never carry it.
 _TRIP = "known_trip_count"
+# The while instruction's body computation reference, parsed separately
+# from the refs union so `launches` can count the WINDOW loop's body
+# subtree without its condition.
+_BODY_RE = re.compile(r"\bbody=%?([\w.\-]+)")
 # Source marker distinguishing megakernel grid loops from other
 # fixed-trip loops (e.g. threefry fold_in): the kernel body is traced
 # from core/megakernel.py, so its fusions carry that source_file.
@@ -118,9 +122,11 @@ def _parse(text: str) -> dict:
                     else cm.group(2)
                 refs += [t.strip().lstrip("%")
                          for t in val.split(",") if t.strip()]
+            bm = _BODY_RE.search(line)
             comps[cur].append({
                 "op": op.group(1),
                 "refs": refs,
+                "body": bm.group(1) if bm is not None else None,
                 "trip": _TRIP in line,
                 "marker": _MARKER in line,
                 "cc_pallas": (op.group(1) == "custom-call"
@@ -175,6 +181,32 @@ def _pallas_regions(comps: dict):
     return outer, interior
 
 
+def _launches(comps: dict, interior: set) -> int:
+    """Kernel-unit op count of the outermost dynamic while loop's BODY
+    subtree: the per-iteration launch proxy.  For a `run_until` graph
+    the outermost dynamic while is the window loop, so this is the ops
+    a window costs -- every instruction reachable from the body
+    computation (fusion interiors included, matching `n_ops`
+    semantics), with pallas-kernel interiors excluded so a region
+    counts as the ONE dispatch it is on TPU.  Dynamic loops are the
+    ones with no static `known_trip_count` (interpret-mode grid loops
+    carry it); the outermost is simply the one with the largest body
+    subtree, since nested loops' subtrees are strict subsets.  Graphs
+    with no dynamic while (an isolated micro-step or exchange phase)
+    report 0."""
+    best = 0
+    for cname, instrs in comps.items():
+        if cname in interior:
+            continue
+        for ins in instrs:
+            if ins["op"] != "while" or ins["trip"] or not ins["body"]:
+                continue
+            sub = _subtree(comps, [ins["body"]])
+            n = sum(len(comps[c]) for c in sub if c not in interior)
+            best = max(best, n)
+    return best
+
+
 def hlo_counts(text: str) -> dict:
     """Instruction counts of an HLO module dump.
 
@@ -184,7 +216,9 @@ def hlo_counts(text: str) -> dict:
     `n_ops_flat` is the raw total including kernel interiors.  The
     per-opcode breakdown follows `n_ops` semantics.  Graphs without
     pallas kernels have n_pallas=0 and n_ops == n_ops_flat, so
-    reference-path counts are unchanged from the pre-megakernel tool."""
+    reference-path counts are unchanged from the pre-megakernel tool.
+    `launches` is the per-window launch proxy: the kernel-unit count of
+    the outermost dynamic while loop's body subtree (see _launches)."""
     comps = _parse(text)
     regions, interior = _pallas_regions(comps)
     n_flat = sum(len(instrs) for instrs in comps.values())
@@ -197,23 +231,26 @@ def hlo_counts(text: str) -> dict:
             if ins["op"] in by_op:
                 by_op[ins["op"]] += 1
     out = {"n_ops": n_ops, "n_ops_flat": n_flat,
-           "n_pallas": len(regions), "n_fusions": by_op.pop("fusion")}
+           "n_pallas": len(regions), "n_fusions": by_op.pop("fusion"),
+           "launches": _launches(comps, interior)}
     out.update({f"n_{k.replace('-', '_')}": v for k, v in by_op.items()})
     return out
 
 
 def _tiny_world(num_hosts: int, rx_batch: int, seed: int,
-                megakernel: bool = True):
+                megakernel: bool = True, persistent: bool = True):
     from shadow1_tpu import sim
 
     state, params, app = sim.build_phold(
         num_hosts=num_hosts, msgs_per_host=2,
         pool_capacity=num_hosts * 16, seed=seed, rx_batch=rx_batch)
-    return state, params.replace(megakernel=bool(megakernel)), app
+    return state, params.replace(megakernel=bool(megakernel),
+                                 persistent=bool(persistent)), app
 
 
 def phase_counts(num_hosts: int = 64, rx_batch: int = 1,
-                 seed: int = 1, megakernel: bool = True) -> dict:
+                 seed: int = 1, megakernel: bool = True,
+                 persistent: bool = True) -> dict:
     """Compile the hot phases for a fixed tiny phold world and count
     their HLO ops.  Returns {phase: hlo_counts(...)}; values depend only
     on (shapes, statics, backend), never on runtime data."""
@@ -224,7 +261,8 @@ def phase_counts(num_hosts: int = 64, rx_batch: int = 1,
     from shadow1_tpu.core.state import I64
 
     state, params, app = _tiny_world(num_hosts, rx_batch, seed,
-                                     megakernel=megakernel)
+                                     megakernel=megakernel,
+                                     persistent=persistent)
     h = int(state.hosts.num_hosts)
     t_h = jnp.zeros((h,), I64)
     we = jnp.asarray(0, I64)
@@ -262,21 +300,26 @@ def phase_counts(num_hosts: int = 64, rx_batch: int = 1,
 
 
 def report(num_hosts: int = 64, rx_batch: int = 1, seed: int = 1,
-           megakernel: bool = True) -> dict:
+           megakernel: bool = True, persistent: bool = True) -> dict:
     """The full diffable report: per-phase counts + config echo."""
     import jax
 
     phases = phase_counts(num_hosts=num_hosts, rx_batch=rx_batch,
-                          seed=seed, megakernel=megakernel)
+                          seed=seed, megakernel=megakernel,
+                          persistent=persistent)
     return {
         "backend": jax.default_backend(),
         "world": {"app": "phold", "num_hosts": num_hosts,
                   "rx_batch": rx_batch, "seed": seed,
-                  "megakernel": bool(megakernel)},
+                  "megakernel": bool(megakernel),
+                  "persistent": bool(persistent)},
         "phases": phases,
         # The headline number regressions gate on: the per-step graph.
         "microstep_ops": phases["microstep"]["n_ops"],
         "microstep_fusions": phases["microstep"]["n_fusions"],
+        # The per-window launch proxy (persistent-kernel round metric):
+        # kernel-unit ops inside the run_until window loop's body.
+        "launches": phases["run_until"]["launches"],
     }
 
 
@@ -290,18 +333,24 @@ def main(argv=None) -> int:
     ap.add_argument("--no-megakernel", action="store_true",
                     help="count the reference (megakernel=False) graph "
                          "for fused-vs-reference comparison")
+    ap.add_argument("--no-persistent", action="store_true",
+                    help="count the per-phase fused graph "
+                         "(persistent=False) instead of the persistent "
+                         "window-kernel graph")
     ap.add_argument("--json", action="store_true",
                     help="emit one machine-readable JSON object")
     args = ap.parse_args(argv)
 
     rep = report(num_hosts=args.hosts, rx_batch=args.rx_batch,
-                 seed=args.seed, megakernel=not args.no_megakernel)
+                 seed=args.seed, megakernel=not args.no_megakernel,
+                 persistent=not args.no_persistent)
     if args.json:
         print(json.dumps(rep))
         return 0
     print(f"backend: {rep['backend']}  world: phold "
           f"H={args.hosts} rx_batch={args.rx_batch} "
-          f"megakernel={rep['world']['megakernel']}")
+          f"megakernel={rep['world']['megakernel']} "
+          f"persistent={rep['world']['persistent']}")
     cols = sorted({k for p in rep["phases"].values() for k in p})
     first = ["n_ops", "n_ops_flat", "n_pallas", "n_fusions"]
     cols = first + [c for c in cols if c not in first]

@@ -20,6 +20,12 @@ excluded; steady-state wall time per simulated second reported):
           leading world axis through ensemble.run_until; FAILS if the
           ensemble compiles more than one graph or its wall time is not
           well under 8 sequential solo runs -- docs/ensemble.md)
+  rung 11: persistent window kernel       (phold through K_WINDOW,
+          params.persistent; FAILS on any bitwise divergence from the
+          reference trajectory, on more than one compiled run_until
+          graph for the measured span, or if the per-window launch
+          surface (tools/kernelcount.py `launches`) has not collapsed
+          >= 5x vs the per-phase fused graph -- docs/megakernel.md)
 
     python tools/ladder.py [rung ...]     # default: 1 2 3 5 6
 """
@@ -291,9 +297,84 @@ def rung_ensemble(n_worlds: int = 8, num_hosts: int = 1024,
     }
 
 
+def rung_persistent(num_hosts: int = 1024, span_s: int = 2):
+    """Phold through the persistent window kernel (K_WINDOW): the
+    measured span must reuse the warmup's single compiled run_until
+    graph (zero new compiles), the trajectory must be bitwise
+    leaf-for-leaf equal to the reference oracle (megakernel off), and
+    the per-window launch surface -- tools/kernelcount.py `launches`,
+    the top-level op count of the run_until while-body -- must be
+    collapsed >= 5x vs the per-phase fused graph (docs/megakernel.md,
+    PERF.md round 10)."""
+    import importlib.util
+    import pathlib
+
+    import numpy as np
+
+    from shadow1_tpu.core import megakernel as mk
+
+    s, p, a = sim.build_phold(num_hosts=num_hosts, msgs_per_host=4,
+                              stop_time=(span_s + 1) * SEC,
+                              pool_capacity=num_hosts * 8, rx_batch=2)
+    assert p.persistent and mk.persistent_enabled(s, p, a), \
+        "persistent window kernel did not engage on the ladder world"
+
+    warm = engine.run_until(s, p, a, SEC // 100)
+    jax.block_until_ready(warm)
+    jit_before = engine.run_until._cache_size()
+    t0 = time.perf_counter()
+    out = engine.run_until(warm, p, a, span_s * SEC)
+    jax.block_until_ready(out)
+    wall = time.perf_counter() - t0
+    graphs = engine.run_until._cache_size() - jit_before
+    assert graphs == 0, (
+        f"measured span compiled {graphs} extra run_until graph(s): "
+        f"the persistent path must reuse the warmup's one graph")
+    assert int(out.err) == 0, f"err flags {int(out.err)}"
+
+    # Same warm-then-span schedule: stopping at the warm horizon clamps
+    # a window there, so a straight run would chunk windows differently
+    # (legitimately different bookkeeping, not a divergence).
+    pref = p.replace(megakernel=False)
+    ref = engine.run_until(s, pref, a, SEC // 100)
+    ref = engine.run_until(ref, pref, a, span_s * SEC)
+    la, _ta = jax.tree_util.tree_flatten(out)
+    lb, _tb = jax.tree_util.tree_flatten(ref)
+    assert len(la) == len(lb), "persistent/reference leaf count diverged"
+    for i, (x, y) in enumerate(zip(la, lb)):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), (
+            f"persistent trajectory diverged from reference at leaf {i}")
+
+    spec = importlib.util.spec_from_file_location(
+        "kernelcount",
+        pathlib.Path(__file__).resolve().parent / "kernelcount.py")
+    kc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(kc)
+    per = kc.phase_counts(megakernel=True, persistent=True)["run_until"]
+    fused = kc.phase_counts(megakernel=True,
+                            persistent=False)["run_until"]
+    assert per["n_pallas"] == 1, per
+    assert per["launches"] * 5 <= fused["launches"], (
+        f"launch surface not collapsed >= 5x: persistent "
+        f"{per['launches']} vs fused {fused['launches']}")
+    return {
+        "num_hosts": num_hosts,
+        "sim_seconds": span_s,
+        "wall_seconds": round(wall, 3),
+        "sim_per_wall": round(span_s / wall, 3),
+        "microsteps": int(out.n_steps),
+        "run_until_graphs_measured_span": graphs,
+        "bitwise_vs_reference": True,
+        "launches_persistent": per["launches"],
+        "launches_fused": fused["launches"],
+        "launch_reduction_x": round(fused["launches"]
+                                    / max(1, per["launches"]), 1),
+    }
+
+
 def main(rungs):
     unknown = set(rungs) - {"1", "2", "3", "4", "5", "6", "7", "8", "9",
-                            "10"}
+                            "10", "11"}
     if unknown:
         raise SystemExit(f"unknown ladder rungs: {sorted(unknown)}")
     results = {"backend": jax.default_backend()}
@@ -332,6 +413,8 @@ def main(rungs):
         record("phold_buckets", rung_buckets)
     if "10" in rungs:
         record("phold_ensemble", rung_ensemble)
+    if "11" in rungs:
+        record("phold_persistent", rung_persistent)
     print(json.dumps(results))
 
 

@@ -13,7 +13,9 @@ Two attribution methods over the same busy-state worlds:
   same full-step baseline.  Slower, more faithful.
 * fused -- the megakernel path (core/megakernel.py): fused step vs
   reference step, per-kernel compute deltas (bodies no-op'd inside the
-  launch structure), and the boundary exchange both ways.
+  launch structure), the boundary exchange both ways, and the whole
+  window both ways (K_WINDOW persistent kernel vs the inline
+  main-graph window body).
 
 Also times the window-boundary exchange as its own forced loop.
 
@@ -301,6 +303,34 @@ def run_fused(state, params, app, we):
                   app, v_exch_fused)
     print(f"{'=> exchange kernel vs reference':44s} {ef - er:+8.3f} "
           f"ms/iter")
+
+    # Whole-window attribution: K_WINDOW (the persistent window kernel)
+    # runs the complete window body -- exchange, micro-step loop,
+    # netem advance, bookkeeping -- inside ONE Pallas region, where the
+    # main-graph row traces the identical body inline.  The delta is
+    # what collapsing a window's dispatch to a single launch buys (or
+    # costs) on this backend.  Windows are heavier than micro-steps, so
+    # the slope pair is shorter.
+    pp = pf.replace(persistent=True)
+    if not mk.persistent_enabled(state, pp, app):
+        print("fused: persistent window kernel disabled for this world "
+              "(mesh halo offsets installed?); skipping K_WINDOW rows")
+        return
+
+    def v_win_ref(s, th):
+        s2, th2, _g, _ws, _wend = engine._window_body_ref(s, pr, app, we)
+        return s2, th2
+
+    def v_win_fused(s, th):
+        s2, th2, _g, _ws, _wend = mk.window_fused(s, pp, app, we)
+        return s2, th2
+
+    wr = timeloop("window body main-graph (forced)", state, params, app,
+                  v_win_ref, iters_pair=(10, 40))
+    wf = timeloop("K_WINDOW persistent kernel (forced)", state, params,
+                  app, v_win_fused, iters_pair=(10, 40))
+    print(f"{'=> K_WINDOW vs main-graph window':44s} {wf - wr:+8.3f} "
+          f"ms/window")
 
 
 def measure_staging_ms(state, params, app, iters_pair=(20, 60)) -> float:
